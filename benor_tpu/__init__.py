@@ -1,0 +1,31 @@
+"""benor_tpu — a TPU-native randomized-consensus simulation framework.
+
+A brand-new framework with the capabilities of
+``viviendbk/ben-or-consensus-algorithm`` (the Ben-Or binary consensus
+protocol, its crash-fault model, its start/stop/status/getState control API
+and its integration-test contract), re-hosted as vectorized JAX device
+arrays: all N nodes' state lives in [trials, N] tensors and one protocol
+round is one compiled kernel instead of O(N^2) localhost HTTP requests.
+
+Layout (SURVEY.md §7):
+  config.py        static SimConfig (the reference's src/config.ts + flags)
+  state.py         NetState / FaultSpec arrays (N2)
+  models/benor.py  the round kernel (N3)
+  ops/             tally, scheduler, sampling, rng (N4, N6, N9)
+  parallel/        mesh + shard_map distribution (N7)
+  backends/        'tpu' array network + 'express' asyncio oracle (N1)
+  sim.py           while-loop driver + checkpoint re-entry
+  api.py           launch_network parity facade (N10)
+"""
+
+from .config import BASE_NODE_PORT, SimConfig, VAL0, VAL1, VALQ
+from .state import FaultSpec, NetState, init_state, observable_state
+from .sim import run_consensus, resume_consensus, simulate, start_state
+
+__all__ = [
+    "BASE_NODE_PORT", "SimConfig", "VAL0", "VAL1", "VALQ",
+    "FaultSpec", "NetState", "init_state", "observable_state",
+    "run_consensus", "resume_consensus", "simulate", "start_state",
+]
+
+__version__ = "0.1.0"

@@ -1,0 +1,135 @@
+"""Typed simulation configuration.
+
+The reference (`/root/reference`) has exactly one config constant —
+``BASE_NODE_PORT`` (src/config.ts:1) — and passes everything else positionally
+(src/index.ts:4-9, src/nodes/node.ts:8-16).  This module is the framework's
+replacement: a single frozen dataclass that is *static* under ``jax.jit``
+(hashable, passed as a static argument), covering the protocol parameters the
+reference hardcodes plus the new TPU-native axes (trials, delivery model,
+fault model, mesh shape, coin mode).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+#: TCP port of node 0 for the HTTP observation layer — parity with
+#: reference src/config.ts:1 (node i listens on BASE_NODE_PORT + i).
+BASE_NODE_PORT = 3000
+
+# Encodings of the protocol value domain ``Value = 0 | 1 | "?"``
+# (reference src/types.ts:8) as int8 device scalars.
+VAL0 = 0
+VAL1 = 1
+VALQ = 2  # the "?" value
+
+
+@dataclasses.dataclass(frozen=True)
+class SimConfig:
+    """Static configuration for one simulated Ben-Or network.
+
+    Attributes mirror the reference's launch parameters
+    (``launchNetwork(N, F, initialValues, faultyList)``, src/index.ts:4-9)
+    plus the TPU-native extensions mandated by BASELINE.json.
+    """
+
+    # --- protocol parameters (reference parity) -------------------------
+    n_nodes: int                      # N — total nodes
+    n_faulty: int                     # F — protocol fault parameter; quorum = N - F
+    max_rounds: int = 32              # round cap (reference loops forever; node.ts:147-157)
+
+    # --- decision rule --------------------------------------------------
+    # 'reference': plurality-adopt before coin (node.ts:106-112 — quirk 9 in
+    #   SURVEY §2.1; required for the k<=2 test bounds).
+    # 'textbook': flip the coin whenever no value has > F votes (classic Ben-Or).
+    rule: str = "reference"
+
+    # --- randomness -----------------------------------------------------
+    seed: int = 0
+    # 'private': independent fair coin per (trial, node, round) — reference
+    #   Math.random() at node.ts:111.  'common': one shared coin per
+    #   (trial, round) — the shared-common-coin variant (expected O(1) rounds).
+    coin_mode: str = "private"
+
+    # --- delivery / scheduler (the N9 asynchrony model) -----------------
+    # 'all':    every receiver tallies every live sender's message (the
+    #           reference's *final* tally once all fetches land; deterministic).
+    # 'quorum': every receiver tallies exactly N-F messages chosen by the
+    #           scheduler — the "first N-F arrivals win" nondeterminism of
+    #           node.ts:52,88 made explicit and seeded.
+    delivery: str = "all"
+    # subset selection when delivery == 'quorum':
+    # 'uniform':     uniformly random N-F subset of live senders per receiver
+    # 'biased':      delay-bounded split adversary (dense path only; strength
+    #                set by adversary_strength)
+    # 'adversarial': worst-case count-controlling adversary — forces tied
+    #                0/1 tallies at every receiver (both paths)
+    scheduler: str = "uniform"
+    # Delay added by the 'biased' scheduler to starved-class edges.
+    adversary_strength: float = 0.0
+
+    # --- compute path ---------------------------------------------------
+    # 'dense':     explicit [T, N, N] delivery mask; exact; N <= ~10^4.
+    # 'histogram': O(N) global per-class counts + per-lane (multivariate)
+    #              hypergeometric sampling of the tallied quorum; N up to 10^6+.
+    # 'auto':      dense when N <= dense_path_max_n else histogram.
+    path: str = "auto"
+    dense_path_max_n: int = 2048
+
+    # --- Monte-Carlo ----------------------------------------------------
+    trials: int = 1                   # T — independent MC trials (batch axis)
+
+    # --- fault model (N5) -----------------------------------------------
+    # 'crash':          faulty nodes dead from birth (reference node.ts:21-26)
+    # 'byzantine':      faulty nodes alive but broadcast bit-flipped values
+    # 'crash_at_round': faulty node i dies at the start of round crash_round[i]
+    fault_model: str = "crash"
+
+    # --- state-machine shape -------------------------------------------
+    # Freeze a lane once it decides (reference nodes loop forever after
+    # deciding — quirk 5; the frozen lane still *broadcasts* its decided value
+    # so quorum math is preserved, but its own (x, decided, k) stop updating).
+    freeze_decided: bool = True
+
+    # --- distribution (N7) ----------------------------------------------
+    # Mesh axis sizes (trials_axis, nodes_axis); None => single device.
+    mesh_shape: Optional[Tuple[int, int]] = None
+
+    # --- misc -----------------------------------------------------------
+    backend: str = "tpu"              # 'tpu' | 'express' — the N1 backend switch
+    debug: bool = False               # enable host-callback tracing / profiling
+
+    def __post_init__(self) -> None:
+        if self.n_nodes < 1:
+            raise ValueError("n_nodes must be >= 1")
+        if not (0 <= self.n_faulty <= self.n_nodes):
+            raise ValueError("n_faulty must be in [0, n_nodes]")
+        if self.rule not in ("reference", "textbook"):
+            raise ValueError(f"unknown rule: {self.rule}")
+        if self.coin_mode not in ("private", "common"):
+            raise ValueError(f"unknown coin_mode: {self.coin_mode}")
+        if self.delivery not in ("all", "quorum"):
+            raise ValueError(f"unknown delivery: {self.delivery}")
+        if self.scheduler not in ("uniform", "biased", "adversarial"):
+            raise ValueError(f"unknown scheduler: {self.scheduler}")
+        if self.path not in ("auto", "dense", "histogram"):
+            raise ValueError(f"unknown path: {self.path}")
+        if self.fault_model not in ("crash", "byzantine", "crash_at_round"):
+            raise ValueError(f"unknown fault_model: {self.fault_model}")
+        if self.backend not in ("tpu", "express"):
+            raise ValueError(f"unknown backend: {self.backend}")
+
+    @property
+    def quorum(self) -> int:
+        """Messages required before a tally fires: N - F (node.ts:52,88)."""
+        return self.n_nodes - self.n_faulty
+
+    @property
+    def resolved_path(self) -> str:
+        if self.path != "auto":
+            return self.path
+        return "dense" if self.n_nodes <= self.dense_path_max_n else "histogram"
+
+    def replace(self, **kw) -> "SimConfig":
+        return dataclasses.replace(self, **kw)

@@ -1,0 +1,5 @@
+"""Protocol models. The flagship (and the reference's only protocol) is Ben-Or."""
+
+from .benor import all_settled, benor_round
+
+__all__ = ["all_settled", "benor_round"]

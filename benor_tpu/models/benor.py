@@ -1,0 +1,126 @@
+"""The Ben-Or round kernel (SURVEY.md N3) — one pure function per round.
+
+Reproduces, lane-vectorized over [trials, nodes], the exact semantics of the
+reference's ``/message`` handler (src/nodes/node.ts:43-163), including the
+behavioral quirks the reference tests co-evolved with (SURVEY §2.1):
+
+  * quorum gate counts raw messages INCLUDING "?" (node.ts:52,88 — quirk 4),
+  * phase-1 majority with tie -> "?" (node.ts:63-69),
+  * phase-2 decide when count(v) > F (node.ts:99-104),
+  * plurality-adopt before the coin (node.ts:106-112 — quirk 9; the
+    'textbook' rule flag removes this branch),
+  * broadcasts include self (node.ts:72,149,173 — quirk 6),
+  * faulty crash nodes never send (killed at birth, node.ts:21-26).
+
+Everything is branch-free jnp.where masking: static shapes, no Python
+control flow, fuses into a handful of XLA kernels per round.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..config import SimConfig, VAL0, VAL1, VALQ
+from ..ops import rng, tally
+from ..state import FaultSpec, NetState
+
+
+def _flip(x: jax.Array) -> jax.Array:
+    """Byzantine bit-flip: 0 <-> 1, "?" unchanged."""
+    return jnp.where(x == VAL0, jnp.int8(VAL1),
+                     jnp.where(x == VAL1, jnp.int8(VAL0), jnp.int8(VALQ)))
+
+
+def _sent_values(cfg: SimConfig, x: jax.Array, faults: FaultSpec) -> jax.Array:
+    """What each lane broadcasts: byzantine lanes flip their value."""
+    if cfg.fault_model == "byzantine":
+        return jnp.where(faults.faulty, _flip(x), x)
+    return x
+
+
+def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
+                base_key: jax.Array, r: jax.Array) -> NetState:
+    """Advance every lane by one full Ben-Or round (proposal + vote phase).
+
+    ``r`` is the 1-based round index; matches the reference's message ``k``.
+    """
+    T, N = state.x.shape
+    F = cfg.n_faulty
+    m = cfg.quorum
+
+    # --- crash-at-round fault injection (start of round) -----------------
+    killed = state.killed
+    if cfg.fault_model == "crash_at_round":
+        crashing = faults.faulty & (faults.crash_round > 0) & \
+            (r >= faults.crash_round)
+        killed = killed | crashing
+
+    alive = ~killed                                          # senders this round
+    n_alive = jnp.sum(alive, axis=-1, dtype=jnp.int32)       # [T]
+    # Quorum gate: a tally only ever fires if >= N-F messages can arrive
+    # (node.ts:52,88). With fewer live senders the whole trial stalls forever,
+    # exactly like reference receivers waiting for fetches that never come.
+    quorum_ok = (n_alive >= m)[:, None]                      # [T, 1]
+
+    # Lanes that actually run the round logic: alive, trial has quorum, and
+    # (unless freeze_decided is off) not already decided — quirk 5 handling.
+    frozen = state.decided & cfg.freeze_decided
+    active = alive & quorum_ok & ~frozen
+
+    # --- phase 1: "proposal phase" (node.ts:46-82) -----------------------
+    sent1 = _sent_values(cfg, state.x, faults)
+    cnt1 = tally.receiver_counts(cfg, base_key, r, rng.PHASE_PROPOSAL,
+                                 sent1, alive)               # [T, N, 3]
+    p0, p1 = cnt1[..., 0], cnt1[..., 1]
+    # majority -> value, tie -> "?" (node.ts:63-69)
+    x1 = jnp.where(p0 > p1, jnp.int8(VAL0),
+                   jnp.where(p1 > p0, jnp.int8(VAL1), jnp.int8(VALQ)))
+
+    # --- phase 2: "voting phase" (node.ts:83-158) ------------------------
+    # A live undecided lane votes its phase-1 result; a frozen decided lane
+    # keeps vouching for its decided value (the reference's decided nodes keep
+    # broadcasting forever, node.ts:147-157 — freezing the lane must not
+    # starve its peers' quorums).
+    vote_val = jnp.where(frozen, state.x, x1)
+    sent2 = _sent_values(cfg, vote_val, faults)
+    cnt2 = tally.receiver_counts(cfg, base_key, r, rng.PHASE_VOTE,
+                                 sent2, alive)
+    v0, v1 = cnt2[..., 0], cnt2[..., 1]
+
+    decide0 = v0 > F                                         # node.ts:99
+    decide1 = v1 > F                                         # node.ts:102
+    coin = rng.coin_flips(base_key, r, rng.ids(T), rng.ids(N),
+                          common=(cfg.coin_mode == "common"))
+    if cfg.rule == "reference":
+        # plurality-adopt before coin (node.ts:106-112)
+        any_votes = (v0 + v1) > 0
+        adopt0 = any_votes & (v0 > v1)
+        adopt1 = any_votes & (v0 < v1)
+        x2 = jnp.where(decide0, jnp.int8(VAL0),
+             jnp.where(decide1, jnp.int8(VAL1),
+             jnp.where(adopt0, jnp.int8(VAL0),
+             jnp.where(adopt1, jnp.int8(VAL1), coin))))
+    else:  # textbook: coin whenever no value exceeds F votes
+        x2 = jnp.where(decide0, jnp.int8(VAL0),
+             jnp.where(decide1, jnp.int8(VAL1), coin))
+
+    newly_decided = active & (decide0 | decide1)
+
+    # --- commit (node.ts:100-103, 147) -----------------------------------
+    new_x = jnp.where(active, x2, state.x)
+    new_decided = state.decided | newly_decided
+    # k <- k+1 after the vote tally, unconditionally for lanes that ran the
+    # round — including the round in which they decide (node.ts:147 runs
+    # after the decide branch), so a lane deciding in round r reports k=r+1.
+    new_k = jnp.where(active, r + 1, state.k)
+
+    return NetState(x=new_x, decided=new_decided, k=new_k, killed=killed)
+
+
+def all_settled(state: NetState) -> jax.Array:
+    """True when every lane is decided or dead — the termination predicate
+    replacing the reference's racy global-halt probe (node.ts:119-145)."""
+    return jnp.all(state.decided | state.killed)

@@ -1,0 +1,5 @@
+"""Device-side operators: tallies, delivery scheduling, sampling, randomness."""
+
+from . import rng, sampling, scheduler, tally
+
+__all__ = ["rng", "sampling", "scheduler", "tally"]

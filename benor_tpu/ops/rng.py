@@ -1,0 +1,104 @@
+"""Deterministic, mesh-shape-independent randomness (SURVEY.md N6).
+
+The reference's only randomness is a private per-node fair coin,
+``Math.random() > 0.5`` at src/nodes/node.ts:111, drawn from the global
+process RNG.  Here every random draw is derived counter-style from
+``(seed, round, phase, trial, node[, peer])`` by *chained*
+``jax.random.fold_in`` — never from an arithmetic product of indices — so:
+
+  * results are bit-identical across mesh shapes (a shard folds in the
+    *global* ids it owns, never shard-local indices),
+  * no id ever overflows: each folded component stays < 2^31 even at
+    10^6 nodes x 10^6 trials (a flat trial*N+node id would wrap int32),
+  * per-(trial, node, round) streams are independent.
+
+This is SURVEY §7 hard-part 5 ("sharded randomness") solved by construction.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Phase tags folded into the round key so proposal-phase scheduling, vote-phase
+# scheduling and the coin never share a stream.  Phase-2 sampling uses
+# PHASE_* + 16 for a second independent uniform.
+PHASE_PROPOSAL = 0
+PHASE_VOTE = 1
+PHASE_COIN = 2
+
+
+def round_key(base_key: jax.Array, r: jax.Array, phase: int) -> jax.Array:
+    """Key for (round, phase), shared across all lanes."""
+    return jax.random.fold_in(jax.random.fold_in(base_key, r), phase)
+
+
+def grid_keys(rp_key: jax.Array, trial_ids: jax.Array,
+              node_ids: jax.Array) -> jax.Array:
+    """Independent key per (trial, node) -> keys [T, N].
+
+    trial_ids int32 [T], node_ids int32 [N] — *global* ids; shards pass the
+    id ranges they own.
+    """
+    tkeys = jax.vmap(lambda t: jax.random.fold_in(rp_key, t))(trial_ids)
+    return jax.vmap(lambda tk: jax.vmap(
+        lambda n: jax.random.fold_in(tk, n))(node_ids))(tkeys)
+
+
+def grid_uniforms(base_key: jax.Array, r: jax.Array, phase: int,
+                  trial_ids: jax.Array, node_ids: jax.Array) -> jax.Array:
+    """One float32 uniform in [0,1) per (trial, node) -> [T, N]."""
+    keys = grid_keys(round_key(base_key, r, phase), trial_ids, node_ids)
+    flat = keys.reshape(-1)
+    u = jax.vmap(lambda k: jax.random.uniform(k))(flat)
+    return u.reshape(trial_ids.shape[0], node_ids.shape[0])
+
+
+def edge_uniforms(base_key: jax.Array, r: jax.Array, phase: int,
+                  trial_ids: jax.Array, recv_ids: jax.Array,
+                  send_ids: jax.Array) -> jax.Array:
+    """One float32 uniform per (trial, receiver, sender) edge -> [T, R, S].
+
+    Dense-path delay tensor; R * S stays <= ~10^8 by construction
+    (dense_path_max_n), ids never combined arithmetically.
+    """
+    rk = round_key(base_key, r, phase)
+    tkeys = jax.vmap(lambda t: jax.random.fold_in(rk, t))(trial_ids)
+
+    def per_trial(tk):
+        rkeys = jax.vmap(lambda i: jax.random.fold_in(tk, i))(recv_ids)
+
+        def per_recv(rkey):
+            return jax.vmap(
+                lambda s: jax.random.uniform(jax.random.fold_in(rkey, s))
+            )(send_ids)
+
+        return jax.vmap(per_recv)(rkeys)
+
+    return jax.vmap(per_trial)(tkeys)
+
+
+def coin_flips(base_key: jax.Array, r: jax.Array, trial_ids: jax.Array,
+               node_ids: jax.Array, common: bool) -> jax.Array:
+    """Fair coin -> int8 in {0, 1}, shape [T, N].
+
+    private: independent per (trial, node, round) — reference node.ts:111.
+    common:  one shared coin per (trial, round); all nodes of a trial agree
+             (the shared-common-coin variant, expected O(1) rounds).
+    """
+    kr = round_key(base_key, r, PHASE_COIN)
+    if common:
+        tkeys = jax.vmap(lambda t: jax.random.fold_in(kr, t))(trial_ids)
+        bits = jax.vmap(lambda k: jax.random.bernoulli(k))(tkeys)
+        return jnp.broadcast_to(
+            bits[:, None], (trial_ids.shape[0], node_ids.shape[0])
+        ).astype(jnp.int8)
+    keys = grid_keys(kr, trial_ids, node_ids)
+    flat = keys.reshape(-1)
+    bits = jax.vmap(lambda k: jax.random.bernoulli(k))(flat)
+    return bits.reshape(trial_ids.shape[0], node_ids.shape[0]).astype(jnp.int8)
+
+
+def ids(n: int, offset: int = 0) -> jax.Array:
+    """Global id vector [n] starting at ``offset`` (shards pass their base)."""
+    return jnp.arange(n, dtype=jnp.int32) + offset

@@ -1,0 +1,108 @@
+"""Hypergeometric quorum-count sampling (SURVEY.md §7 stage 5).
+
+At N = 1M nodes the dense N x N delivery mask is impossible (10^12 entries).
+But Ben-Or messages are broadcast scalars over the 3-value domain {0, 1, "?"},
+so a receiver that tallies "the first N-F arrivals" (reference node.ts:52,88)
+is statistically drawing N-F senders *without replacement* from the global
+multiset of sent values — i.e. its per-class tallied counts follow a
+multivariate hypergeometric distribution over the global class histogram.
+Sampling those counts directly is O(1) per lane: O(N) per round network-wide.
+
+Exactness strategy:
+  * class 0 count ``h0``: EXACT inverse-CDF sampling.  The hypergeometric pmf
+    for h0 depends only on trial-global quantities (total, c0, m), so one
+    [T, m+1] CDF table is shared by all N lanes of a trial; each lane draws
+    its own uniform and binary-searches the shared CDF.
+  * class 1 count ``h1 | h0``: parameters vary per lane through h0, so an
+    exact shared table is impossible without an O(m^2) blowup; we use a
+    clamped normal approximation (error O(1) counts at m ~ 10^5-10^6 scale).
+    ``tests/test_sampling.py`` KS-checks the end-to-end rounds-to-decide
+    distribution against the exact dense path at small N.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import gammaln
+
+
+def _log_comb(n, k):
+    """log C(n, k) with -inf outside the valid range; float32 inputs."""
+    n = n.astype(jnp.float32)
+    k = k.astype(jnp.float32)
+    valid = (k >= 0) & (k <= n)
+    k_safe = jnp.clip(k, 0.0, jnp.maximum(n, 0.0))
+    out = gammaln(n + 1) - gammaln(k_safe + 1) - gammaln(n - k_safe + 1)
+    return jnp.where(valid, out, -jnp.inf)
+
+
+def hypergeom_cdf_table(total: jax.Array, good: jax.Array, m: int) -> jax.Array:
+    """CDF of Hypergeometric(total, good, m) over support h = 0..m.
+
+    total, good: int32 [...], broadcastable; returns float32 [..., m+1].
+    Computed in log space then normalized (tolerates float32 lgamma error).
+    """
+    h = jnp.arange(m + 1, dtype=jnp.int32)
+    shape = total.shape + (m + 1,)
+    t = jnp.broadcast_to(total[..., None], shape)
+    g = jnp.broadcast_to(good[..., None], shape)
+    logpmf = (_log_comb(g, h) + _log_comb(t - g, m - h) - _log_comb(t, jnp.full_like(h, m)))
+    logpmf = jnp.where(jnp.isfinite(logpmf), logpmf, -jnp.inf)
+    mx = jnp.max(logpmf, axis=-1, keepdims=True)
+    pmf = jnp.exp(logpmf - jnp.where(jnp.isfinite(mx), mx, 0.0))
+    pmf = pmf / jnp.maximum(jnp.sum(pmf, axis=-1, keepdims=True), 1e-30)
+    return jnp.cumsum(pmf, axis=-1)
+
+
+def hypergeom_exact_shared(u: jax.Array, total: jax.Array, good: jax.Array,
+                           m: int) -> jax.Array:
+    """Exact hypergeometric draws from per-trial parameters shared by lanes.
+
+    u: float32 [T, N] per-lane uniforms; total/good: int32 [T].
+    Returns int32 [T, N] counts h ~ Hypergeom(total, good, m).
+    """
+    cdf = hypergeom_cdf_table(total, good, m)              # [T, m+1]
+    # searchsorted per trial row against that trial's lanes
+    idx = jax.vmap(lambda c, uu: jnp.searchsorted(c, uu))(cdf, u)
+    return jnp.clip(idx, 0, m).astype(jnp.int32)
+
+
+def hypergeom_normal_approx(u: jax.Array, total: jax.Array, good: jax.Array,
+                            nsample: jax.Array) -> jax.Array:
+    """Clamped normal-approximation hypergeometric draws, fully per-lane.
+
+    u: uniforms [...]; total/good/nsample: int32 broadcastable to u's shape.
+    """
+    t = jnp.maximum(total.astype(jnp.float32), 1.0)
+    g = good.astype(jnp.float32)
+    n = nsample.astype(jnp.float32)
+    p = g / t
+    mean = n * p
+    fpc = jnp.where(t > 1, (t - n) / jnp.maximum(t - 1, 1.0), 0.0)
+    var = jnp.maximum(n * p * (1 - p) * fpc, 0.0)
+    z = jax.scipy.special.ndtri(jnp.clip(u, 1e-7, 1 - 1e-7))
+    draw = jnp.round(mean + z * jnp.sqrt(var))
+    lo = jnp.maximum(0.0, n - (t - g))
+    hi = jnp.minimum(g, n)
+    return jnp.clip(draw, lo, hi).astype(jnp.int32)
+
+
+def multivariate_hypergeom_counts(u0: jax.Array, u1: jax.Array,
+                                  class_counts: jax.Array, m: int) -> jax.Array:
+    """Sample per-lane tallied class counts (h0, h1, hq) without replacement.
+
+    u0, u1: float32 [T, N] independent uniforms per lane.
+    class_counts: int32 [T, 3] global (c0, c1, cq) histogram of sent values.
+    m: static quorum size (N - F).  Returns int32 [T, N, 3] with rows summing
+    to m (clamped into the feasible region).
+    """
+    c0 = class_counts[:, 0]
+    c1 = class_counts[:, 1]
+    total = class_counts.sum(axis=-1)                       # [T]
+    h0 = hypergeom_exact_shared(u0, total, c0, m)           # [T, N] exact
+    rem_total = jnp.maximum(total[:, None] - c0[:, None], 0)
+    rem_draw = jnp.maximum(m - h0, 0)
+    h1 = hypergeom_normal_approx(u1, rem_total, c1[:, None], rem_draw)
+    hq = jnp.maximum(m - h0 - h1, 0)
+    return jnp.stack([h0, h1, hq], axis=-1)

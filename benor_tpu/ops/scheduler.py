@@ -1,0 +1,77 @@
+"""Seeded asynchronous-adversary message scheduler (SURVEY.md N9).
+
+The reference's asynchrony is accidental: a node tallies whichever N-F
+messages the Node.js event loop happens to deliver first (node.ts:52,88).
+Here that nondeterminism is explicit, deterministic and seeded.  Three
+schedulers, selected by ``SimConfig.scheduler``:
+
+  uniform:      every (receiver, sender) edge draws an iid delay; the N-F
+                smallest delays per receiver define the tallied multiset.
+  biased:       uniform delays plus ``adversary_strength`` added to edges
+                whose message carries the value the receiver's parity class
+                is being starved of — a *delay-bounded* adversary (dense
+                path only; its power is limited by quorum overlap).
+  adversarial:  the worst-case *count-controlling* adversary — handled in
+                ops/tally.py (both paths): every receiver tallies a multiset
+                whose 0/1 counts tie, so phase-1 yields "?" and private-coin
+                runs livelock; the common coin defeats it in O(1) rounds.
+
+Dense path only in this module (N x N mask, N <= dense_path_max_n).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..config import SimConfig, VAL0, VAL1
+from . import rng
+
+
+def full_delivery_mask(alive: jax.Array) -> jax.Array:
+    """delivery == 'all': every live sender reaches every receiver.
+
+    alive: bool [T, N] -> mask bool [T, N_recv, N_send].
+    (Broadcast includes self, matching reference loops i = 0..N-1 at
+    node.ts:72,149,173 — quirk 6.)
+    """
+    T, N = alive.shape
+    return jnp.broadcast_to(alive[:, None, :], (T, N, N))
+
+
+def quorum_delivery_mask(cfg: SimConfig, base_key: jax.Array, r: jax.Array,
+                         phase: int, sent: jax.Array,
+                         alive: jax.Array) -> jax.Array:
+    """Per-receiver top-(N-F) arrival mask for 'uniform'/'biased' schedulers.
+
+    sent: int8 [T, N] values being broadcast this phase (used only by the
+    biased scheduler).  Returns bool [T, N_recv, N_send] selecting, for each
+    receiver, the min(N-F, #alive) live senders with smallest delays.
+    """
+    T, N = alive.shape
+    m = cfg.quorum
+    delays = rng.edge_uniforms(base_key, r, phase, rng.ids(T), rng.ids(N),
+                               rng.ids(N))                   # [T, N, N]
+
+    if cfg.scheduler == "biased" and cfg.adversary_strength != 0.0:
+        # Split-bias: even receivers' 1-carrying edges and odd receivers'
+        # 0-carrying edges are delayed, so the two halves of the network see
+        # opposite majorities.  Bounded adversary: once the quorum N-F forces
+        # overlap with the starved class, messages get through regardless —
+        # use scheduler='adversarial' for the unbounded worst case.
+        rcv = jnp.arange(N, dtype=jnp.int32)[None, :, None]
+        even_recv = (rcv % 2 == 0)                           # [1, N, 1]
+        carries0 = (sent == VAL0)[:, None, :]
+        carries1 = (sent == VAL1)[:, None, :]
+        starved = jnp.where(even_recv, carries1, carries0)
+        delays = delays + cfg.adversary_strength * starved.astype(jnp.float32)
+
+    delays = jnp.where(alive[:, None, :], delays, jnp.inf)
+    # top-(m) smallest delays per receiver row
+    _, idx = jax.lax.top_k(-delays, m)                       # [T, N, m]
+    mask = jnp.zeros((T, N, N), bool)
+    mask = jax.vmap(jax.vmap(lambda row, i: row.at[i].set(True)))(mask, idx)
+    # If fewer than m senders are alive, top_k picked dead (inf-delay) slots;
+    # intersect with alive so those rows tally only live senders (and the
+    # quorum gate in the round kernel stalls them, as the reference would).
+    return mask & alive[:, None, :]

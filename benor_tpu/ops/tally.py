@@ -1,0 +1,110 @@
+"""Vote/proposal tallies — the framework's hot op (SURVEY.md §3.3-3.4).
+
+In the reference one protocol round is O(N^2) localhost HTTP POSTs, each
+re-counting a JS array (node.ts:52-69, 88-98).  Here a round's entire message
+plane is one of two data movements:
+
+  dense:     [T, N_recv, N_send] delivery mask (x) one-hot sent values ->
+             einsum on the MXU; exact, any scheduler; N <= ~10^4.
+  histogram: O(N) global class histogram; 'all' delivery broadcasts it,
+             'quorum' delivery draws per-lane multivariate-hypergeometric
+             counts from it (ops/sampling.py); N up to 10^6+.
+
+Both return per-receiver class counts int32 [T, N, 3] over {0, 1, "?"}.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..config import SimConfig, VAL0, VAL1, VALQ
+from . import rng, sampling, scheduler
+
+
+def class_histogram(sent: jax.Array, alive: jax.Array) -> jax.Array:
+    """Global per-trial class counts of live senders' values -> int32 [T, 3]."""
+    cnt = [jnp.sum((sent == v) & alive, axis=-1, dtype=jnp.int32)
+           for v in (VAL0, VAL1, VALQ)]
+    return jnp.stack(cnt, axis=-1)
+
+
+def dense_counts(mask: jax.Array, sent: jax.Array, alive: jax.Array) -> jax.Array:
+    """Exact per-receiver counts from an explicit delivery mask.
+
+    mask: bool [T, N_recv, N_send]; sent: int8 [T, N]; alive: bool [T, N].
+    One [N, N] @ [N, 3] matmul per trial — MXU-shaped, fp32-exact for
+    N < 2^24.
+    """
+    onehot = jnp.stack(
+        [((sent == v) & alive).astype(jnp.float32) for v in (VAL0, VAL1, VALQ)],
+        axis=-1)                                            # [T, N, 3]
+    counts = jnp.einsum("trs,tsv->trv", mask.astype(jnp.float32), onehot)
+    return counts.astype(jnp.int32)
+
+
+def receiver_counts(cfg: SimConfig, base_key: jax.Array, r: jax.Array,
+                    phase: int, sent: jax.Array, alive: jax.Array) -> jax.Array:
+    """Dispatch: per-receiver tallied class counts int32 [T, N, 3].
+
+    This is the TPU-native replacement for the whole HTTP message plane
+    (SURVEY §5.8): which N-F multiset each receiver tallies, per
+    (trial, receiver), deterministically seeded.
+    """
+    T, N = sent.shape
+
+    # 'all' delivery: every receiver's tally equals the global histogram —
+    # O(T*N), no mask, identical on both paths.
+    if cfg.delivery == "all":
+        hist = class_histogram(sent, alive)                 # [T, 3]
+        return jnp.broadcast_to(hist[:, None, :], (T, N, 3))
+
+    # Worst-case count-controlling adversary: identical on both paths
+    # (scheduler semantics must not flip when path='auto' crosses
+    # dense_path_max_n).
+    if cfg.scheduler == "adversarial":
+        hist = class_histogram(sent, alive)
+        counts = adversarial_counts(hist, cfg.quorum)       # [T, 3]
+        return jnp.broadcast_to(counts[:, None, :], (T, N, 3))
+
+    if cfg.resolved_path == "dense":
+        mask = scheduler.quorum_delivery_mask(cfg, base_key, r, phase,
+                                              sent, alive)
+        return dense_counts(mask, sent, alive)
+
+    # histogram path, uniform scheduler
+    if cfg.scheduler == "biased":
+        raise NotImplementedError(
+            "scheduler='biased' needs per-edge delays (dense path); use "
+            "path='dense' or the count-controlling scheduler='adversarial'")
+    hist = class_histogram(sent, alive)
+    u0 = rng.grid_uniforms(base_key, r, phase, rng.ids(T), rng.ids(N))
+    u1 = rng.grid_uniforms(base_key, r, phase + 16, rng.ids(T), rng.ids(N))
+    return sampling.multivariate_hypergeom_counts(u0, u1, hist, cfg.quorum)
+
+
+def adversarial_counts(hist: jax.Array, m: int) -> jax.Array:
+    """Worst-case count-controlling scheduler: force per-receiver ties.
+
+    The strongest asynchronous adversary doesn't merely *delay* messages —
+    it picks, for every receiver, the N-F multiset whose 0/1 counts tie, so
+    phase-1 tallies yield "?" and phase-2 never accumulates > F votes for any
+    value; undecided nodes fall through to their coins every round.  (A
+    shared common coin defeats exactly this adversary in O(1) expected
+    rounds — the classic Ben-Or vs Rabin contrast, reproducible with
+    ``coin_mode='common'``.)
+
+    hist: int32 [T, 3] global (c0, c1, cq); returns int32 [T, 3] delivered
+    counts summing to m, balance-first, identical for every receiver.
+    """
+    c0, c1, cq = hist[:, 0], hist[:, 1], hist[:, 2]
+    tgt = m // 2
+    h0 = jnp.minimum(c0, tgt)
+    h1 = jnp.minimum(c1, tgt)
+    hq = jnp.minimum(cq, m - h0 - h1)
+    rem = m - h0 - h1 - hq                # forced imbalance, if any
+    extra0 = jnp.minimum(rem, c0 - h0)
+    h0, rem = h0 + extra0, rem - extra0
+    extra1 = jnp.minimum(rem, c1 - h1)
+    h1 = h1 + extra1
+    return jnp.stack([h0, h1, hq], axis=-1)

@@ -1,0 +1,89 @@
+"""Simulation driver: the whole network run as one compiled while-loop.
+
+The reference's "run" is an emergent property of the Node.js event loop —
+rounds race as fast as O(N^2) localhost fetches resolve (SURVEY §3.3-3.4).
+Here the run is a single ``lax.while_loop`` whose body is one Ben-Or round;
+termination is ``all(decided | killed)`` or the round cap.  Decided lanes are
+frozen via masking (quirk 5 handled in models/benor.py).
+
+``k`` observability matches the reference's update points exactly:
+k=0 at init (node.ts:25), k=1 at /start (node.ts:172), k=r+1 after a lane
+completes round r (node.ts:147).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .config import SimConfig
+from .models.benor import all_settled, benor_round
+from .state import FaultSpec, NetState, init_state
+
+
+def start_state(cfg: SimConfig, state: NetState) -> NetState:
+    """The /start transition: live lanes set k=1 (node.ts:167-188)."""
+    k = jnp.where(~state.killed, jnp.int32(1), state.k)
+    return NetState(x=state.x, decided=state.decided, k=k, killed=state.killed)
+
+
+def _run_body(cfg: SimConfig, faults: FaultSpec, base_key: jax.Array, carry):
+    r, state = carry
+    state = benor_round(cfg, state, faults, base_key, r)
+    return (r + 1, state)
+
+
+def _run_cond(cfg: SimConfig, carry):
+    r, state = carry
+    return (r <= cfg.max_rounds) & ~all_settled(state)
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def run_consensus(cfg: SimConfig, state: NetState, faults: FaultSpec,
+                  base_key: jax.Array) -> Tuple[jax.Array, NetState]:
+    """Run from /start to termination or round cap.
+
+    Returns (rounds_executed, final_state).  jit-compiled once per config
+    (SimConfig is static/hashable); the loop is on-device, zero host round
+    trips per round.
+    """
+    state = start_state(cfg, state)
+    carry = (jnp.int32(1), state)
+    r, state = jax.lax.while_loop(
+        functools.partial(_run_cond, cfg),
+        functools.partial(_run_body, cfg, faults, base_key),
+        carry)
+    return r - 1, state
+
+
+def resume_consensus(cfg: SimConfig, state: NetState, faults: FaultSpec,
+                     base_key: jax.Array, from_round: int):
+    """Re-enter the round loop from a checkpointed round index (SURVEY §5.4)."""
+    carry = (jnp.int32(from_round), state)
+    r, state = jax.lax.while_loop(
+        functools.partial(_run_cond, cfg),
+        functools.partial(_run_body, cfg, faults, base_key),
+        carry)
+    return r - 1, state
+
+
+def simulate(cfg: SimConfig, initial_values, faulty_list=None,
+             faults: Optional[FaultSpec] = None, crash_rounds=None):
+    """Convenience one-shot: build state, run, return (rounds, state, faults).
+
+    ``faulty_list`` is the reference's launch-time fault vector
+    (launchNodes.ts:8); ``crash_rounds`` is required for
+    fault_model='crash_at_round'; pass ``faults`` directly for fully
+    per-trial specs.
+    """
+    if faults is None:
+        if faulty_list is None:
+            faulty_list = [False] * cfg.n_nodes
+        faults = FaultSpec.from_faulty_list(cfg, faulty_list, crash_rounds)
+    state = init_state(cfg, initial_values, faults)
+    base_key = jax.random.key(cfg.seed)
+    rounds, final = run_consensus(cfg, state, faults, base_key)
+    return rounds, final, faults

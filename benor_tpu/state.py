@@ -1,0 +1,137 @@
+"""Vectorized node-state store (SURVEY.md N2).
+
+The reference keeps per-node state in one JS closure per Express server
+(``currentState = {killed, x, decided, k}``, src/nodes/node.ts:21-26).  Here
+all N nodes x T Monte-Carlo trials live in structure-of-arrays device tensors:
+
+    x:       int8 [T, N]   protocol value, VAL0 | VAL1 | VALQ
+    decided: bool [T, N]
+    k:       int32[T, N]   round counter as *observed* (k=0 before /start,
+                           k=1 after start, k=r+1 after completing round r —
+                           exactly the reference's update points,
+                           node.ts:25,172,147)
+    killed:  bool [T, N]   true for birth-faulty nodes and after /stop
+
+Faulty-at-birth nodes report all-null observable state in the parity API
+(node.ts:21-26 projects them to null); internally their lanes simply carry
+inert values and a ``killed`` flag.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import SimConfig, VAL0, VAL1, VALQ
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class NetState:
+    """Pytree of all node state. Leading axis T = trials, second axis N = nodes."""
+
+    x: jax.Array        # int8  [T, N]
+    decided: jax.Array  # bool  [T, N]
+    k: jax.Array        # int32 [T, N]
+    killed: jax.Array   # bool  [T, N]
+
+    @property
+    def shape(self):
+        return self.x.shape
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class FaultSpec:
+    """Fault-injection masks (SURVEY.md N5).
+
+    ``faulty`` reproduces the reference's ``faultyList`` (launchNodes.ts:8):
+    under the 'crash' model those lanes are killed at birth with null state.
+    Under 'byzantine' they stay alive but broadcast bit-flipped values.
+    Under 'crash_at_round' lane i dies at the start of round crash_round[i]
+    (crash_round <= 0 means never).
+    """
+
+    faulty: jax.Array       # bool  [T, N]
+    crash_round: jax.Array  # int32 [T, N]
+
+    @classmethod
+    def from_faulty_list(cls, cfg: SimConfig, faulty_list,
+                         crash_rounds=None) -> "FaultSpec":
+        f = np.asarray(faulty_list, dtype=bool)
+        if f.shape != (cfg.n_nodes,):
+            raise ValueError("faultyList length must equal N (launchNodes.ts:10-11)")
+        if int(f.sum()) != cfg.n_faulty:
+            # reference: "faultyList doesnt have F faulties" (launchNodes.ts:12-13)
+            raise ValueError("faultyList doesnt have F faulties")
+        faulty = jnp.broadcast_to(jnp.asarray(f), (cfg.trials, cfg.n_nodes))
+        if cfg.fault_model == "crash_at_round":
+            if crash_rounds is None:
+                raise ValueError(
+                    "fault_model='crash_at_round' requires crash_rounds "
+                    "(int[N], round at which each faulty node dies; <=0 = never)")
+            cr = np.asarray(crash_rounds, dtype=np.int32)
+            if cr.shape != (cfg.n_nodes,):
+                raise ValueError("crash_rounds length must equal N")
+            crash_round = jnp.broadcast_to(jnp.asarray(cr),
+                                           (cfg.trials, cfg.n_nodes))
+        elif crash_rounds is not None:
+            raise ValueError(
+                "crash_rounds only applies to fault_model='crash_at_round'")
+        else:
+            crash_round = jnp.zeros((cfg.trials, cfg.n_nodes), jnp.int32)
+        return cls(faulty=faulty, crash_round=crash_round)
+
+
+def init_state(cfg: SimConfig, initial_values, faults: FaultSpec) -> NetState:
+    """Build the T x N state arrays from per-node initial values.
+
+    Mirrors the reference's per-node init (node.ts:21-26): healthy lanes get
+    {x: initial, decided: False, k: 0}; crash-faulty lanes are killed at birth.
+    ``initial_values`` accepts 0/1/"?" (or VALQ) per node, shape [N] or [T, N].
+    """
+    vals = np.asarray(
+        [VALQ if v == "?" else int(v) for v in np.ravel(initial_values)],
+        dtype=np.int8,
+    ).reshape(np.shape(initial_values))
+    if vals.ndim == 1:
+        if vals.shape != (cfg.n_nodes,):
+            raise ValueError("Arrays don't match")  # launchNodes.ts:10-11
+        vals = np.broadcast_to(vals, (cfg.trials, cfg.n_nodes))
+    elif vals.shape != (cfg.trials, cfg.n_nodes):
+        raise ValueError("initial_values must be [N] or [T, N]")
+
+    killed_at_birth = (
+        faults.faulty if cfg.fault_model == "crash"
+        else jnp.zeros_like(faults.faulty)
+    )
+    return NetState(
+        x=jnp.asarray(vals, jnp.int8),
+        decided=jnp.zeros((cfg.trials, cfg.n_nodes), bool),
+        k=jnp.zeros((cfg.trials, cfg.n_nodes), jnp.int32),
+        killed=killed_at_birth,
+    )
+
+
+def observable_state(cfg: SimConfig, state: NetState, faults: FaultSpec,
+                     node_id: int, trial: int = 0) -> dict:
+    """The reference's ``/getState`` JSON for one node (node.ts:197-199).
+
+    Birth-faulty crash nodes project to all-null (node.ts:21-26); every other
+    node reports its live arrays.  Returns plain Python values.
+    """
+    birth_faulty = bool(np.asarray(faults.faulty)[trial, node_id]) and \
+        cfg.fault_model == "crash"
+    if birth_faulty:
+        return {"killed": True, "x": None, "decided": None, "k": None}
+    x = int(np.asarray(state.x)[trial, node_id])
+    return {
+        "killed": bool(np.asarray(state.killed)[trial, node_id]),
+        "x": "?" if x == VALQ else x,
+        "decided": bool(np.asarray(state.decided)[trial, node_id]),
+        "k": int(np.asarray(state.k)[trial, node_id]),
+    }

@@ -1,0 +1,13 @@
+"""Test environment: force an 8-device virtual CPU mesh BEFORE jax imports.
+
+Benches run on the real TPU chip; tests run on CPU with 8 virtual devices so
+the multi-chip sharding paths (parallel/) are exercised without hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,208 @@
+"""Unit tests of the Ben-Or round kernel and sim loop — pure-function level.
+
+The reference has no unit tests (its only suite is black-box HTTP
+integration, SURVEY.md §4); these pin the kernel's semantics directly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from benor_tpu import (FaultSpec, NetState, SimConfig, VAL0, VAL1, VALQ,
+                       init_state, simulate, start_state)
+from benor_tpu.models.benor import benor_round
+
+
+def _mk(cfg, vals, faulty=None):
+    faults = FaultSpec.from_faulty_list(cfg, faulty or [False] * cfg.n_nodes)
+    return init_state(cfg, vals, faults), faults
+
+
+class TestInit:
+    def test_healthy_init_matches_reference(self):
+        # node.ts:21-26: {killed:false, x:initial, decided:false, k:0}
+        cfg = SimConfig(n_nodes=3, n_faulty=0)
+        state, _ = _mk(cfg, [1, 0, 1])
+        assert np.asarray(state.x).tolist() == [[1, 0, 1]]
+        assert not np.asarray(state.decided).any()
+        assert np.asarray(state.k).tolist() == [[0, 0, 0]]
+        assert not np.asarray(state.killed).any()
+
+    def test_faulty_killed_at_birth(self):
+        cfg = SimConfig(n_nodes=3, n_faulty=1)
+        state, _ = _mk(cfg, [1, 1, 1], [True, False, False])
+        assert np.asarray(state.killed).tolist() == [[True, False, False]]
+
+    def test_faulty_count_validated(self):
+        # launchNodes.ts:12-13: "faultyList doesnt have F faulties"
+        cfg = SimConfig(n_nodes=3, n_faulty=2)
+        with pytest.raises(ValueError, match="faulties"):
+            _mk(cfg, [1, 1, 1], [True, False, False])
+
+    def test_length_validated(self):
+        # launchNodes.ts:10-11: "Arrays don't match"
+        cfg = SimConfig(n_nodes=3, n_faulty=0)
+        with pytest.raises(ValueError):
+            _mk(cfg, [1, 1])
+
+    def test_start_sets_k1_on_live_lanes(self):
+        # node.ts:172: /start sets k=1 (killed lanes untouched)
+        cfg = SimConfig(n_nodes=3, n_faulty=1)
+        state, _ = _mk(cfg, [1, 1, 1], [True, False, False])
+        started = start_state(cfg, state)
+        assert np.asarray(started.k).tolist() == [[0, 1, 1]]
+
+
+class TestSingleRound:
+    def run_one(self, cfg, vals, faulty=None):
+        state, faults = _mk(cfg, vals, faulty)
+        state = start_state(cfg, state)
+        key = jax.random.key(cfg.seed)
+        return benor_round(cfg, state, faults, key, jnp.int32(1))
+
+    def test_unanimous_decides_round_one(self):
+        cfg = SimConfig(n_nodes=5, n_faulty=0)
+        out = self.run_one(cfg, [1] * 5)
+        assert np.asarray(out.decided).all()
+        assert (np.asarray(out.x) == 1).all()
+        # decided in round 1 => k=2 (node.ts:147 increments after deciding)
+        assert (np.asarray(out.k) == 2).all()
+
+    def test_majority_tally_quirk4_quorum_includes_question(self):
+        # Quorum gate counts "?" messages; decide counts only 0/1 (quirk 4).
+        # N=4, F=2, quorum=2. Values [?, ?, ?, ?]: phase1 tie -> "?",
+        # phase2 all vote "?" -> v0=v1=0 -> no decide, coin.
+        cfg = SimConfig(n_nodes=4, n_faulty=2)
+        out = self.run_one(cfg, ["?"] * 4, [True, True, False, False])
+        live = np.asarray(out.decided)[0, 2:]
+        assert not live.any()          # no decision possible
+        xs = np.asarray(out.x)[0, 2:]
+        assert set(xs.tolist()) <= {0, 1}   # coin flipped to a binary value
+
+    def test_tie_gives_question_then_plurality_or_coin(self):
+        # N=2, F=0: values [0, 1] -> phase1 tie -> both propose "?";
+        # phase2 votes are ["?", "?"] -> v0=v1=0 -> coin.
+        cfg = SimConfig(n_nodes=2, n_faulty=0)
+        out = self.run_one(cfg, [0, 1])
+        assert not np.asarray(out.decided).any()
+        assert set(np.asarray(out.x).ravel().tolist()) <= {0, 1}
+
+    def test_decide_requires_count_strictly_above_F(self):
+        # N=10, F=5, live=5: v <= 5 = F can never satisfy count > F.
+        cfg = SimConfig(n_nodes=10, n_faulty=5)
+        out = self.run_one(cfg, [1] * 10, [True] * 5 + [False] * 5)
+        assert not np.asarray(out.decided)[0, 5:].any()
+        # but plurality-adopt keeps x=1 (all 5 votes are 1)
+        assert (np.asarray(out.x)[0, 5:] == 1).all()
+
+    def test_quorum_stall_below_n_minus_f(self):
+        # 2 live senders < quorum N-F = 3: no tally ever fires and state
+        # stays frozen, like reference receivers waiting forever for a 3rd
+        # message.  (More dead lanes than F is unreachable via the launch
+        # validator, so construct the FaultSpec directly.)
+        cfg = SimConfig(n_nodes=4, n_faulty=1)
+        faults = FaultSpec(
+            faulty=jnp.asarray([[True, True, False, False]]),
+            crash_round=jnp.zeros((1, 4), jnp.int32))
+        state = init_state(cfg, [1, 1, 1, 1], faults)
+        state = NetState(x=state.x, decided=state.decided, k=state.k,
+                         killed=state.killed | faults.faulty)
+        state = start_state(cfg, state)
+        out = benor_round(cfg, state, faults, jax.random.key(0), jnp.int32(1))
+        assert not np.asarray(out.decided)[0, 2:].any()
+        assert (np.asarray(out.k)[0, 2:] == 1).all()   # k never advanced
+        assert (np.asarray(out.x)[0, 2:] == 1).all()   # x untouched
+
+    def test_textbook_rule_flips_coin_instead_of_plurality(self):
+        # N=10, F=5, live=5, all-1 votes: reference rule adopts 1;
+        # textbook rule coins (so across many seeds some lanes pick 0).
+        vals = [1] * 10
+        fl = [True] * 5 + [False] * 5
+        seen0 = False
+        for seed in range(8):
+            cfg = SimConfig(n_nodes=10, n_faulty=5, rule="textbook", seed=seed)
+            out = self.run_one(cfg, vals, fl)
+            if (np.asarray(out.x)[0, 5:] == 0).any():
+                seen0 = True
+        assert seen0
+
+
+class TestFullRun:
+    def test_unanimous_agreement(self):
+        # reference :133-175 — all decide 1, k <= 2
+        cfg = SimConfig(n_nodes=5, n_faulty=0, max_rounds=16)
+        r, final, _ = simulate(cfg, [1] * 5)
+        assert np.asarray(final.decided).all()
+        assert (np.asarray(final.x) == 1).all()
+        assert (np.asarray(final.k) <= 2).all()
+
+    def test_unanimous_zero(self):
+        cfg = SimConfig(n_nodes=5, n_faulty=0, max_rounds=16)
+        r, final, _ = simulate(cfg, [0] * 5)
+        assert np.asarray(final.decided).all()
+        assert (np.asarray(final.x) == 0).all()
+
+    def test_simple_majority(self):
+        # reference :179-223 — healthy decide 1, k <= 2
+        cfg = SimConfig(n_nodes=5, n_faulty=1, max_rounds=16)
+        r, final, _ = simulate(cfg, [1, 1, 1, 0, 0],
+                               [False, False, False, False, True])
+        live = np.s_[0, :4]
+        assert np.asarray(final.decided)[live].all()
+        assert (np.asarray(final.x)[live] == 1).all()
+        assert (np.asarray(final.k)[live] <= 2).all()
+
+    def test_fault_tolerance_threshold_agreement(self):
+        # reference :227-286 — N=9, F=4, mixed inputs: all healthy decide
+        # the same value
+        cfg = SimConfig(n_nodes=9, n_faulty=4, max_rounds=32)
+        r, final, _ = simulate(cfg, [0, 0, 1, 1, 1, 0, 0, 1, 1],
+                               [True] * 4 + [False] * 5)
+        d = np.asarray(final.decided)[0, 4:]
+        x = np.asarray(final.x)[0, 4:]
+        assert d.all()
+        assert (x == x[0]).all()
+
+    def test_exceeding_fault_tolerance_livelock(self):
+        # reference :292-345 — N=10, F=5: never decides, k > 10
+        cfg = SimConfig(n_nodes=10, n_faulty=5, max_rounds=15)
+        r, final, _ = simulate(cfg, [0, 0, 1, 1, 1, 0, 0, 1, 1, 0],
+                               [True] * 5 + [False] * 5)
+        live = np.s_[0, 5:]
+        assert not np.asarray(final.decided)[live].any()
+        assert (np.asarray(final.k)[live] > 10).all()
+
+    def test_no_faulty_mixed_decides_one(self):
+        # reference :351-393 — [0,1,0,1,1] with plurality rule -> all decide 1
+        cfg = SimConfig(n_nodes=5, n_faulty=0, max_rounds=16)
+        r, final, _ = simulate(cfg, [0, 1, 0, 1, 1])
+        assert np.asarray(final.decided).all()
+        assert (np.asarray(final.x) == 1).all()
+        assert (np.asarray(final.k) <= 2).all()
+
+    def test_one_node(self):
+        # reference :454-486
+        cfg = SimConfig(n_nodes=1, n_faulty=0, max_rounds=16)
+        r, final, _ = simulate(cfg, [1])
+        assert np.asarray(final.decided).all()
+        assert (np.asarray(final.x) == 1).all()
+
+    def test_agreement_and_validity_invariants_random(self):
+        # Property: agreement (all deciders agree) + validity (decided value
+        # was some node's input) over randomized inputs — reference :399-450
+        rng = np.random.default_rng(7)
+        for trial in range(5):
+            vals = rng.integers(0, 2, size=7).tolist()
+            cfg = SimConfig(n_nodes=7, n_faulty=2, max_rounds=32,
+                            seed=trial)
+            r, final, _ = simulate(
+                cfg, vals, [False, False, True, False, True, False, False])
+            live = [0, 1, 3, 5, 6]
+            d = np.asarray(final.decided)[0, live]
+            x = np.asarray(final.x)[0, live]
+            assert d.all()
+            assert (x == x[0]).all()
+            assert x[0] in (0, 1)
+            if len(set(vals)) == 1:
+                assert x[0] == vals[0]
