@@ -18,6 +18,8 @@ Layout (SURVEY.md §7):
   api.py           launch_network parity facade (N10)
 """
 
+from .api import (get_nodes_state, launch_network, reached_finality,
+                  start_consensus, stop_consensus)
 from .config import BASE_NODE_PORT, SimConfig, VAL0, VAL1, VALQ
 from .state import FaultSpec, NetState, init_state, observable_state
 from .sim import run_consensus, resume_consensus, simulate, start_state
@@ -26,6 +28,8 @@ __all__ = [
     "BASE_NODE_PORT", "SimConfig", "VAL0", "VAL1", "VALQ",
     "FaultSpec", "NetState", "init_state", "observable_state",
     "run_consensus", "resume_consensus", "simulate", "start_state",
+    "launch_network", "start_consensus", "stop_consensus",
+    "get_nodes_state", "reached_finality",
 ]
 
 __version__ = "0.1.0"

@@ -1,0 +1,16 @@
+"""Backends (the N1 backend switch).
+
+'tpu'     — the device-array simulator (backends/tpu.py): the whole network
+            is [trials, N] tensors, one compiled kernel per round.
+'express' — a pure-Python event-loop re-host of the reference's per-node
+            servers (backends/express.py): the semantic oracle, quirks and
+            all, used for differential/parity testing without Node.js.
+
+Both expose the same observable contract (status/start/stop/get_state) and
+pass the identical scenario suite (tests/test_scenarios.py).
+"""
+
+from .express import ExpressNetwork
+from .tpu import TpuNetwork
+
+__all__ = ["ExpressNetwork", "TpuNetwork"]

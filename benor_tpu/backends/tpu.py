@@ -1,0 +1,98 @@
+"""The TPU array-backed network: reference control surface over device arrays.
+
+Implements the reference's observable contract (SURVEY.md N10) — the four
+HTTP routes of src/nodes/node.ts served from [trials, N] tensors:
+
+  /status   -> status(i)        node.ts:33-39
+  /start    -> start()          node.ts:167-188 (+ consensus.ts:3-8 fan-out)
+  /stop     -> stop()           node.ts:191-194 (+ consensus.ts:10-15)
+  /getState -> get_state(i)     node.ts:197-199
+
+``start()`` runs the whole consensus to termination (or the round cap) as
+one compiled while-loop — the poll-until-finality loop of the reference's
+tests (benorconsensus.test.ts:149-160) observes an already-final snapshot.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+from ..config import SimConfig
+from ..sim import run_consensus
+from ..state import FaultSpec, NetState, init_state, observable_state
+
+
+class TpuNetwork:
+    """One simulated network (all trials of it) behind the parity API."""
+
+    def __init__(self, cfg: SimConfig, initial_values, faulty_list,
+                 crash_rounds=None):
+        # Validation order and messages mirror launchNodes.ts:10-13.
+        if len(initial_values) != len(faulty_list) or \
+                cfg.n_nodes != len(initial_values):
+            raise ValueError("Arrays don't match")
+        self.cfg = cfg
+        self.faults = FaultSpec.from_faulty_list(cfg, faulty_list,
+                                                 crash_rounds)
+        self.state: NetState = init_state(cfg, initial_values, self.faults)
+        self._faulty_list = list(faulty_list)
+        self._started = False
+        self.rounds_executed = 0
+
+    # -- /status (node.ts:33-39) ----------------------------------------
+    def status(self, node_id: int, trial: int = 0):
+        """Returns (body, http_code): ("faulty", 500) | ("live", 200)."""
+        killed = bool(np.asarray(self.state.killed)[trial, node_id])
+        return ("faulty", 500) if killed else ("live", 200)
+
+    # -- /start (consensus.ts:3-8 -> node.ts:167-188) --------------------
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        base_key = jax.random.key(self.cfg.seed)
+        rounds, final = run_consensus(self.cfg, self.state, self.faults,
+                                      base_key)
+        self.rounds_executed = int(rounds)
+        self.state = final
+
+    # -- /stop (consensus.ts:10-15 -> node.ts:191-194) -------------------
+    def stop(self) -> None:
+        self.state = NetState(
+            x=self.state.x, decided=self.state.decided, k=self.state.k,
+            killed=jax.numpy.ones_like(self.state.killed))
+
+    # -- /getState (node.ts:197-199) -------------------------------------
+    def get_state(self, node_id: int, trial: int = 0) -> dict:
+        return observable_state(self.cfg, self.state, self.faults,
+                                node_id, trial)
+
+    def get_states(self, trial: int = 0) -> List[dict]:
+        # Bulk path: one device->host transfer per array, then N dict builds
+        # (observable_state per node would re-transfer the [T, N] arrays
+        # 4N times).
+        from ..config import VALQ
+        x = np.asarray(self.state.x)[trial]
+        decided = np.asarray(self.state.decided)[trial]
+        k = np.asarray(self.state.k)[trial]
+        killed = np.asarray(self.state.killed)[trial]
+        birth_faulty = np.asarray(self.faults.faulty)[trial] \
+            if self.cfg.fault_model == "crash" else \
+            np.zeros(self.cfg.n_nodes, bool)
+        out = []
+        for i in range(self.cfg.n_nodes):
+            if birth_faulty[i]:
+                out.append({"killed": True, "x": None, "decided": None,
+                            "k": None})
+            else:
+                xi = int(x[i])
+                out.append({"killed": bool(killed[i]),
+                            "x": "?" if xi == VALQ else xi,
+                            "decided": bool(decided[i]), "k": int(k[i])})
+        return out
+
+    def close(self) -> None:
+        pass

@@ -1,0 +1,199 @@
+"""The reference's integration-test contract, ported scenario-for-scenario.
+
+Source: /root/reference/__test__/tests/benorconsensus.test.ts (SURVEY.md §4
+scenario matrix).  Every scenario runs on BOTH backends — the TPU
+device-array simulator and the express-style event-loop oracle — and must
+produce the same observable verdicts; this is the differential-parity
+harness the reference's grading suite becomes.
+"""
+
+import numpy as np
+import pytest
+
+from benor_tpu.api import (get_nodes_state, launch_network, reached_finality,
+                           start_consensus, stop_consensus)
+
+BACKENDS = ["tpu", "express"]
+
+
+def _launch(faulty, values, backend, **kw):
+    return launch_network(len(faulty), sum(faulty), values, faulty,
+                          backend=backend, **kw)
+
+
+def _run_to_finality(net):
+    """The tests' poll loop (benorconsensus.test.ts:149-160) collapsed:
+    start() returns with the network already settled or at its round cap."""
+    start_consensus(net)
+    return get_nodes_state(net)
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+class TestSetup:
+    """'Project is setup correctly' — status codes (test.ts:45-118)."""
+
+    def test_status_2_healthy_1_faulty(self, backend):
+        net = _launch([True, False, False], [1, 1, 1], backend)
+        for i, faulty in enumerate([True, False, False]):
+            body, code = net.status(i)
+            if faulty:
+                assert (body, code) == ("faulty", 500)
+            else:
+                assert (body, code) == ("live", 200)
+        net.close()
+
+    def test_status_8_healthy_2_faulty(self, backend):
+        faulty = [True, False, False, False, False, True, False, False,
+                  False, False]
+        net = _launch(faulty, [1] * 10, backend)
+        for i, f in enumerate(faulty):
+            body, code = net.status(i)
+            assert (body, code) == (("faulty", 500) if f else ("live", 200))
+        net.close()
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+class TestBenOr:
+    """'Testing Ben-Or implementation' (test.ts:120-492)."""
+
+    def _assert_faulty_null(self, state):
+        # faulty fields are all null (e.g. test.ts:164-167)
+        assert state["decided"] is None
+        assert state["x"] is None
+        assert state["k"] is None
+
+    def test_unanimous_agreement(self, backend):
+        # test.ts:133-175: N=5, F=0, all 1 -> all decide 1, k <= 2
+        faulty = [False] * 5
+        net = _launch(faulty, [1] * 5, backend)
+        states = _run_to_finality(net)
+        assert reached_finality(states)
+        for st in states:
+            assert st["decided"] is True
+            assert st["x"] == 1
+            assert st["k"] <= 2
+        net.close()
+
+    def test_simple_majority(self, backend):
+        # test.ts:179-223: N=5, F=1, vals 1,1,1,0,(0 faulty) -> decide 1, k <= 2
+        faulty = [False, False, False, False, True]
+        net = _launch(faulty, [1, 1, 1, 0, 0], backend)
+        states = _run_to_finality(net)
+        for st, f in zip(states, faulty):
+            if f:
+                self._assert_faulty_null(st)
+            else:
+                assert st["decided"] is True
+                assert st["x"] == 1
+                assert st["k"] <= 2
+        net.close()
+
+    def test_fault_tolerance_threshold(self, backend):
+        # test.ts:227-286: N=9, F=4, mixed -> all healthy decide, same value
+        faulty = [True] * 4 + [False] * 5
+        net = _launch(faulty, [0, 0, 1, 1, 1, 0, 0, 1, 1], backend)
+        states = _run_to_finality(net)
+        consensus = []
+        for st, f in zip(states, faulty):
+            if f:
+                self._assert_faulty_null(st)
+            else:
+                assert st["decided"] is True
+                assert st["k"] is not None
+                assert st["x"] is not None
+                consensus.append(st["x"])
+        assert all(v == consensus[0] for v in consensus)
+        net.close()
+
+    def test_exceeding_fault_tolerance_livelock(self, backend):
+        # test.ts:292-345: N=10, F=5 -> healthy never decide, k > 10
+        faulty = [True] * 5 + [False] * 5
+        net = _launch(faulty, [0, 0, 1, 1, 1, 0, 0, 1, 1, 0], backend,
+                      max_rounds=15)
+        states = _run_to_finality(net)
+        for st, f in zip(states, faulty):
+            if f:
+                self._assert_faulty_null(st)
+            else:
+                assert st["decided"] is not True
+                assert st["k"] > 10
+                assert st["x"] is not None
+        net.close()
+
+    def test_no_faulty_nodes(self, backend):
+        # test.ts:351-393: N=5, F=0, vals 0,1,0,1,1 -> all decide 1, k <= 2
+        faulty = [False] * 5
+        net = _launch(faulty, [0, 1, 0, 1, 1], backend)
+        states = _run_to_finality(net)
+        for st in states:
+            assert st["decided"] is True
+            assert st["x"] == 1
+            assert st["k"] <= 2
+        net.close()
+
+    def test_randomized(self, backend):
+        # test.ts:399-450: N=7, F=2, random bits -> healthy all decide,
+        # identical value
+        rng = np.random.default_rng(42)
+        faulty = [False, False, True, False, True, False, False]
+        values = [int(v) for v in rng.integers(0, 2, size=7)]
+        net = _launch(faulty, values, backend)
+        states = _run_to_finality(net)
+        consensus = []
+        for st, f in zip(states, faulty):
+            if f:
+                self._assert_faulty_null(st)
+            else:
+                assert st["decided"] is True
+                assert st["x"] is not None
+                consensus.append(st["x"])
+        assert all(v == consensus[0] for v in consensus)
+        net.close()
+
+    def test_one_node(self, backend):
+        # test.ts:454-486: N=1 decides its own value (self-broadcast,
+        # quirk 6, makes the quorum of 1 reachable)
+        net = _launch([False], [1], backend)
+        states = _run_to_finality(net)
+        assert len(states) == 1
+        assert states[0]["decided"] is True
+        assert states[0]["x"] == 1
+        net.close()
+
+    def test_stop_consensus_kills_all(self, backend):
+        # consensus.ts:10-15 + node.ts:191-194: /stop flips killed
+        faulty = [False] * 3
+        net = _launch(faulty, [1, 1, 1], backend)
+        start_consensus(net)
+        stop_consensus(net)
+        for i in range(3):
+            assert net.status(i) == ("faulty", 500)
+        # state survives the kill (reference /getState after /stop)
+        st = net.get_state(0)
+        assert st["killed"] is True
+        assert st["x"] is not None
+        net.close()
+
+
+class TestBackendAgreement:
+    """Differential check: both backends reach the same verdict per scenario."""
+
+    @pytest.mark.parametrize("faulty,values", [
+        ([False] * 5, [1] * 5),
+        ([False, False, False, False, True], [1, 1, 1, 0, 0]),
+        ([True] * 4 + [False] * 5, [0, 0, 1, 1, 1, 0, 0, 1, 1]),
+        ([False] * 5, [0, 1, 0, 1, 1]),
+        ([False], [1]),
+    ])
+    def test_same_decision(self, faulty, values):
+        outcomes = {}
+        for backend in BACKENDS:
+            net = _launch(faulty, values, backend)
+            states = _run_to_finality(net)
+            live = [s for s, f in zip(states, faulty) if not f]
+            outcomes[backend] = (
+                all(s["decided"] is True for s in live),
+                {s["x"] for s in live},
+            )
+            net.close()
+        assert outcomes["tpu"] == outcomes["express"]
