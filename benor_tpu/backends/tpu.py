@@ -52,12 +52,18 @@ class TpuNetwork:
     def start(self) -> None:
         if self._started:
             return
-        self._started = True
         base_key = jax.random.key(self.cfg.seed)
-        rounds, final = run_consensus(self.cfg, self.state, self.faults,
-                                      base_key)
+        if self.cfg.mesh_shape is not None:
+            from ..parallel import make_mesh, run_consensus_sharded
+            mesh = make_mesh(*self.cfg.mesh_shape)
+            rounds, final = run_consensus_sharded(
+                self.cfg, self.state, self.faults, base_key, mesh)
+        else:
+            rounds, final = run_consensus(self.cfg, self.state, self.faults,
+                                          base_key)
         self.rounds_executed = int(rounds)
         self.state = final
+        self._started = True
 
     # -- /stop (consensus.ts:10-15 -> node.ts:191-194) -------------------
     def stop(self) -> None:

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 
 from ..config import SimConfig, VAL0, VAL1, VALQ
 from ..ops import rng, tally
+from ..ops.collectives import SINGLE, ShardCtx
 from ..state import FaultSpec, NetState
 
 
@@ -42,10 +43,15 @@ def _sent_values(cfg: SimConfig, x: jax.Array, faults: FaultSpec) -> jax.Array:
 
 
 def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
-                base_key: jax.Array, r: jax.Array) -> NetState:
+                base_key: jax.Array, r: jax.Array,
+                ctx: ShardCtx = SINGLE) -> NetState:
     """Advance every lane by one full Ben-Or round (proposal + vote phase).
 
     ``r`` is the 1-based round index; matches the reference's message ``k``.
+    Under a mesh, ``state``/``faults`` hold this shard's [T_loc, N_loc]
+    blocks and ``ctx`` names the mesh axes; tallies psum over ICI and RNG
+    keys derive from global ids, so results are bit-identical to the
+    single-device run regardless of mesh shape.
     """
     T, N = state.x.shape
     F = cfg.n_faulty
@@ -59,7 +65,8 @@ def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
         killed = killed | crashing
 
     alive = ~killed                                          # senders this round
-    n_alive = jnp.sum(alive, axis=-1, dtype=jnp.int32)       # [T]
+    n_alive = ctx.psum_nodes(
+        jnp.sum(alive, axis=-1, dtype=jnp.int32))            # [T] global
     # Quorum gate: a tally only ever fires if >= N-F messages can arrive
     # (node.ts:52,88). With fewer live senders the whole trial stalls forever,
     # exactly like reference receivers waiting for fetches that never come.
@@ -73,7 +80,7 @@ def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
     # --- phase 1: "proposal phase" (node.ts:46-82) -----------------------
     sent1 = _sent_values(cfg, state.x, faults)
     cnt1 = tally.receiver_counts(cfg, base_key, r, rng.PHASE_PROPOSAL,
-                                 sent1, alive)               # [T, N, 3]
+                                 sent1, alive, ctx)          # [T, N, 3]
     p0, p1 = cnt1[..., 0], cnt1[..., 1]
     # majority -> value, tie -> "?" (node.ts:63-69)
     x1 = jnp.where(p0 > p1, jnp.int8(VAL0),
@@ -87,12 +94,12 @@ def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
     vote_val = jnp.where(frozen, state.x, x1)
     sent2 = _sent_values(cfg, vote_val, faults)
     cnt2 = tally.receiver_counts(cfg, base_key, r, rng.PHASE_VOTE,
-                                 sent2, alive)
+                                 sent2, alive, ctx)
     v0, v1 = cnt2[..., 0], cnt2[..., 1]
 
     decide0 = v0 > F                                         # node.ts:99
     decide1 = v1 > F                                         # node.ts:102
-    coin = rng.coin_flips(base_key, r, rng.ids(T), rng.ids(N),
+    coin = rng.coin_flips(base_key, r, ctx.trial_ids(T), ctx.node_ids(N),
                           common=(cfg.coin_mode == "common"))
     if cfg.rule == "reference":
         # plurality-adopt before coin (node.ts:106-112)
@@ -120,7 +127,11 @@ def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
     return NetState(x=new_x, decided=new_decided, k=new_k, killed=killed)
 
 
-def all_settled(state: NetState) -> jax.Array:
+def all_settled(state: NetState, ctx: ShardCtx = SINGLE) -> jax.Array:
     """True when every lane is decided or dead — the termination predicate
-    replacing the reference's racy global-halt probe (node.ts:119-145)."""
-    return jnp.all(state.decided | state.killed)
+    replacing the reference's racy global-halt probe (node.ts:119-145).
+
+    Under a mesh this is a psum of the per-shard unsettled count, so every
+    shard agrees on termination (the while-loop carry stays replicated)."""
+    unsettled = jnp.sum(~(state.decided | state.killed), dtype=jnp.int32)
+    return ctx.psum_all(unsettled) == 0

@@ -1,0 +1,85 @@
+"""Shard context: the framework's collective-communication abstraction (N7).
+
+The reference's "communication backend" is localhost HTTP fan-out
+(src/nodes/node.ts:72-80; SURVEY §5.8).  The TPU-native equivalent is data
+movement XLA already performs: within a chip the tally is a reduction in HBM;
+across chips it is a ``psum`` of per-shard class histograms over the ICI mesh
+(and over DCN for a second trials axis at pod scale).
+
+``ShardCtx`` names the mesh axes a kernel is running under (inside
+``shard_map``) — or none (single device).  Every op in models/ and ops/ takes
+a ctx and calls these methods instead of raw ``lax`` collectives, so the SAME
+round kernel runs unmodified on one chip or a v4-pod mesh:
+
+  * id offsets: RNG keys derive from *global* (trial, node) ids
+    (ops/rng.py), so a shard folds in ``axis_index * local_size + arange``
+    — never shard-local order.  This makes results bit-identical across
+    mesh shapes (SURVEY §7 hard-part 5).
+  * ``psum_nodes``: local class histogram -> global histogram (the vote
+    tally that replaces the O(N^2) HTTP broadcast).
+  * ``all_gather_nodes``: dense path needs every sender's value on every
+    receiver shard — one tiled all-gather of an int8 [T, N_local] block.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardCtx:
+    """Mesh-axis names for a kernel invocation; hashable (jit-static).
+
+    ``None`` axis => that dimension is not sharded (no collective emitted).
+    The default instance is the single-device context.
+    """
+
+    trial_axis: Optional[str] = None
+    node_axis: Optional[str] = None
+
+    # -- global id vectors (for RNG key derivation) -----------------------
+    def trial_ids(self, t_local: int) -> jax.Array:
+        """Global trial ids owned by this shard -> int32 [t_local]."""
+        base = jnp.int32(0)
+        if self.trial_axis is not None:
+            base = lax.axis_index(self.trial_axis).astype(jnp.int32) * t_local
+        return jnp.arange(t_local, dtype=jnp.int32) + base
+
+    def node_ids(self, n_local: int) -> jax.Array:
+        """Global node ids owned by this shard -> int32 [n_local]."""
+        base = jnp.int32(0)
+        if self.node_axis is not None:
+            base = lax.axis_index(self.node_axis).astype(jnp.int32) * n_local
+        return jnp.arange(n_local, dtype=jnp.int32) + base
+
+    # -- collectives ------------------------------------------------------
+    def psum_nodes(self, x: jax.Array) -> jax.Array:
+        """Sum partial reductions over the node axis (ICI all-reduce)."""
+        if self.node_axis is None:
+            return x
+        return lax.psum(x, self.node_axis)
+
+    def all_gather_nodes(self, x: jax.Array, axis: int = -1) -> jax.Array:
+        """Concatenate node-sharded blocks along ``axis`` on every shard."""
+        if self.node_axis is None:
+            return x
+        if axis < 0:
+            axis = x.ndim + axis
+        return lax.all_gather(x, self.node_axis, axis=axis, tiled=True)
+
+    def psum_all(self, x: jax.Array) -> jax.Array:
+        """Sum over every mesh axis (global scalar reductions)."""
+        axes: Tuple[str, ...] = tuple(
+            a for a in (self.trial_axis, self.node_axis) if a is not None)
+        if not axes:
+            return x
+        return lax.psum(x, axes)
+
+
+#: The single-device (no-mesh) context used by default everywhere.
+SINGLE = ShardCtx()

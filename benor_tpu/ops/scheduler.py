@@ -40,18 +40,27 @@ def full_delivery_mask(alive: jax.Array) -> jax.Array:
 
 
 def quorum_delivery_mask(cfg: SimConfig, base_key: jax.Array, r: jax.Array,
-                         phase: int, sent: jax.Array,
-                         alive: jax.Array) -> jax.Array:
+                         phase: int, sent: jax.Array, alive: jax.Array,
+                         trial_ids=None, recv_ids=None) -> jax.Array:
     """Per-receiver top-(N-F) arrival mask for 'uniform'/'biased' schedulers.
 
-    sent: int8 [T, N] values being broadcast this phase (used only by the
-    biased scheduler).  Returns bool [T, N_recv, N_send] selecting, for each
-    receiver, the min(N-F, #alive) live senders with smallest delays.
+    sent: int8 [T, N_send] GLOBAL sender values this phase (used only by the
+    biased scheduler); alive: bool [T, N_send].  ``trial_ids``/``recv_ids``
+    are the global ids of this shard's trials/receivers (defaults: unsharded
+    0..T-1 / 0..N-1).  Returns bool [T, N_recv, N_send] selecting, for each
+    local receiver, the min(N-F, #alive) live senders with smallest delays —
+    delays keyed on global (trial, receiver, sender) ids, so the mask is
+    bit-identical across mesh shapes.
     """
     T, N = alive.shape
+    if trial_ids is None:
+        trial_ids = rng.ids(T)
+    if recv_ids is None:
+        recv_ids = rng.ids(N)
+    n_recv = recv_ids.shape[0]
     m = cfg.quorum
-    delays = rng.edge_uniforms(base_key, r, phase, rng.ids(T), rng.ids(N),
-                               rng.ids(N))                   # [T, N, N]
+    delays = rng.edge_uniforms(base_key, r, phase, trial_ids, recv_ids,
+                               rng.ids(N))                   # [T, n_recv, N]
 
     if cfg.scheduler == "biased" and cfg.adversary_strength != 0.0:
         # Split-bias: even receivers' 1-carrying edges and odd receivers'
@@ -59,8 +68,7 @@ def quorum_delivery_mask(cfg: SimConfig, base_key: jax.Array, r: jax.Array,
         # opposite majorities.  Bounded adversary: once the quorum N-F forces
         # overlap with the starved class, messages get through regardless —
         # use scheduler='adversarial' for the unbounded worst case.
-        rcv = jnp.arange(N, dtype=jnp.int32)[None, :, None]
-        even_recv = (rcv % 2 == 0)                           # [1, N, 1]
+        even_recv = (recv_ids % 2 == 0)[None, :, None]       # [1, n_recv, 1]
         carries0 = (sent == VAL0)[:, None, :]
         carries1 = (sent == VAL1)[:, None, :]
         starved = jnp.where(even_recv, carries1, carries0)
@@ -68,8 +76,8 @@ def quorum_delivery_mask(cfg: SimConfig, base_key: jax.Array, r: jax.Array,
 
     delays = jnp.where(alive[:, None, :], delays, jnp.inf)
     # top-(m) smallest delays per receiver row
-    _, idx = jax.lax.top_k(-delays, m)                       # [T, N, m]
-    mask = jnp.zeros((T, N, N), bool)
+    _, idx = jax.lax.top_k(-delays, m)                       # [T, n_recv, m]
+    mask = jnp.zeros((T, n_recv, N), bool)
     mask = jax.vmap(jax.vmap(lambda row, i: row.at[i].set(True)))(mask, idx)
     # If fewer than m senders are alive, top_k picked dead (inf-delay) slots;
     # intersect with alive so those rows tally only live senders (and the

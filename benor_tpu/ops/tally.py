@@ -20,13 +20,20 @@ import jax.numpy as jnp
 
 from ..config import SimConfig, VAL0, VAL1, VALQ
 from . import rng, sampling, scheduler
+from .collectives import SINGLE, ShardCtx
 
 
-def class_histogram(sent: jax.Array, alive: jax.Array) -> jax.Array:
-    """Global per-trial class counts of live senders' values -> int32 [T, 3]."""
+def class_histogram(sent: jax.Array, alive: jax.Array,
+                    ctx: ShardCtx = SINGLE) -> jax.Array:
+    """Global per-trial class counts of live senders' values -> int32 [T, 3].
+
+    Under a node-sharded mesh this is a local partial histogram + one psum
+    over ICI — the entire replacement for the reference's O(N^2) HTTP
+    message plane (SURVEY §5.8).
+    """
     cnt = [jnp.sum((sent == v) & alive, axis=-1, dtype=jnp.int32)
            for v in (VAL0, VAL1, VALQ)]
-    return jnp.stack(cnt, axis=-1)
+    return ctx.psum_nodes(jnp.stack(cnt, axis=-1))
 
 
 def dense_counts(mask: jax.Array, sent: jax.Array, alive: jax.Array) -> jax.Array:
@@ -44,42 +51,52 @@ def dense_counts(mask: jax.Array, sent: jax.Array, alive: jax.Array) -> jax.Arra
 
 
 def receiver_counts(cfg: SimConfig, base_key: jax.Array, r: jax.Array,
-                    phase: int, sent: jax.Array, alive: jax.Array) -> jax.Array:
+                    phase: int, sent: jax.Array, alive: jax.Array,
+                    ctx: ShardCtx = SINGLE) -> jax.Array:
     """Dispatch: per-receiver tallied class counts int32 [T, N, 3].
 
     This is the TPU-native replacement for the whole HTTP message plane
     (SURVEY §5.8): which N-F multiset each receiver tallies, per
-    (trial, receiver), deterministically seeded.
+    (trial, receiver), deterministically seeded.  ``sent``/``alive`` are this
+    shard's local [T_loc, N_loc] blocks; returned counts are per local
+    receiver but tallied over the GLOBAL sender population.
     """
     T, N = sent.shape
+    trial_ids = ctx.trial_ids(T)
+    node_ids = ctx.node_ids(N)
 
     # 'all' delivery: every receiver's tally equals the global histogram —
     # O(T*N), no mask, identical on both paths.
     if cfg.delivery == "all":
-        hist = class_histogram(sent, alive)                 # [T, 3]
+        hist = class_histogram(sent, alive, ctx)            # [T, 3]
         return jnp.broadcast_to(hist[:, None, :], (T, N, 3))
 
     # Worst-case count-controlling adversary: identical on both paths
     # (scheduler semantics must not flip when path='auto' crosses
     # dense_path_max_n).
     if cfg.scheduler == "adversarial":
-        hist = class_histogram(sent, alive)
+        hist = class_histogram(sent, alive, ctx)
         counts = adversarial_counts(hist, cfg.quorum)       # [T, 3]
         return jnp.broadcast_to(counts[:, None, :], (T, N, 3))
 
     if cfg.resolved_path == "dense":
+        # Dense path on a node-sharded mesh: receivers stay local, the
+        # sender axis is all-gathered (one tiled int8/bool gather per phase).
+        sent_g = ctx.all_gather_nodes(sent)                 # [T, N_glob]
+        alive_g = ctx.all_gather_nodes(alive)
         mask = scheduler.quorum_delivery_mask(cfg, base_key, r, phase,
-                                              sent, alive)
-        return dense_counts(mask, sent, alive)
+                                              sent_g, alive_g,
+                                              trial_ids, node_ids)
+        return dense_counts(mask, sent_g, alive_g)
 
     # histogram path, uniform scheduler
     if cfg.scheduler == "biased":
         raise NotImplementedError(
             "scheduler='biased' needs per-edge delays (dense path); use "
             "path='dense' or the count-controlling scheduler='adversarial'")
-    hist = class_histogram(sent, alive)
-    u0 = rng.grid_uniforms(base_key, r, phase, rng.ids(T), rng.ids(N))
-    u1 = rng.grid_uniforms(base_key, r, phase + 16, rng.ids(T), rng.ids(N))
+    hist = class_histogram(sent, alive, ctx)
+    u0 = rng.grid_uniforms(base_key, r, phase, trial_ids, node_ids)
+    u1 = rng.grid_uniforms(base_key, r, phase + 16, trial_ids, node_ids)
     return sampling.multivariate_hypergeom_counts(u0, u1, hist, cfg.quorum)
 
 

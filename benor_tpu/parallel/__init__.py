@@ -1,1 +1,10 @@
 """Multi-chip distribution: mesh construction and shard_map'd round kernels."""
+
+from .mesh import (AXIS_NODES, AXIS_TRIALS, STATE_SPEC, make_mesh,
+                   state_sharding)
+from .sharded import MESH_CTX, run_consensus_sharded, shard_inputs
+
+__all__ = [
+    "AXIS_NODES", "AXIS_TRIALS", "STATE_SPEC", "make_mesh", "state_sharding",
+    "MESH_CTX", "run_consensus_sharded", "shard_inputs",
+]

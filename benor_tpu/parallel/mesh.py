@@ -1,0 +1,59 @@
+"""Device-mesh construction for multi-chip runs (SURVEY.md N7).
+
+The mesh has two logical axes:
+
+  'trials' — Monte-Carlo batch data-parallelism.  Trials never communicate;
+             at pod scale this axis maps onto DCN (cross-host) because its
+             only collective is the scalar termination psum.
+  'nodes'  — the simulated-node axis.  Its per-round collective is the
+             3-class histogram psum (and, on the dense path, one int8
+             all-gather), so this axis should ride ICI.
+
+On a v4-8 the natural layout is ``make_mesh(trial_shards=1, node_shards=8)``
+for giant-N runs, or ``(8, 1)`` for many-trials sweeps at moderate N.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_TRIALS = "trials"
+AXIS_NODES = "nodes"
+
+#: PartitionSpec of every [T, N] state/fault leaf.
+STATE_SPEC = P(AXIS_TRIALS, AXIS_NODES)
+
+
+def make_mesh(trial_shards: int = 1, node_shards: Optional[int] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a ('trials', 'nodes') mesh over ``trial_shards * node_shards``
+    devices (default: node_shards = all available / trial_shards)."""
+    if devices is None:
+        devices = jax.devices()
+    if node_shards is None:
+        node_shards = len(devices) // trial_shards
+    n = trial_shards * node_shards
+    if n > len(devices):
+        raise ValueError(
+            f"mesh ({trial_shards}x{node_shards}) needs {n} devices, "
+            f"have {len(devices)}")
+    grid = np.asarray(devices[:n]).reshape(trial_shards, node_shards)
+    return Mesh(grid, (AXIS_TRIALS, AXIS_NODES))
+
+
+def state_sharding(mesh: Mesh) -> NamedSharding:
+    """NamedSharding that places [T, N] leaves block-wise on the mesh."""
+    return NamedSharding(mesh, STATE_SPEC)
+
+
+def check_divisible(cfg_trials: int, cfg_nodes: int, mesh: Mesh) -> None:
+    ts = mesh.shape[AXIS_TRIALS]
+    ns = mesh.shape[AXIS_NODES]
+    if cfg_trials % ts or cfg_nodes % ns:
+        raise ValueError(
+            f"mesh shape ({ts}, {ns}) must evenly divide trials="
+            f"{cfg_trials} / nodes={cfg_nodes}; pad T or N to a multiple")

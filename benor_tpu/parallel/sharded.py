@@ -1,0 +1,100 @@
+"""shard_map'd consensus runner — the multi-chip round loop (SURVEY.md N7).
+
+The single-device run (sim.py) and this runner share the SAME round kernel
+(models/benor.py): the kernel takes a ``ShardCtx`` naming the mesh axes and
+performs its tallies via ``psum`` over ICI instead of a local reduction.
+Because every random draw is keyed on *global* (trial, node, round) ids
+(ops/rng.py), the sharded run is bit-identical to the single-device run for
+any mesh shape — verified by tests/test_parallel.py.
+
+Per round and node-shard the communication is:
+  histogram path:  one psum of an int32 [T_loc, 3] histogram per phase
+                   (+ one [T_loc] alive-count psum, one scalar termination
+                   psum) — O(1) bytes per node, pure ICI latency.
+  dense path:      one tiled all-gather of int8 [T_loc, N_loc] sent values
+                   and bool alive per phase.
+
+The whole run stays inside one jitted while_loop: zero host round-trips.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..config import SimConfig
+from ..models.benor import all_settled, benor_round
+from ..ops.collectives import ShardCtx
+from ..sim import start_state
+from ..state import FaultSpec, NetState
+from . import mesh as meshlib
+
+#: ShardCtx used by every kernel invocation under the ('trials','nodes') mesh.
+MESH_CTX = ShardCtx(trial_axis=meshlib.AXIS_TRIALS,
+                    node_axis=meshlib.AXIS_NODES)
+
+
+def _local_run(cfg: SimConfig, state: NetState, faults: FaultSpec,
+               base_key: jax.Array) -> Tuple[jax.Array, NetState]:
+    """Per-shard body: full /start -> termination loop on local blocks.
+
+    The loop carries a replicated ``settled`` flag computed via psum so all
+    shards take identical trip counts (a shard-local predicate would
+    deadlock the collectives inside the body).
+    """
+    ctx = MESH_CTX
+    state = start_state(cfg, state)
+
+    def body(carry):
+        r, st, _ = carry
+        st = benor_round(cfg, st, faults, base_key, r, ctx)
+        return (r + 1, st, all_settled(st, ctx))
+
+    def cond(carry):
+        r, _, settled = carry
+        return (r <= cfg.max_rounds) & ~settled
+
+    r, state, _ = jax.lax.while_loop(
+        cond, body, (jnp.int32(1), state, all_settled(state, ctx)))
+    return r - 1, state
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled(cfg: SimConfig, mesh: Mesh):
+    sspec = meshlib.STATE_SPEC
+    fn = shard_map(
+        functools.partial(_local_run, cfg),
+        mesh=mesh,
+        in_specs=(sspec, sspec, P()),
+        out_specs=(P(), sspec),
+        check_vma=False,  # while_loop results can't be proven replicated
+    )
+    return jax.jit(fn)
+
+
+def shard_inputs(state: NetState, faults: FaultSpec, mesh: Mesh):
+    """Place state/fault leaves block-wise on the mesh (one transfer each)."""
+    sh = meshlib.state_sharding(mesh)
+    put = lambda a: jax.device_put(a, sh)
+    state = NetState(x=put(state.x), decided=put(state.decided),
+                     k=put(state.k), killed=put(state.killed))
+    faults = FaultSpec(faulty=put(faults.faulty),
+                       crash_round=put(faults.crash_round))
+    return state, faults
+
+
+def run_consensus_sharded(cfg: SimConfig, state: NetState, faults: FaultSpec,
+                          base_key: jax.Array,
+                          mesh: Mesh) -> Tuple[jax.Array, NetState]:
+    """Run /start -> termination over a ('trials','nodes') device mesh.
+
+    Same contract as sim.run_consensus; results are bit-identical to it.
+    """
+    meshlib.check_divisible(cfg.trials, cfg.n_nodes, mesh)
+    state, faults = shard_inputs(state, faults, mesh)
+    return _compiled(cfg, mesh)(state, faults, base_key)

@@ -11,3 +11,9 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The axon TPU plugin overrides JAX_PLATFORMS at import; the config update
+# below wins regardless, so tests really run on the 8-device virtual CPU.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

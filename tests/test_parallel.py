@@ -1,0 +1,97 @@
+"""Multi-chip sharding tests (SURVEY.md N7, §7 stage 6 + hard-part 5).
+
+Runs on the 8-device virtual CPU mesh forced by conftest.py.  The core
+contract: the shard_map'd runner is BIT-IDENTICAL to the single-device run
+for every mesh shape, every compute path, every scheduler — because RNG keys
+derive from global (trial, node, round) ids, never shard-local order.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from benor_tpu.config import SimConfig
+from benor_tpu.parallel import make_mesh, run_consensus_sharded
+from benor_tpu.sim import run_consensus
+from benor_tpu.state import FaultSpec, init_state
+
+N, F, T = 16, 4, 8
+FAULTY = [True] * F + [False] * (N - F)
+VALS = [i % 2 for i in range(N)]
+MESH_SHAPES = [(1, 8), (2, 4), (4, 2), (8, 1), (1, 1), (2, 2)]
+
+
+def _run_pair(cfg, mesh_shape):
+    faults = FaultSpec.from_faulty_list(cfg, FAULTY)
+    state = init_state(cfg, VALS, faults)
+    key = jax.random.key(cfg.seed)
+    r1, s1 = run_consensus(cfg, state, faults, key)
+    mesh = make_mesh(*mesh_shape)
+    r2, s2 = run_consensus_sharded(cfg, state, faults, key, mesh)
+    return (r1, s1), (r2, s2)
+
+
+def _assert_identical(a, b):
+    (r1, s1), (r2, s2) = a, b
+    assert int(r1) == int(r2)
+    np.testing.assert_array_equal(np.asarray(s1.x), np.asarray(s2.x))
+    np.testing.assert_array_equal(np.asarray(s1.decided),
+                                  np.asarray(s2.decided))
+    np.testing.assert_array_equal(np.asarray(s1.k), np.asarray(s2.k))
+    np.testing.assert_array_equal(np.asarray(s1.killed), np.asarray(s2.killed))
+
+
+@pytest.mark.parametrize("mesh_shape", MESH_SHAPES)
+@pytest.mark.parametrize("path", ["dense", "histogram"])
+def test_sharded_bit_identical_quorum_uniform(mesh_shape, path):
+    cfg = SimConfig(n_nodes=N, n_faulty=F, trials=T, delivery="quorum",
+                    scheduler="uniform", path=path, seed=7)
+    a, b = _run_pair(cfg, mesh_shape)
+    _assert_identical(a, b)
+
+
+@pytest.mark.parametrize("mesh_shape", [(2, 4), (8, 1)])
+def test_sharded_bit_identical_all_delivery(mesh_shape):
+    cfg = SimConfig(n_nodes=N, n_faulty=F, trials=T, delivery="all", seed=1)
+    a, b = _run_pair(cfg, mesh_shape)
+    _assert_identical(a, b)
+
+
+@pytest.mark.parametrize("mesh_shape", [(1, 8), (4, 2)])
+def test_sharded_bit_identical_common_coin_adversarial(mesh_shape):
+    # The adversarial scheduler forces livelock under private coins; the
+    # common coin must still converge identically on every mesh shape.
+    cfg = SimConfig(n_nodes=N, n_faulty=F, trials=T, delivery="quorum",
+                    scheduler="adversarial", coin_mode="common", seed=5)
+    a, b = _run_pair(cfg, mesh_shape)
+    _assert_identical(a, b)
+
+
+@pytest.mark.parametrize("mesh_shape", [(2, 4)])
+def test_sharded_bit_identical_byzantine(mesh_shape):
+    cfg = SimConfig(n_nodes=N, n_faulty=F, trials=T, delivery="quorum",
+                    scheduler="uniform", fault_model="byzantine", seed=11)
+    a, b = _run_pair(cfg, mesh_shape)
+    _assert_identical(a, b)
+
+
+def test_mesh_divisibility_validated():
+    cfg = SimConfig(n_nodes=N, n_faulty=F, trials=4, delivery="all")
+    faults = FaultSpec.from_faulty_list(cfg, FAULTY)
+    state = init_state(cfg, VALS, faults)
+    with pytest.raises(ValueError, match="evenly divide"):
+        run_consensus_sharded(cfg, state, faults, jax.random.key(0),
+                              make_mesh(8, 1))
+
+
+def test_backend_mesh_shape_switch():
+    """TpuNetwork honors cfg.mesh_shape end-to-end via the parity API."""
+    from benor_tpu.api import launch_network, start_consensus
+
+    net_single = launch_network(N, F, VALS, FAULTY, delivery="quorum",
+                                trials=T, seed=7)
+    net_mesh = launch_network(N, F, VALS, FAULTY, delivery="quorum",
+                              trials=T, seed=7, mesh_shape=(2, 4))
+    start_consensus(net_single)
+    start_consensus(net_mesh)
+    assert net_single.get_states() == net_mesh.get_states()
