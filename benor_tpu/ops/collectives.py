@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from . import rng
+
 
 @dataclasses.dataclass(frozen=True)
 class ShardCtx:
@@ -48,14 +50,14 @@ class ShardCtx:
         base = jnp.int32(0)
         if self.trial_axis is not None:
             base = lax.axis_index(self.trial_axis).astype(jnp.int32) * t_local
-        return jnp.arange(t_local, dtype=jnp.int32) + base
+        return rng.ids(t_local, base)
 
     def node_ids(self, n_local: int) -> jax.Array:
         """Global node ids owned by this shard -> int32 [n_local]."""
         base = jnp.int32(0)
         if self.node_axis is not None:
             base = lax.axis_index(self.node_axis).astype(jnp.int32) * n_local
-        return jnp.arange(n_local, dtype=jnp.int32) + base
+        return rng.ids(n_local, base)
 
     # -- collectives ------------------------------------------------------
     def psum_nodes(self, x: jax.Array) -> jax.Array:

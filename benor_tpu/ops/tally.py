@@ -23,6 +23,15 @@ from . import rng, sampling, scheduler
 from .collectives import SINGLE, ShardCtx
 
 
+def dense_gather_needed(cfg: SimConfig) -> bool:
+    """True iff receiver_counts will take the dense masked path (and thus
+    gather sender arrays).  Callers use this to prefetch the round-constant
+    ``alive`` gather once for both phases — keep in sync with the dispatch
+    order in receiver_counts below."""
+    return (cfg.delivery == "quorum" and cfg.scheduler != "adversarial"
+            and cfg.resolved_path == "dense")
+
+
 def class_histogram(sent: jax.Array, alive: jax.Array,
                     ctx: ShardCtx = SINGLE) -> jax.Array:
     """Global per-trial class counts of live senders' values -> int32 [T, 3].
@@ -52,7 +61,8 @@ def dense_counts(mask: jax.Array, sent: jax.Array, alive: jax.Array) -> jax.Arra
 
 def receiver_counts(cfg: SimConfig, base_key: jax.Array, r: jax.Array,
                     phase: int, sent: jax.Array, alive: jax.Array,
-                    ctx: ShardCtx = SINGLE) -> jax.Array:
+                    ctx: ShardCtx = SINGLE,
+                    alive_g: jax.Array | None = None) -> jax.Array:
     """Dispatch: per-receiver tallied class counts int32 [T, N, 3].
 
     This is the TPU-native replacement for the whole HTTP message plane
@@ -81,9 +91,11 @@ def receiver_counts(cfg: SimConfig, base_key: jax.Array, r: jax.Array,
 
     if cfg.resolved_path == "dense":
         # Dense path on a node-sharded mesh: receivers stay local, the
-        # sender axis is all-gathered (one tiled int8/bool gather per phase).
+        # sender axis is all-gathered. ``alive`` doesn't change within a
+        # round, so callers gather it once and pass it for both phases.
         sent_g = ctx.all_gather_nodes(sent)                 # [T, N_glob]
-        alive_g = ctx.all_gather_nodes(alive)
+        if alive_g is None:
+            alive_g = ctx.all_gather_nodes(alive)
         mask = scheduler.quorum_delivery_mask(cfg, base_key, r, phase,
                                               sent_g, alive_g,
                                               trial_ids, node_ids)

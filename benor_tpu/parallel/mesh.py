@@ -34,8 +34,14 @@ def make_mesh(trial_shards: int = 1, node_shards: Optional[int] = None,
     devices (default: node_shards = all available / trial_shards)."""
     if devices is None:
         devices = jax.devices()
+    if trial_shards < 1:
+        raise ValueError(f"trial_shards must be >= 1, got {trial_shards}")
     if node_shards is None:
         node_shards = len(devices) // trial_shards
+    if node_shards < 1:
+        raise ValueError(
+            f"node_shards must be >= 1 (trial_shards={trial_shards} over "
+            f"{len(devices)} devices leaves none for the node axis)")
     n = trial_shards * node_shards
     if n > len(devices):
         raise ValueError(

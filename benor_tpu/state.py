@@ -94,10 +94,20 @@ def init_state(cfg: SimConfig, initial_values, faults: FaultSpec) -> NetState:
     {x: initial, decided: False, k: 0}; crash-faulty lanes are killed at birth.
     ``initial_values`` accepts 0/1/"?" (or VALQ) per node, shape [N] or [T, N].
     """
-    vals = np.asarray(
-        [VALQ if v == "?" else int(v) for v in np.ravel(initial_values)],
-        dtype=np.int8,
-    ).reshape(np.shape(initial_values))
+    arr = np.asarray(initial_values)
+    if arr.dtype.kind in "iub":  # already numeric: vectorized fast path
+        if not np.isin(arr, (VAL0, VAL1, VALQ)).all():  # pre-cast: no wrap
+            raise ValueError(
+                "initial_values must be 0, 1 or '?' (reference src/types.ts:8)")
+        vals = arr.astype(np.int8)
+    else:  # mixed 0/1/"?" python lists (the reference's Value domain)
+        vals = np.asarray(
+            [VALQ if v == "?" else int(v) for v in np.ravel(arr)],
+            dtype=np.int8,
+        ).reshape(arr.shape)
+        if not np.isin(vals, (VAL0, VAL1, VALQ)).all():
+            raise ValueError(
+                "initial_values must be 0, 1 or '?' (reference src/types.ts:8)")
     if vals.ndim == 1:
         if vals.shape != (cfg.n_nodes,):
             raise ValueError("Arrays don't match")  # launchNodes.ts:10-11

@@ -1,0 +1,84 @@
+"""Checkpoint/resume round-trip (SURVEY.md §5.4).
+
+The key property: interrupt-at-round-r + resume is BIT-IDENTICAL to an
+uninterrupted run, because randomness is keyed on (seed, round, phase,
+trial, node) and never on loop history (ops/rng.py).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from benor_tpu.config import SimConfig
+from benor_tpu.sim import run_consensus
+from benor_tpu.state import FaultSpec, init_state
+from benor_tpu.utils.checkpoint import (load_checkpoint, resume_from,
+                                        save_checkpoint)
+
+
+def _setup(**overrides):
+    n, f = 120, 40
+    cfg = SimConfig(n_nodes=n, n_faulty=f, trials=32, max_rounds=48,
+                    delivery="quorum", scheduler="uniform", path="dense",
+                    seed=7, **overrides)
+    faulty = [True] * f + [False] * (n - f)
+    vals = [1] * f + [1] * 40 + [0] * 40  # balanced healthy inputs
+    faults = FaultSpec.from_faulty_list(cfg, faulty)
+    state = init_state(cfg, vals, faults)
+    return cfg, state, faults
+
+
+def test_resume_bit_identical(tmp_path):
+    cfg, state, faults = _setup()
+    base_key = jax.random.key(cfg.seed)
+
+    # uninterrupted run
+    rounds_full, final_full = run_consensus(cfg, state, faults, base_key)
+    assert int(rounds_full) >= 3, "config must take several rounds"
+
+    # capped run -> checkpoint -> resume with the full config
+    cfg_cap = cfg.replace(max_rounds=2)
+    rounds_cap, mid = run_consensus(cfg_cap, state, faults, base_key)
+    assert int(rounds_cap) == 2
+    path = str(tmp_path / "ckpt.npz")
+    save_checkpoint(path, cfg, mid, faults, next_round=int(rounds_cap) + 1)
+
+    rounds_res, final_res, _ = resume_from(path)
+    assert int(rounds_res) == int(rounds_full)
+    np.testing.assert_array_equal(np.asarray(final_res.x),
+                                  np.asarray(final_full.x))
+    np.testing.assert_array_equal(np.asarray(final_res.decided),
+                                  np.asarray(final_full.decided))
+    np.testing.assert_array_equal(np.asarray(final_res.k),
+                                  np.asarray(final_full.k))
+    np.testing.assert_array_equal(np.asarray(final_res.killed),
+                                  np.asarray(final_full.killed))
+
+
+def test_load_round_trips_config_and_arrays(tmp_path):
+    cfg, state, faults = _setup(fault_model="crash", coin_mode="common")
+    path = str(tmp_path / "ckpt.npz")
+    save_checkpoint(path, cfg, state, faults, next_round=1)
+    cfg2, state2, faults2, nr = load_checkpoint(path)
+    assert cfg2 == cfg
+    assert nr == 1
+    np.testing.assert_array_equal(np.asarray(state2.x), np.asarray(state.x))
+    np.testing.assert_array_equal(np.asarray(faults2.faulty),
+                                  np.asarray(faults.faulty))
+    assert state2.x.dtype == state.x.dtype
+    assert state2.k.dtype == state.k.dtype
+
+
+def test_version_gate(tmp_path):
+    cfg, state, faults = _setup()
+    path = str(tmp_path / "ckpt.npz")
+    save_checkpoint(path, cfg, state, faults, next_round=1)
+    import numpy as _np
+    with _np.load(path) as z:
+        data = {k: z[k] for k in z.files}
+    data["version"] = _np.int32(99)
+    with open(path, "wb") as fh:
+        _np.savez(fh, **data)
+    with pytest.raises(ValueError, match="version"):
+        load_checkpoint(path)
