@@ -39,6 +39,7 @@ def main() -> None:
     from benor_tpu.config import SimConfig
     from benor_tpu.sim import run_consensus
     from benor_tpu.state import FaultSpec, init_state
+    from benor_tpu.sweep import random_inputs, summarize_final
 
     n = int(os.environ.get("BENCH_N", 1_000_000))
     trials = int(os.environ.get("BENCH_TRIALS", 32))
@@ -52,8 +53,7 @@ def main() -> None:
     log(f"bench: N={n} trials={trials} f_fracs={fracs} on {dev.platform} "
         f"({dev.device_kind})")
 
-    rng = np.random.default_rng(seed)
-    init_vals = rng.integers(0, 2, size=(trials, n), dtype=np.int8)
+    init_vals = random_inputs(seed, trials, n)
 
     configs = []
     for frac in fracs:
@@ -80,18 +80,6 @@ def main() -> None:
     compile_s = time.perf_counter() - t0
     log(f"bench: warm-up (compile+run) {compile_s:.1f}s")
 
-    import jax.numpy as jnp
-
-    @jax.jit
-    def summarize(final, healthy):
-        """On-device summary -> 3 scalars (the tunnel makes bulk [T, N]
-        device->host transfers cost seconds; fetch only scalars)."""
-        hd = final.decided & healthy
-        n_h = jnp.maximum(jnp.sum(healthy), 1)
-        return (jnp.sum(hd) / n_h,
-                jnp.sum(final.k * hd) / jnp.maximum(jnp.sum(hd), 1),
-                jnp.sum(hd & (final.x == 1)) / jnp.maximum(jnp.sum(hd), 1))
-
     # Timed sweep: the north-star workload end-to-end, repeated BENCH_REPS
     # times. NOTE: block_until_ready does not actually wait under the axon
     # tunnel runtime — fetching the scalar `rounds` output is what forces
@@ -106,7 +94,8 @@ def main() -> None:
     elapsed = (time.perf_counter() - t0) / reps
 
     for frac, cfg, rounds, final, faults in curve:
-        dec_frac, mean_k, ones_frac = summarize(final, ~faults.faulty)
+        dec_frac, mean_k, ones_frac, _ = summarize_final(
+            final, faults.faulty, cfg.max_rounds)
         log(f"  f={frac:.2f}: rounds_executed={rounds} "
             f"decided={float(dec_frac):.3f} mean_k={float(mean_k):.2f} "
             f"x1_frac={float(ones_frac):.3f}")

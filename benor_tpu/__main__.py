@@ -1,0 +1,125 @@
+"""CLI driver — the reference's `yarn start` demo plus the science harness.
+
+`python -m benor_tpu` reproduces src/start.ts:6-43: launch 10 nodes with 4
+faulty, all-1 inputs, run consensus, print each node's final state.
+
+Subcommands:
+  demo   [--backend tpu|express] [-n N] [-f F] ...   the start.ts demo
+  sweep  --n N --f-values 0,100,...                  rounds-vs-f curve
+  coins  --n N --f F                                 private vs common coin
+  preset NAME                                        a BASELINE.json config
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def _demo(args) -> int:
+    from .api import get_nodes_state, launch_network, start_consensus
+    n, f = args.n, args.f
+    # start.ts:25-29 — the reference refuses F > N/2 in the demo driver
+    if f > n / 2:
+        print("Too many faulty nodes", file=sys.stderr)
+        return 1
+    initial = [1] * n                      # start.ts:9-20: all-1 inputs
+    faulty = [True] * f + [False] * (n - f)
+    net = launch_network(n, f, initial, faulty, backend=args.backend,
+                         max_rounds=args.max_rounds, seed=args.seed)
+    start_consensus(net)
+    for i, st in enumerate(get_nodes_state(net)):
+        print(f"node {i}: {st}")
+    return 0
+
+
+def _sweep(args) -> int:
+    from .config import SimConfig
+    from .sweep import rounds_vs_f, save_points
+    f_values = [int(x) for x in args.f_values.split(",")]
+    cfg = SimConfig(n_nodes=args.n, n_faulty=0, trials=args.trials,
+                    max_rounds=args.max_rounds, delivery="quorum",
+                    scheduler=args.scheduler, coin_mode=args.coin,
+                    seed=args.seed)
+    print(f"rounds-vs-f sweep: N={args.n}, trials={args.trials}, "
+          f"scheduler={args.scheduler}, coin={args.coin}")
+    points = rounds_vs_f(cfg, f_values)
+    if args.out:
+        save_points(args.out, points)
+        print(f"wrote {args.out}")
+    return 0
+
+
+def _coins(args) -> int:
+    from .config import SimConfig
+    from .sweep import coin_comparison
+    cfg = SimConfig(n_nodes=args.n, n_faulty=args.f, trials=args.trials,
+                    max_rounds=args.max_rounds, seed=args.seed)
+    res = coin_comparison(cfg)
+    for mode, pts in res.items():
+        p = pts[0]
+        print(f"{mode}: decided={p.decided_frac:.3f} mean_k={p.mean_k:.2f}")
+    return 0
+
+
+def _preset(args) -> int:
+    from .sweep import baseline_configs, run_point
+    cfgs = baseline_configs()
+    if args.name not in cfgs:
+        print(f"unknown preset {args.name!r}; choose from "
+              f"{sorted(cfgs)}", file=sys.stderr)
+        return 1
+    pt = run_point(cfgs[args.name])
+    print(json.dumps(pt.to_dict(), indent=1))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="benor_tpu")
+    sub = ap.add_subparsers(dest="cmd")
+
+    d = sub.add_parser("demo", help="the reference start.ts demo")
+    d.add_argument("-n", type=int, default=10)        # start.ts:7
+    d.add_argument("-f", type=int, default=4)         # start.ts:8
+    d.add_argument("--backend", choices=("tpu", "express"), default="tpu")
+    d.add_argument("--max-rounds", type=int, default=32)
+    d.add_argument("--seed", type=int, default=0)
+
+    s = sub.add_parser("sweep", help="rounds-vs-f curve")
+    s.add_argument("--n", type=int, required=True)
+    s.add_argument("--f-values", required=True,
+                   help="comma-separated fault counts")
+    s.add_argument("--trials", type=int, default=256)
+    s.add_argument("--max-rounds", type=int, default=64)
+    s.add_argument("--scheduler",
+                   choices=("uniform", "biased", "adversarial"),
+                   default="uniform")
+    s.add_argument("--coin", choices=("private", "common"), default="private")
+    s.add_argument("--seed", type=int, default=0)
+    s.add_argument("--out", help="write points to this JSON file")
+
+    c = sub.add_parser("coins", help="private vs common coin, adversarial")
+    c.add_argument("--n", type=int, default=100)
+    c.add_argument("--f", type=int, default=40)  # need F >> sqrt(N)
+    c.add_argument("--trials", type=int, default=128)
+    c.add_argument("--max-rounds", type=int, default=48)
+    c.add_argument("--seed", type=int, default=0)
+
+    p = sub.add_parser("preset", help="run a BASELINE.json preset config")
+    p.add_argument("name")
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # bare `python -m benor_tpu [-n N -f F ...]` == the start.ts demo
+    if not argv or argv[0] not in ("demo", "sweep", "coins", "preset", "-h",
+                                   "--help"):
+        argv = ["demo"] + argv
+    args = ap.parse_args(argv)
+    return {"demo": _demo, "sweep": _sweep, "coins": _coins,
+            "preset": _preset}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -176,7 +176,11 @@ class ExpressNetwork:
         return self.nodes[node_id].status()
 
     def start(self) -> None:
-        # startConsensus: sequential /start fan-out (consensus.ts:3-8)
+        # startConsensus: sequential /start fan-out (consensus.ts:3-8).
+        # Idempotent so repeated /start routes don't re-broadcast.
+        if getattr(self, "_started", False):
+            return
+        self._started = True
         for nd in self.nodes:
             nd.on_start()
         self._drain()
@@ -184,6 +188,9 @@ class ExpressNetwork:
     def stop(self) -> None:
         for nd in self.nodes:
             nd.on_stop()
+
+    def stop_node(self, node_id: int) -> None:
+        self.nodes[node_id].on_stop()
 
     def get_state(self, node_id: int, trial: int = 0) -> dict:
         self._check_trial(trial)

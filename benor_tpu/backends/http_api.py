@@ -1,0 +1,117 @@
+"""HTTP observation layer: the reference's control plane over real sockets.
+
+Serves the four routes of the reference node server (src/nodes/node.ts) on
+``BASE_NODE_PORT + node_id`` (src/config.ts:1), one listener per simulated
+node, backed by EITHER network backend's device/oracle state:
+
+    GET /status    200 "live" | 500 "faulty"          node.ts:33-39
+    GET /start     200 {"message": "Algorithm started"}   node.ts:167-188
+    GET /stop      200 "killed"                       node.ts:191-194
+    GET /getState  200 NodeState JSON                 node.ts:197-199
+
+Semantics notes:
+  * The reference runs consensus *concurrently* with polling; here the
+    first /start on any node runs the whole network to termination (the
+    compiled while-loop), so pollers observe the final snapshot — the same
+    fixed point the reference's pollers converge to.
+  * /stop kills only the receiving node (consensus.ts fans /stop out to all
+    ports to stop the network, and so does ``stop_all``).
+  * POST /message is intentionally absent: peer messages are device-array
+    data movement, not RPCs (SURVEY §5.8); external injection would bypass
+    the deterministic scheduler.  The routes above are the ones the
+    reference's control plane and test harness actually consume.
+
+This layer exists for wire-level interop (curl, the reference's own test
+utilities pointed at localhost) at demo-scale N; in-process code should use
+the Python facade (api.py) which serves the same dicts without sockets.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+from ..config import BASE_NODE_PORT
+
+
+class _Handler(BaseHTTPRequestHandler):
+    network = None          # set per listener class
+    node_id: int = -1
+    start_lock: Optional[threading.Lock] = None
+
+    def log_message(self, fmt, *args):  # silence default stderr chatter
+        pass
+
+    def _send(self, code: int, body, as_json: bool) -> None:
+        data = (json.dumps(body) if as_json else str(body)).encode()
+        self.send_response(code)
+        self.send_header(
+            "Content-Type",
+            "application/json" if as_json else "text/plain; charset=utf-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        net, nid = self.network, self.node_id
+        if self.path == "/status":
+            body, code = net.status(nid)
+            self._send(code, body, as_json=False)
+        elif self.path == "/start":
+            with self.start_lock:          # idempotent network-level start
+                net.start()
+            self._send(200, {"message": "Algorithm started"}, as_json=True)
+        elif self.path == "/stop":
+            net.stop_node(nid)
+            self._send(200, "killed", as_json=False)
+        elif self.path == "/getState":
+            self._send(200, net.get_state(nid), as_json=True)
+        else:
+            self._send(404, {"error": f"no route {self.path}"}, as_json=True)
+
+
+class NodeHttpCluster:
+    """N HTTP listeners (ports base..base+N-1) over one simulated network."""
+
+    def __init__(self, network, base_port: int = BASE_NODE_PORT,
+                 host: str = "127.0.0.1"):
+        self.network = network
+        self.base_port = base_port
+        self.servers: List[ThreadingHTTPServer] = []
+        self.threads: List[threading.Thread] = []
+        start_lock = threading.Lock()
+        n = network.cfg.n_nodes if hasattr(network, "cfg") else network.n
+        for i in range(n):
+            handler = type(f"_Handler{i}", (_Handler,), {
+                "network": network, "node_id": i, "start_lock": start_lock})
+            srv = ThreadingHTTPServer((host, base_port + i), handler)
+            t = threading.Thread(target=srv.serve_forever, daemon=True)
+            self.servers.append(srv)
+            self.threads.append(t)
+
+    def serve(self) -> "NodeHttpCluster":
+        for t in self.threads:
+            t.start()
+        return self
+
+    def stop_all(self) -> None:
+        """consensus.ts:10-15 — /stop every node (state-level)."""
+        self.network.stop()
+
+    def close(self) -> None:
+        for srv in self.servers:
+            srv.shutdown()
+            srv.server_close()
+
+    def __enter__(self):
+        return self.serve()
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def serve_network(network, base_port: int = BASE_NODE_PORT):
+    """Convenience: wrap a launched network in a serving HTTP cluster."""
+    return NodeHttpCluster(network, base_port).serve()

@@ -71,6 +71,12 @@ class TpuNetwork:
             x=self.state.x, decided=self.state.decided, k=self.state.k,
             killed=jax.numpy.ones_like(self.state.killed))
 
+    def stop_node(self, node_id: int) -> None:
+        """Single node's /stop route (node.ts:191-194), all trials."""
+        self.state = NetState(
+            x=self.state.x, decided=self.state.decided, k=self.state.k,
+            killed=self.state.killed.at[:, node_id].set(True))
+
     # -- /getState (node.ts:197-199) -------------------------------------
     def get_state(self, node_id: int, trial: int = 0) -> dict:
         return observable_state(self.cfg, self.state, self.faults,

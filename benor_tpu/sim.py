@@ -33,6 +33,9 @@ def start_state(cfg: SimConfig, state: NetState) -> NetState:
 def _run_body(cfg: SimConfig, faults: FaultSpec, base_key: jax.Array, carry):
     r, state = carry
     state = benor_round(cfg, state, faults, base_key, r)
+    if cfg.debug:  # per-round host callback (SURVEY §5.1); zero cost if off
+        from .utils.tracing import emit_round_event
+        emit_round_event(state)
     return (r + 1, state)
 
 

@@ -1,0 +1,208 @@
+"""Science harness: parameter sweeps over the consensus simulator.
+
+The reference repo's only "experiment" is a hardcoded 10-node demo
+(src/start.ts:7-20).  This module is the research surface the BASELINE.json
+north star asks for: expected-rounds-vs-f curves, private-vs-common-coin
+comparisons, and Monte-Carlo throughput measurement at up to millions of
+simulated nodes.
+
+Everything is summarized ON DEVICE and fetched as scalars / max_rounds-sized
+histograms — under the axon tunnel a bulk [T, N] device->host transfer costs
+seconds, and ``jax.block_until_ready`` does not actually block, so every
+timed section ends with a scalar fetch as its completion barrier.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import json
+import time
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import SimConfig, VAL1
+from .sim import run_consensus
+from .state import FaultSpec, NetState, init_state
+
+
+@dataclasses.dataclass
+class SweepPoint:
+    """Summary of one (config, fault-count) Monte-Carlo batch."""
+
+    n_nodes: int
+    n_faulty: int
+    trials: int
+    coin_mode: str
+    scheduler: str
+    rounds_executed: int        # while-loop trip count (max over lanes)
+    decided_frac: float         # healthy lanes that decided
+    mean_k: float               # mean observed k among decided healthy lanes
+    k_hist: np.ndarray          # int64[max_rounds+2] histogram of decided k
+    ones_frac: float            # decided-1 fraction among decided healthy
+    seconds: float              # wall-clock for the batch (post-compile)
+    trials_per_sec: float
+
+    def to_dict(self) -> Dict:
+        d = dataclasses.asdict(self)
+        d["k_hist"] = self.k_hist.tolist()
+        return d
+
+
+@functools.partial(jax.jit, static_argnums=2)
+def summarize_final(final: NetState, faulty: jax.Array, max_rounds: int):
+    """On-device reduction -> 4 scalars + a small k histogram."""
+    healthy = ~faulty
+    hd = final.decided & healthy
+    n_hd = jnp.maximum(jnp.sum(hd), 1)
+    decided_frac = jnp.sum(hd) / jnp.maximum(jnp.sum(healthy), 1)
+    mean_k = jnp.sum(final.k * hd) / n_hd
+    ones_frac = jnp.sum(hd & (final.x == VAL1)) / n_hd
+    k_hist = jnp.bincount(jnp.where(hd, final.k, 0).ravel(),
+                          weights=hd.ravel().astype(jnp.int32),
+                          length=max_rounds + 2)
+    return decided_frac, mean_k, ones_frac, k_hist
+
+
+def random_inputs(seed: int, trials: int, n: int) -> np.ndarray:
+    """Per-trial random initial bits — the standard MC input distribution."""
+    return np.random.default_rng(seed).integers(
+        0, 2, size=(trials, n), dtype=np.int8)
+
+
+def run_point(cfg: SimConfig, initial_values=None, faulty_list=None,
+              faults: Optional[FaultSpec] = None) -> SweepPoint:
+    """Run one MC batch to termination; returns its on-device summary.
+
+    Defaults: per-trial random initial bits; the first F nodes faulty
+    (which F nodes crash is statistically irrelevant under the uniform
+    scheduler — lanes are exchangeable).  Pass ``faults`` directly to
+    decouple the protocol parameter F from the number of actual crashes
+    (the reference's launch validation pins them equal, launchNodes.ts:12-13,
+    but an asynchronous adversary is strongest with NO crashes: every node
+    alive and the full N-F quorum slack available for message reordering).
+    """
+    if initial_values is None:
+        initial_values = random_inputs(cfg.seed, cfg.trials, cfg.n_nodes)
+    if faults is None:
+        if faulty_list is None:
+            faulty_list = np.zeros(cfg.n_nodes, bool)
+            faulty_list[:cfg.n_faulty] = True
+        faults = FaultSpec.from_faulty_list(cfg, faulty_list)
+    state = init_state(cfg, initial_values, faults)
+    base_key = jax.random.key(cfg.seed)
+
+    # compile (cached across calls with the same static cfg)
+    r, final = run_consensus(cfg, state, faults, base_key)
+    int(r)  # completion barrier
+    t0 = time.perf_counter()
+    r, final = run_consensus(cfg, state, faults, base_key)
+    rounds = int(r)  # completion barrier inside the timed window
+    seconds = time.perf_counter() - t0
+
+    dec, mk, ones, khist = summarize_final(final, faults.faulty, cfg.max_rounds)
+    return SweepPoint(
+        n_nodes=cfg.n_nodes, n_faulty=cfg.n_faulty, trials=cfg.trials,
+        coin_mode=cfg.coin_mode, scheduler=cfg.scheduler,
+        rounds_executed=rounds, decided_frac=float(dec), mean_k=float(mk),
+        k_hist=np.asarray(khist).astype(np.int64), ones_frac=float(ones),
+        seconds=seconds,
+        trials_per_sec=cfg.trials / seconds if seconds > 0 else float("inf"))
+
+
+def rounds_vs_f(base_cfg: SimConfig, f_values: Sequence[int],
+                verbose: bool = True) -> List[SweepPoint]:
+    """The north-star curve: expected rounds-to-decide as F grows.
+
+    Each point reuses ``base_cfg`` with ``n_faulty`` replaced; initial
+    values are per-trial random bits seeded by ``base_cfg.seed``.
+    """
+    points = []
+    for f in f_values:
+        pt = run_point(base_cfg.replace(n_faulty=int(f)))
+        points.append(pt)
+        if verbose:
+            print(f"  f={f}: mean_k={pt.mean_k:.2f} "
+                  f"decided={pt.decided_frac:.3f} "
+                  f"{pt.trials_per_sec:.1f} trials/s", flush=True)
+    return points
+
+
+def coin_comparison(base_cfg: SimConfig,
+                    verbose: bool = True) -> Dict[str, List[SweepPoint]]:
+    """Private vs shared common coin under the worst-case adversarial
+    scheduler — the classic Ben-Or-vs-Rabin contrast: the count-controlling
+    adversary livelocks private coins (decided_frac ~ 0 at the round cap)
+    while the common coin terminates in O(1) expected rounds.
+
+    The adversary is given maximum power: all N nodes stay alive (zero
+    crashes), so it can discard any F messages per receiver; inputs are
+    perfectly balanced.  It forces a tied (m/2, m/2) delivered multiset —
+    which requires an even quorum m = N - F; for odd m a one-message
+    imbalance leaks through and the run converges regardless of coin.
+
+    Escape physics (and why termination is still guaranteed — Ben-Or's
+    original argument): a tie is only constructible while the private coin
+    flips stay balanced enough, min(c0, c1) >= m/2, i.e. within F/2 of the
+    N/2 mean.  With per-round std sqrt(N)/2, the per-round escape
+    probability is ~2*Phi(-F/sqrt(N)), so the private-coin livelock is only
+    long-lived when F >> sqrt(N) (e.g. N=100, F=40 holds for ~1e4 rounds;
+    N=20, F=6 escapes ~11% of rounds).  The common coin escapes in O(1)
+    rounds at ANY F: the first round after all lanes flip the same value,
+    the adversary cannot hide a unanimous class.
+    """
+    if base_cfg.quorum % 2:
+        raise ValueError(
+            f"coin_comparison needs an even quorum N-F for a perfect-tie "
+            f"adversary (got N-F={base_cfg.quorum}); adjust N or F")
+    T, N = base_cfg.trials, base_cfg.n_nodes
+    no_crash = FaultSpec(faulty=jnp.zeros((T, N), bool),
+                         crash_round=jnp.zeros((T, N), jnp.int32))
+    balanced = np.tile(np.arange(N, dtype=np.int8) % 2, (T, 1))
+    out: Dict[str, List[SweepPoint]] = {}
+    for coin in ("private", "common"):
+        cfg = base_cfg.replace(coin_mode=coin, scheduler="adversarial",
+                               delivery="quorum")
+        if verbose:
+            print(f" coin_mode={coin}:", flush=True)
+        pt = run_point(cfg, initial_values=balanced, faults=no_crash)
+        if verbose:
+            print(f"  decided={pt.decided_frac:.3f} mean_k={pt.mean_k:.2f} "
+                  f"{pt.trials_per_sec:.1f} trials/s", flush=True)
+        out[coin] = [pt]
+    return out
+
+
+def baseline_configs() -> Dict[str, SimConfig]:
+    """The five BASELINE.json benchmark configs as ready-to-run presets."""
+    return {
+        # "Fault-free Ben-Or, N=5 nodes, random initial x"
+        "n5_faultfree": SimConfig(n_nodes=5, n_faulty=0, trials=1024,
+                                  delivery="quorum", scheduler="uniform"),
+        # "Crash-fault Ben-Or, N=10k nodes, f=N/5 crash mask, 1k MC trials"
+        "n10k_crash": SimConfig(n_nodes=10_000, n_faulty=2_000, trials=1000,
+                                delivery="quorum", scheduler="uniform",
+                                path="histogram"),
+        # "Byzantine Ben-Or, N=100k nodes, f<N/5 adversarial bit-flip mask"
+        "n100k_byzantine": SimConfig(n_nodes=100_000, n_faulty=19_999,
+                                     trials=64, fault_model="byzantine",
+                                     delivery="quorum", scheduler="uniform",
+                                     path="histogram"),
+        # "Private-coin vs shared-common-coin, N=1M, rounds-to-decide vs f"
+        "n1m_coin_sweep": SimConfig(n_nodes=1_000_000, n_faulty=200_000,
+                                    trials=32, delivery="quorum",
+                                    scheduler="uniform", path="histogram"),
+        # "Asynchronous adversarial scheduler, N=1M nodes"
+        "n1m_adversarial": SimConfig(n_nodes=1_000_000, n_faulty=200_000,
+                                     trials=32, delivery="quorum",
+                                     scheduler="adversarial", max_rounds=24,
+                                     path="histogram"),
+    }
+
+
+def save_points(path: str, points: Sequence[SweepPoint]) -> None:
+    with open(path, "w") as fh:
+        json.dump([p.to_dict() for p in points], fh, indent=1)

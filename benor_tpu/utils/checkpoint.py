@@ -32,13 +32,20 @@ _FORMAT_VERSION = 1
 
 
 def save_checkpoint(path: str, cfg: SimConfig, state: NetState,
-                    faults: FaultSpec, next_round: int) -> None:
+                    faults: FaultSpec, next_round: int,
+                    base_key: "jax.Array | None" = None) -> None:
     """Snapshot a (possibly mid-run) simulation to ``path`` (.npz).
 
     ``next_round`` is the 1-based round index the loop would execute next —
     pass ``rounds_executed + 1`` from a capped ``run_consensus``.
+    ``base_key`` is the PRNG key the run was started with; it is persisted
+    (as raw key data) so resume continues the same random streams.  Omit it
+    only if the run used the default ``jax.random.key(cfg.seed)``.
     """
+    if base_key is None:
+        base_key = jax.random.key(cfg.seed)
     payload = {
+        "key_data": np.asarray(jax.random.key_data(base_key)),
         "x": np.asarray(state.x),
         "decided": np.asarray(state.decided),
         "k": np.asarray(state.k),
@@ -56,8 +63,8 @@ def save_checkpoint(path: str, cfg: SimConfig, state: NetState,
     os.replace(tmp, path)  # atomic: no torn checkpoints on crash
 
 
-def load_checkpoint(path: str) -> Tuple[SimConfig, NetState, FaultSpec, int]:
-    """Load a checkpoint; returns (cfg, state, faults, next_round)."""
+def load_checkpoint(path: str):
+    """Load a checkpoint; returns (cfg, state, faults, next_round, base_key)."""
     with np.load(path, allow_pickle=False) as z:
         version = int(z["version"])
         if version != _FORMAT_VERSION:
@@ -72,7 +79,8 @@ def load_checkpoint(path: str) -> Tuple[SimConfig, NetState, FaultSpec, int]:
         faults = FaultSpec(faulty=jnp.asarray(z["faulty"]),
                            crash_round=jnp.asarray(z["crash_round"]))
         next_round = int(z["next_round"])
-    return cfg, state, faults, next_round
+        base_key = jax.random.wrap_key_data(jnp.asarray(z["key_data"]))
+    return cfg, state, faults, next_round, base_key
 
 
 def resume_from(path: str):
@@ -84,7 +92,6 @@ def resume_from(path: str):
     """
     from ..sim import resume_consensus
 
-    cfg, state, faults, next_round = load_checkpoint(path)
-    base_key = jax.random.key(cfg.seed)
+    cfg, state, faults, next_round, base_key = load_checkpoint(path)
     rounds, final = resume_consensus(cfg, state, faults, base_key, next_round)
     return rounds, final, faults

@@ -1,0 +1,78 @@
+"""Tracing / profiling hooks (SURVEY.md §5.1).
+
+The reference's observability is ``console.log`` on decisions
+(src/nodes/node.ts:71) and on listen (node.ts:203-205).  Here the round loop
+is one fused device program, so per-round visibility needs an explicit
+escape hatch: with ``SimConfig(debug=True)`` the simulator emits one
+``jax.debug.callback`` per executed round carrying (round, #decided,
+#killed) — streamed to every registered sink without leaving the compiled
+while-loop.
+
+``profile_trace`` wraps ``jax.profiler.trace`` for XLA-level traces
+viewable in TensorBoard / Perfetto.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+import time
+from typing import Callable, List
+
+import jax
+
+#: Registered sinks; each is called as sink(round, n_decided, n_killed).
+_SINKS: List[Callable[[int, int, int], None]] = []
+
+
+def default_sink(r: int, n_decided: int, n_killed: int) -> None:
+    print(f"[benor_tpu] round {int(r)}: decided={int(n_decided)} "
+          f"killed={int(n_killed)}", file=sys.stderr, flush=True)
+
+
+def add_sink(sink: Callable[[int, int, int], None]) -> None:
+    _SINKS.append(sink)
+
+
+def remove_sink(sink: Callable[[int, int, int], None]) -> None:
+    _SINKS.remove(sink)
+
+
+def round_callback(r, n_decided, n_killed) -> None:
+    """Host-side fanout invoked (async, ordered) once per executed round."""
+    sinks = _SINKS or [default_sink]
+    for sink in sinks:
+        sink(int(r), int(n_decided), int(n_killed))
+
+
+def emit_round_event(state) -> None:
+    """Called from the jitted round loop when cfg.debug is set.
+
+    ``ordered=True`` threads a sequencing token through the loop so sinks
+    observe rounds in execution order even with async host dispatch; the
+    cost only exists when cfg.debug is set (otherwise nothing is traced in).
+    """
+    import jax.numpy as jnp
+    jax.debug.callback(round_callback, state.k.max(),
+                       jnp.sum(state.decided), jnp.sum(state.killed),
+                       ordered=True)
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str):
+    """XLA profiler trace around a block: TensorBoard-compatible output."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def timed(label: str, sink=None):
+    """Wall-clock a host-side block; prints to stderr by default."""
+    t0 = time.perf_counter()
+    yield
+    dt = time.perf_counter() - t0
+    msg = f"[benor_tpu] {label}: {dt * 1e3:.1f} ms"
+    (sink or (lambda m: print(m, file=sys.stderr, flush=True)))(msg)

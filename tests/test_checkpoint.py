@@ -56,13 +56,33 @@ def test_resume_bit_identical(tmp_path):
                                   np.asarray(final_full.killed))
 
 
+def test_resume_preserves_custom_base_key(tmp_path):
+    """A run started with a non-default key resumes on the SAME streams."""
+    cfg, state, faults = _setup()
+    custom_key = jax.random.key(12345)          # != key(cfg.seed)
+    rounds_full, final_full = run_consensus(cfg, state, faults, custom_key)
+    cfg_cap = cfg.replace(max_rounds=2)
+    rounds_cap, mid = run_consensus(cfg_cap, state, faults, custom_key)
+    path = str(tmp_path / "ckpt.npz")
+    save_checkpoint(path, cfg, mid, faults, next_round=int(rounds_cap) + 1,
+                    base_key=custom_key)
+    rounds_res, final_res, _ = resume_from(path)
+    assert int(rounds_res) == int(rounds_full)
+    np.testing.assert_array_equal(np.asarray(final_res.x),
+                                  np.asarray(final_full.x))
+
+
 def test_load_round_trips_config_and_arrays(tmp_path):
     cfg, state, faults = _setup(fault_model="crash", coin_mode="common")
     path = str(tmp_path / "ckpt.npz")
     save_checkpoint(path, cfg, state, faults, next_round=1)
-    cfg2, state2, faults2, nr = load_checkpoint(path)
+    cfg2, state2, faults2, nr, key = load_checkpoint(path)
     assert cfg2 == cfg
     assert nr == 1
+    import jax as _jax
+    np.testing.assert_array_equal(
+        np.asarray(_jax.random.key_data(key)),
+        np.asarray(_jax.random.key_data(_jax.random.key(cfg.seed))))
     np.testing.assert_array_equal(np.asarray(state2.x), np.asarray(state.x))
     np.testing.assert_array_equal(np.asarray(faults2.faulty),
                                   np.asarray(faults.faulty))

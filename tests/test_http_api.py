@@ -1,0 +1,77 @@
+"""Wire-level parity: the reference's HTTP control plane over real sockets.
+
+Mirrors the reference test harness's usage (__test__/tests/utils.ts:4-12:
+fetch /getState; benorconsensus.test.ts:50-75: /status codes) against both
+backends, on a non-default port base so parallel CI runs don't collide.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from benor_tpu.api import launch_network
+from benor_tpu.backends.http_api import NodeHttpCluster
+
+BASE = 3100
+
+
+def _get(port: int, path: str):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+@pytest.mark.parametrize("backend", ["tpu", "express"])
+class TestHttpParity:
+    def test_status_codes(self, backend):
+        """benorconsensus.test.ts:45-75: faulty => 500 'faulty',
+        healthy => 200 'live'."""
+        net = launch_network(3, 1, [1, 1, 1], [True, False, False],
+                             backend=backend)
+        with NodeHttpCluster(net, BASE):
+            assert _get(BASE + 0, "/status") == (500, "faulty")
+            assert _get(BASE + 1, "/status") == (200, "live")
+            assert _get(BASE + 2, "/status") == (200, "live")
+
+    def test_full_consensus_over_http(self, backend):
+        """launch -> /start -> poll /getState until finality -> assertions
+        (the unanimous N=5 scenario, benorconsensus.test.ts:133-175)."""
+        net = launch_network(5, 0, [1] * 5, [False] * 5, backend=backend,
+                             seed=1)
+        with NodeHttpCluster(net, BASE):
+            code, body = _get(BASE, "/start")
+            assert code == 200 and json.loads(body) == {
+                "message": "Algorithm started"}
+            states = []
+            for i in range(5):
+                code, body = _get(BASE + i, "/getState")
+                assert code == 200
+                states.append(json.loads(body))
+            assert all(s["decided"] is not False for s in states)  # finality
+            assert all(s["x"] == 1 and s["k"] <= 2 for s in states)
+
+    def test_stop_route_kills_single_node(self, backend):
+        net = launch_network(3, 0, [1, 1, 1], [False] * 3, backend=backend)
+        with NodeHttpCluster(net, BASE):
+            assert _get(BASE + 1, "/stop") == (200, "killed")
+            assert _get(BASE + 1, "/status")[0] == 500
+            assert _get(BASE + 0, "/status")[0] == 200
+
+    def test_unknown_route_404(self, backend):
+        net = launch_network(1, 0, [1], [False], backend=backend)
+        with NodeHttpCluster(net, BASE):
+            assert _get(BASE, "/nope")[0] == 404
+
+    def test_faulty_node_state_is_null(self, backend):
+        """faulty nodes report all-null state (node.ts:21-26)."""
+        net = launch_network(3, 1, [1, 1, 1], [True, False, False],
+                             backend=backend)
+        with NodeHttpCluster(net, BASE):
+            state = json.loads(_get(BASE, "/getState")[1])
+            assert state == {"killed": True, "x": None,
+                             "decided": None, "k": None}

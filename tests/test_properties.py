@@ -1,0 +1,153 @@
+"""Property tests: the consensus invariants, over Monte-Carlo batches.
+
+The reference's suite checks single scenarios; these check the protocol
+PROPERTIES — agreement, validity, termination — over many random trials,
+schedulers and both compute paths (the kind of testing SURVEY §4 notes the
+reference lacks).
+"""
+
+import numpy as np
+import pytest
+
+from benor_tpu.config import SimConfig, VALQ
+from benor_tpu.sim import simulate
+
+
+def _run(n, f, trials, seed, *, vals=None, faulty=None, **overrides):
+    kw = dict(delivery="quorum", scheduler="uniform")
+    kw.update(overrides)
+    cfg = SimConfig(n_nodes=n, n_faulty=f, trials=trials, max_rounds=64,
+                    seed=seed, **kw)
+    if vals is None:
+        vals = np.random.default_rng(seed).integers(
+            0, 2, size=(trials, n), dtype=np.int8)
+    if faulty is None:
+        faulty = [True] * f + [False] * (n - f)
+    rounds, final, faults = simulate(cfg, vals, faulty)
+    healthy = ~np.asarray(faults.faulty)
+    return (np.asarray(final.x), np.asarray(final.decided),
+            np.asarray(final.k), healthy)
+
+
+@pytest.mark.parametrize("path", ["dense", "histogram"])
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_agreement(path, seed):
+    """No two healthy decided lanes of a trial hold different values."""
+    x, decided, _, healthy = _run(60, 15, 64, seed, path=path)
+    for t in range(x.shape[0]):
+        vals = x[t][healthy[t] & decided[t]]
+        assert vals.size > 0
+        assert (vals == vals[0]).all(), f"trial {t} disagrees"
+
+
+@pytest.mark.parametrize("path", ["dense", "histogram"])
+@pytest.mark.parametrize("v", [0, 1])
+def test_validity_unanimous(path, v):
+    """If every healthy node starts with v, every decision is v."""
+    n, f, trials = 40, 10, 32
+    vals = np.full((trials, n), v, np.int8)
+    x, decided, k, healthy = _run(n, f, trials, 11, vals=vals, path=path)
+    assert (decided | ~healthy).all()
+    assert (x[healthy & decided] == v).all()
+    # unanimous inputs decide in the first round (k snapshot = 2)
+    assert (k[healthy & decided] == 2).all()
+
+
+@pytest.mark.parametrize("scheduler", ["uniform", "biased"])
+def test_termination_under_threshold(scheduler):
+    """F < N/2 with a fair/bounded scheduler: every trial terminates."""
+    x, decided, k, healthy = _run(
+        30, 14, 64, 13, scheduler=scheduler, path="dense",
+        adversary_strength=0.75 if scheduler == "biased" else 0.0)
+    assert (decided | ~healthy).all()
+
+
+def test_no_decision_value_is_question_mark():
+    """Decided lanes never hold "?" — decisions are on 0/1 only."""
+    x, decided, _, healthy = _run(25, 8, 64, 17)
+    assert (x[decided & healthy] != VALQ).all()
+
+
+def test_byzantine_agreement_full_delivery():
+    """Byzantine flips with delivery='all': every receiver tallies the same
+    multiset, so decisions are identical -> agreement holds exactly."""
+    n, f, trials = 50, 9, 64
+    x, decided, _, healthy = _run(n, f, trials, 19, fault_model="byzantine",
+                                  delivery="all")
+    for t in range(trials):
+        vals = x[t][healthy[t] & decided[t]]
+        if vals.size:
+            assert (vals == vals[0]).all(), f"trial {t} safety violation"
+    assert (decided & healthy).any(axis=1).mean() > 0.9
+
+
+def test_byzantine_quorum_sampling_breaks_reference_rule():
+    """A *finding* the simulator must reproduce: the reference's decide rule
+    (plurality-adopt + decide on count > F, node.ts:99-112) is NOT safe once
+    receivers tally different N-F subsets and all N nodes stay alive
+    (Byzantine keeps faulty senders alive, unlike crash).  With a split vote
+    (a zeros, b ones), a 41-of-50 sample can put count(0) on either side of
+    F=9, so different receivers decide different values.  The reference
+    never sees this because its crash model pins alive == quorum (zero
+    sampling slack).  BFT-safe Ben-Or needs the (N+F)/2 vote threshold,
+    which the reference (and hence our reference-mode) lacks."""
+    n, f, trials = 50, 9, 64
+    x, decided, _, healthy = _run(n, f, trials, 19, fault_model="byzantine",
+                                  delivery="quorum")
+    violations = 0
+    for t in range(trials):
+        vals = x[t][healthy[t] & decided[t]]
+        if vals.size and not (vals == vals[0]).all():
+            violations += 1
+    assert violations > 0, (
+        "expected the simulator to surface reference-rule safety violations "
+        "under Byzantine faults + quorum sampling")
+
+
+def test_crash_at_round_kills_and_network_survives():
+    """crash_at_round: faulty lanes die at their round; with quorum still
+    available the healthy majority terminates."""
+    n, f, trials = 30, 5, 32
+    crash_rounds = np.zeros(n, np.int32)
+    crash_rounds[:f] = [1, 2, 2, 3, 4]
+    cfg = SimConfig(n_nodes=n, n_faulty=f, trials=trials, max_rounds=64,
+                    delivery="quorum", scheduler="uniform", seed=23,
+                    fault_model="crash_at_round")
+    vals = np.random.default_rng(23).integers(0, 2, (trials, n), np.int8)
+    rounds, final, faults = simulate(
+        cfg, vals, [True] * f + [False] * (n - f), crash_rounds=crash_rounds)
+    killed = np.asarray(final.killed)
+    decided = np.asarray(final.decided)
+    faulty = np.asarray(faults.faulty)
+    # a lane dies iff the run reached its crash round (a trial that settles
+    # early never executes the later crash rounds — like the reference
+    # network being torn down before a node would have failed)
+    executed = int(rounds)
+    for i in range(f):
+        if crash_rounds[i] <= executed:
+            assert killed[:, i].all(), f"lane {i} should have crashed"
+    assert killed[:, 0].all(), "round-1 crash always precedes settling"
+    assert (decided | faulty).all(), "healthy lanes must still decide"
+
+
+def test_mesh_shape_invariance_of_results():
+    """SURVEY §7 hard-part 5: same seed, different mesh shapes -> identical
+    results (RNG keyed on global ids, not shard layout)."""
+    import jax
+    from benor_tpu.parallel import make_mesh, run_consensus_sharded
+    from benor_tpu.sim import run_consensus
+    from benor_tpu.state import FaultSpec, init_state
+
+    cfg = SimConfig(n_nodes=32, n_faulty=8, trials=8, max_rounds=48,
+                    delivery="quorum", scheduler="uniform", seed=29,
+                    path="dense")
+    vals = np.random.default_rng(29).integers(0, 2, (8, 32), np.int8)
+    faults = FaultSpec.from_faulty_list(cfg, [True] * 8 + [False] * 24)
+    state = init_state(cfg, vals, faults)
+    key = jax.random.key(cfg.seed)
+    _, ref = run_consensus(cfg, state, faults, key)
+    for shape in [(1, 8), (2, 4), (4, 2), (8, 1)]:
+        mesh = make_mesh(*shape)
+        _, out = run_consensus_sharded(cfg, state, faults, key, mesh)
+        np.testing.assert_array_equal(np.asarray(out.x), np.asarray(ref.x))
+        np.testing.assert_array_equal(np.asarray(out.k), np.asarray(ref.k))

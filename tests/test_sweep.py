@@ -1,0 +1,102 @@
+"""Science-harness tests: sweep points, curves, coin comparison, CLI."""
+
+import json
+
+import numpy as np
+import pytest
+
+from benor_tpu.config import SimConfig
+from benor_tpu.sweep import (baseline_configs, coin_comparison, rounds_vs_f,
+                             run_point, save_points)
+
+
+def test_run_point_summary_consistency():
+    cfg = SimConfig(n_nodes=50, n_faulty=10, trials=64, max_rounds=32,
+                    delivery="quorum", scheduler="uniform", seed=5)
+    pt = run_point(cfg)
+    assert pt.decided_frac == pytest.approx(1.0)
+    assert 2.0 <= pt.mean_k <= 10.0
+    # histogram mass equals number of decided healthy lanes
+    assert pt.k_hist.sum() == 64 * 40
+    # histogram mean matches mean_k
+    ks = np.arange(len(pt.k_hist))
+    assert (ks * pt.k_hist).sum() / pt.k_hist.sum() == pytest.approx(
+        pt.mean_k, abs=1e-3)
+    assert pt.trials_per_sec > 0
+
+
+def test_rounds_vs_f_monotone_ish():
+    """More faults -> fewer live senders -> never *faster* on average."""
+    cfg = SimConfig(n_nodes=40, n_faulty=0, trials=96, max_rounds=48,
+                    delivery="quorum", scheduler="uniform", seed=6)
+    pts = rounds_vs_f(cfg, [0, 8, 16], verbose=False)
+    assert [p.n_faulty for p in pts] == [0, 8, 16]
+    assert all(p.decided_frac == pytest.approx(1.0) for p in pts)
+    assert pts[0].mean_k <= pts[-1].mean_k + 0.5  # noise tolerance
+
+
+def test_coin_comparison_adversarial_contrast():
+    """Count-controlling adversary: private coin livelocks, common escapes.
+
+    F must be >> sqrt(N) for a durable livelock (see coin_comparison
+    docstring): N=100, F=40 gives a per-round escape chance of
+    ~2*Phi(-4) ~ 6e-5, so 24 rounds decide with prob < 0.2%.
+    """
+    cfg = SimConfig(n_nodes=100, n_faulty=40, trials=64, max_rounds=24,
+                    seed=7)
+    res = coin_comparison(cfg, verbose=False)
+    assert res["private"][0].decided_frac < 0.05
+    assert res["common"][0].decided_frac == pytest.approx(1.0)
+    assert res["common"][0].mean_k <= 6.0
+
+
+def test_coin_comparison_rejects_odd_quorum():
+    cfg = SimConfig(n_nodes=21, n_faulty=6, trials=4)
+    with pytest.raises(ValueError, match="even quorum"):
+        coin_comparison(cfg, verbose=False)
+
+
+def test_save_points_roundtrip(tmp_path):
+    cfg = SimConfig(n_nodes=10, n_faulty=2, trials=8, delivery="quorum",
+                    scheduler="uniform", seed=8)
+    pts = rounds_vs_f(cfg, [2], verbose=False)
+    path = str(tmp_path / "pts.json")
+    save_points(path, pts)
+    data = json.load(open(path))
+    assert data[0]["n_faulty"] == 2
+    assert isinstance(data[0]["k_hist"], list)
+
+
+def test_baseline_presets_valid():
+    cfgs = baseline_configs()
+    assert set(cfgs) == {"n5_faultfree", "n10k_crash", "n100k_byzantine",
+                         "n1m_coin_sweep", "n1m_adversarial"}
+    # constructing them validates all fields via __post_init__
+    for cfg in cfgs.values():
+        assert cfg.n_nodes >= 5
+
+
+class TestCli:
+    def test_demo_default(self, capsys):
+        from benor_tpu.__main__ import main
+        assert main(["demo", "-n", "6", "-f", "2", "--backend", "tpu"]) == 0
+        out = capsys.readouterr().out
+        assert out.count("node ") == 6
+        assert "'decided': True" in out
+
+    def test_demo_express(self, capsys):
+        from benor_tpu.__main__ import main
+        assert main(["demo", "-n", "5", "-f", "1",
+                     "--backend", "express"]) == 0
+        assert "'decided': True" in capsys.readouterr().out
+
+    def test_demo_too_many_faulty(self, capsys):
+        from benor_tpu.__main__ import main
+        assert main(["demo", "-n", "4", "-f", "3"]) == 1  # start.ts:25-29
+
+    def test_sweep_cli(self, tmp_path, capsys):
+        from benor_tpu.__main__ import main
+        out = str(tmp_path / "s.json")
+        assert main(["sweep", "--n", "12", "--f-values", "0,3",
+                     "--trials", "16", "--out", out]) == 0
+        assert len(json.load(open(out))) == 2

@@ -1,0 +1,52 @@
+"""Debug tracing hooks: per-round callbacks out of the compiled loop."""
+
+import numpy as np
+
+import jax
+
+from benor_tpu.config import SimConfig
+from benor_tpu.sim import simulate
+from benor_tpu.utils import tracing
+
+
+def test_round_events_emitted_in_order():
+    rows = []
+    sink = lambda r, d, k: rows.append((r, d, k))
+    tracing.add_sink(sink)
+    try:
+        cfg = SimConfig(n_nodes=30, n_faulty=8, trials=16, max_rounds=32,
+                        delivery="quorum", scheduler="uniform", seed=9,
+                        debug=True)
+        rounds, final, _ = simulate(
+            cfg, [1] * 22 + [0] * 8, [True] * 8 + [False] * 22)
+        jax.effects_barrier()  # flush pending debug callbacks
+    finally:
+        tracing.remove_sink(sink)
+    assert len(rows) == int(rounds)
+    # monotone round counter; decided count non-decreasing; final row matches
+    ks = [r for r, _, _ in rows]
+    assert ks == sorted(ks)
+    decs = [d for _, d, _ in rows]
+    assert decs == sorted(decs)
+    assert decs[-1] == int(np.asarray(final.decided).sum())
+
+
+def test_debug_off_emits_nothing():
+    rows = []
+    sink = lambda *a: rows.append(a)
+    tracing.add_sink(sink)
+    try:
+        cfg = SimConfig(n_nodes=10, n_faulty=2, trials=4, seed=9,
+                        delivery="quorum", scheduler="uniform")
+        simulate(cfg, [1] * 10, [True] * 2 + [False] * 8)
+        jax.effects_barrier()
+    finally:
+        tracing.remove_sink(sink)
+    assert rows == []
+
+
+def test_timed_context(capsys):
+    msgs = []
+    with tracing.timed("unit", sink=msgs.append):
+        pass
+    assert len(msgs) == 1 and "unit" in msgs[0]
