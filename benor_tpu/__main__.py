@@ -84,7 +84,8 @@ def main(argv=None) -> int:
     d = sub.add_parser("demo", help="the reference start.ts demo")
     d.add_argument("-n", type=int, default=10)        # start.ts:7
     d.add_argument("-f", type=int, default=4)         # start.ts:8
-    d.add_argument("--backend", choices=("tpu", "express"), default="tpu")
+    d.add_argument("--backend", choices=("tpu", "express", "native"),
+                   default="tpu")
     d.add_argument("--max-rounds", type=int, default=32)
     d.add_argument("--seed", type=int, default=0)
 

@@ -35,6 +35,10 @@ def launch_network(n: int, f: int, initial_values: Sequence,
                           backend=backend or cfg.backend, **cfg_overrides)
     if cfg.backend == "express":
         return ExpressNetwork(cfg, list(initial_values), list(faulty_list))
+    if cfg.backend == "native":
+        from .backends.native_oracle import NativeExpressNetwork
+        return NativeExpressNetwork(cfg, list(initial_values),
+                                    list(faulty_list))
     return TpuNetwork(cfg, list(initial_values), list(faulty_list))
 
 

@@ -134,6 +134,7 @@ class ExpressNetwork:
         self.rng = random.Random(cfg.seed)
         self.queue: deque = deque()
         self._halt_pending = False
+        self._started = False
         # Worst-case message volume per round is O(N^2) broadcasts (quirk-8
         # refires); the cap exists only to catch runaways and raises rather
         # than silently truncating the oracle.
@@ -178,7 +179,7 @@ class ExpressNetwork:
     def start(self) -> None:
         # startConsensus: sequential /start fan-out (consensus.ts:3-8).
         # Idempotent so repeated /start routes don't re-broadcast.
-        if getattr(self, "_started", False):
+        if self._started:
             return
         self._started = True
         for nd in self.nodes:

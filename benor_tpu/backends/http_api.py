@@ -83,13 +83,22 @@ class NodeHttpCluster:
         self.threads: List[threading.Thread] = []
         start_lock = threading.Lock()
         n = network.cfg.n_nodes if hasattr(network, "cfg") else network.n
-        for i in range(n):
-            handler = type(f"_Handler{i}", (_Handler,), {
-                "network": network, "node_id": i, "start_lock": start_lock})
-            srv = ThreadingHTTPServer((host, base_port + i), handler)
-            t = threading.Thread(target=srv.serve_forever, daemon=True)
-            self.servers.append(srv)
-            self.threads.append(t)
+        try:
+            for i in range(n):
+                handler = type(f"_Handler{i}", (_Handler,), {
+                    "network": network, "node_id": i,
+                    "start_lock": start_lock})
+                srv = ThreadingHTTPServer((host, base_port + i), handler)
+                t = threading.Thread(target=srv.serve_forever, daemon=True)
+                self.servers.append(srv)
+                self.threads.append(t)
+        except OSError:
+            # e.g. EADDRINUSE on port base+k: release 0..k-1 before raising
+            for srv in self.servers:
+                srv.server_close()
+            self.servers.clear()
+            self.threads.clear()
+            raise
 
     def serve(self) -> "NodeHttpCluster":
         for t in self.threads:

@@ -76,6 +76,11 @@ class SimConfig:
     # 'auto':      dense when N <= dense_path_max_n else histogram.
     path: str = "auto"
     dense_path_max_n: int = 2048
+    # Use the fused pallas kernel (ops/pallas_tally.py) for the dense tally:
+    # streams the bool mask and builds one-hots in VMEM instead of
+    # materializing f32 [T, N, N] operands in HBM.  Runs in interpreter mode
+    # off-TPU (tests); same results either way.
+    use_pallas: bool = False
 
     # --- Monte-Carlo ----------------------------------------------------
     trials: int = 1                   # T — independent MC trials (batch axis)
@@ -97,7 +102,11 @@ class SimConfig:
     mesh_shape: Optional[Tuple[int, int]] = None
 
     # --- misc -----------------------------------------------------------
-    backend: str = "tpu"              # 'tpu' | 'express' — the N1 backend switch
+    # The N1 backend switch: 'tpu' = device-array simulator; 'express' =
+    # pure-Python event-loop oracle; 'native' = the C++ oracle (bit-exact
+    # with 'express', ~100x faster drain loop, for large-N differential
+    # testing).
+    backend: str = "tpu"
     debug: bool = False               # enable host-callback tracing / profiling
 
     def __post_init__(self) -> None:
@@ -117,7 +126,7 @@ class SimConfig:
             raise ValueError(f"unknown path: {self.path}")
         if self.fault_model not in ("crash", "byzantine", "crash_at_round"):
             raise ValueError(f"unknown fault_model: {self.fault_model}")
-        if self.backend not in ("tpu", "express"):
+        if self.backend not in ("tpu", "express", "native"):
             raise ValueError(f"unknown backend: {self.backend}")
 
     @property

@@ -1,0 +1,91 @@
+"""Pallas TPU kernel for the dense-path tally (the hot op at N <= ~10^4).
+
+The XLA dense path (ops/tally.py:dense_counts) converts the bool delivery
+mask and the int8 sent values to float32 one-hots in HBM before the einsum —
+materializing a [T, N, N] f32 tensor (4x the bool mask's bytes) plus a
+[T, N, 3] one-hot.  This kernel instead:
+
+  * streams the bool mask into VMEM tile-by-tile and converts on-chip,
+  * builds the [S, 128] one-hot (3 live columns, zero-padded to the 128-lane
+    MXU width) in VMEM from the raw int8 ``sent`` / bool ``alive`` vectors,
+  * issues one [TILE_R, S] x [S, 128] MXU matmul per (trial, receiver-tile)
+    grid step.
+
+HBM traffic per phase drops from ~5 bytes to ~1 byte per mask entry; the
+matmul itself is identical MXU work.  Enabled with
+``SimConfig(use_pallas=True)`` (TPU backend; tests exercise it in
+interpreter mode on CPU).
+
+Reference for semantics: the per-receiver tally of node.ts:52-69 / 88-98 —
+counts[t, r, c] = #{s : mask[t, r, s] and alive[t, s] and sent[t, s] == c}.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..config import VAL0, VAL1, VALQ
+
+#: Receiver-tile height; 128 matches the MXU systolic dimension.
+TILE_R = 128
+#: Lane width of the padded class axis (only the first 3 columns are live).
+LANES = 128
+
+
+def _tally_kernel(mask_ref, sent_ref, alive_ref, out_ref):
+    """One (trial, receiver-tile) grid step.
+
+    mask_ref:  bool [1, TILE_R, S]   this tile's delivery mask
+    sent_ref:  int8 [1, S]           all senders' values (this trial)
+    alive_ref: bool [1, S]           sender liveness (this trial)
+    out_ref:   f32  [1, TILE_R, LANES]
+    """
+    mask = mask_ref[0].astype(jnp.float32)                  # [TILE_R, S]
+    sent = sent_ref[0]                                      # [S]
+    alive = alive_ref[0]
+    s = sent.shape[0]
+    # one-hot [S, LANES]: column c in {0,1,2} is (sent == c) & alive
+    class_ids = jax.lax.broadcasted_iota(jnp.int8, (s, LANES), 1)
+    onehot = ((sent[:, None] == class_ids) & alive[:, None] &
+              (class_ids < 3)).astype(jnp.float32)
+    out_ref[0] = jnp.dot(mask, onehot,
+                         preferred_element_type=jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def dense_counts_pallas(mask: jax.Array, sent: jax.Array, alive: jax.Array,
+                        interpret: bool = False) -> jax.Array:
+    """Drop-in replacement for ops.tally.dense_counts.
+
+    mask: bool [T, R, S]; sent: int8 [T, S]; alive: bool [T, S]
+    -> int32 [T, R, 3].
+    """
+    T, R, S = mask.shape
+    r_pad = (-R) % TILE_R
+    if r_pad:
+        mask = jnp.pad(mask, ((0, 0), (0, r_pad), (0, 0)))
+    rp = R + r_pad
+
+    grid = (T, rp // TILE_R)
+    out = pl.pallas_call(
+        _tally_kernel,
+        out_shape=jax.ShapeDtypeStruct((T, rp, LANES), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, TILE_R, S), lambda t, i: (t, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S), lambda t, i: (t, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, S), lambda t, i: (t, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, TILE_R, LANES), lambda t, i: (t, i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(mask, sent, alive)
+    return out[:, :R, :3].astype(jnp.int32)

@@ -99,6 +99,11 @@ def receiver_counts(cfg: SimConfig, base_key: jax.Array, r: jax.Array,
         mask = scheduler.quorum_delivery_mask(cfg, base_key, r, phase,
                                               sent_g, alive_g,
                                               trial_ids, node_ids)
+        if cfg.use_pallas:
+            from .pallas_tally import dense_counts_pallas
+            return dense_counts_pallas(
+                mask, sent_g, alive_g,
+                interpret=jax.default_backend() != "tpu")
         return dense_counts(mask, sent_g, alive_g)
 
     # histogram path, uniform scheduler

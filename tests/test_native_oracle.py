@@ -1,0 +1,96 @@
+"""Native C++ oracle: bit-exact differential testing vs the Python oracle.
+
+The native oracle replays the exact event-loop semantics (same FIFO, same
+quirks, same CPython-MT19937 coin stream), so for any (seed, scenario) the
+two oracles must produce IDENTICAL final states — not just statistically
+similar ones.
+"""
+
+import numpy as np
+import pytest
+
+from benor_tpu.api import launch_network
+from benor_tpu.backends.native_oracle import native_available
+from benor_tpu.config import SimConfig
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="g++ unavailable; native oracle not built")
+
+
+def _mt_reference_check():
+    """CPython-MT19937 parity spot check, independent of the oracle."""
+    import ctypes
+    import random
+    # drive the C++ stream indirectly through a 1-node run is awkward;
+    # instead check Python's stream has the documented first value for
+    # seed 42 (guards against interpreter changes breaking the contract)
+    r = random.Random(42)
+    assert abs(r.random() - 0.6394267984578837) < 1e-15
+
+
+SCENARIOS = [
+    # (n, f, seed, values, faulty) — the §4 matrix shapes + stress shapes
+    (5, 0, 0, [1] * 5, [False] * 5),
+    (5, 1, 1, [1, 1, 1, 0, 0], [False] * 4 + [True]),
+    (9, 4, 2, [1, 0, 1, 0, 1, 0, 1, 1, 0],
+     [True, True, False, False, True, False, False, False, True]),
+    (10, 5, 3, [1, 0] * 5, [True] * 5 + [False] * 5),   # livelock F=N/2
+    (7, 2, 4, [0, 1, 1, 0, 1, 0, 1],
+     [True, False, True, False, False, False, False]),
+    (1, 0, 5, [1], [False]),                            # N=1
+    (30, 9, 6, [i % 2 for i in range(30)],
+     [True] * 9 + [False] * 21),
+]
+
+
+@pytest.mark.parametrize("n,f,seed,values,faulty", SCENARIOS)
+def test_native_matches_python_oracle_exactly(n, f, seed, values, faulty):
+    _mt_reference_check()
+    nets = {}
+    for backend in ("express", "native"):
+        net = launch_network(n, f, values, faulty, backend=backend,
+                             seed=seed, max_rounds=12)
+        net.start()
+        nets[backend] = net.get_states()
+    assert nets["express"] == nets["native"]
+
+
+def test_native_large_n_runs_fast():
+    """N=300: ~1e5+ messages/round — impractical interpreted, fast native."""
+    import time
+    n, f = 300, 90
+    values = [i % 2 for i in range(n)]
+    faulty = [True] * f + [False] * (n - f)
+    net = launch_network(n, f, values, faulty, backend="native", seed=9,
+                         max_rounds=12)
+    t0 = time.perf_counter()
+    net.start()
+    dt = time.perf_counter() - t0
+    states = net.get_states()
+    healthy = [s for s in states if s["decided"] is not None]
+    assert all(s["decided"] for s in healthy)
+    vals = {s["x"] for s in healthy}
+    assert len(vals) == 1, f"disagreement: {vals}"
+    assert dt < 30, f"native oracle too slow: {dt:.1f}s"
+
+
+def test_native_step_cap_raises():
+    net = launch_network(5, 0, [1] * 5, [False] * 5, backend="native",
+                         seed=0)
+    net._step_cap = 3
+    with pytest.raises(RuntimeError, match="step cap"):
+        net.start()
+
+
+def test_native_parity_api_surface():
+    net = launch_network(3, 1, [1, 1, 0], [True, False, False],
+                         backend="native", seed=0)
+    assert net.status(0) == ("faulty", 500)
+    assert net.status(1) == ("live", 200)
+    assert net.get_state(0) == {"killed": True, "x": None, "decided": None,
+                                "k": None}
+    net.start()
+    net.stop_node(1)
+    assert net.status(1) == ("faulty", 500)
+    net.stop()
+    assert net.status(2) == ("faulty", 500)
