@@ -28,7 +28,8 @@ import numpy as np
 from ..config import SimConfig
 from ..state import FaultSpec, NetState
 
-_FORMAT_VERSION = 1
+# v2: added key_data (the run's base PRNG key) to the payload.
+_FORMAT_VERSION = 2
 
 
 def save_checkpoint(path: str, cfg: SimConfig, state: NetState,
