@@ -61,8 +61,10 @@ class SimConfig:
     delivery: str = "all"
     # subset selection when delivery == 'quorum':
     # 'uniform':     uniformly random N-F subset of live senders per receiver
-    # 'biased':      delay-bounded split adversary (dense path only; strength
-    #                set by adversary_strength)
+    # 'biased':      split adversary delaying starved-class edges by
+    #                adversary_strength.  Dense path: any strength;
+    #                histogram path: strength >= 1 (strict priority, exact
+    #                at histogram level).
     # 'adversarial': worst-case count-controlling adversary — forces tied
     #                0/1 tallies at every receiver (both paths)
     scheduler: str = "uniform"

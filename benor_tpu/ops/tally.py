@@ -106,15 +106,72 @@ def receiver_counts(cfg: SimConfig, base_key: jax.Array, r: jax.Array,
                 interpret=jax.default_backend() != "tpu")
         return dense_counts(mask, sent_g, alive_g)
 
-    # histogram path, uniform scheduler
-    if cfg.scheduler == "biased":
-        raise NotImplementedError(
-            "scheduler='biased' needs per-edge delays (dense path); use "
-            "path='dense' or the count-controlling scheduler='adversarial'")
+    # histogram path
     hist = class_histogram(sent, alive, ctx)
     u0 = rng.grid_uniforms(base_key, r, phase, trial_ids, node_ids)
     u1 = rng.grid_uniforms(base_key, r, phase + 16, trial_ids, node_ids)
+    if cfg.scheduler == "biased":
+        if cfg.adversary_strength < 1.0:
+            raise NotImplementedError(
+                "histogram path supports the biased scheduler only at "
+                "adversary_strength >= 1 (strict priority, exact at "
+                "histogram level); fractional delay bias needs per-edge "
+                "delays — use path='dense', or scheduler='adversarial' for "
+                "the unbounded worst case")
+        return biased_priority_counts(u0, hist, cfg.quorum, node_ids)
     return sampling.multivariate_hypergeom_counts(u0, u1, hist, cfg.quorum)
+
+
+def biased_priority_counts(u0: jax.Array, hist: jax.Array,
+                           m: int, node_ids: jax.Array) -> jax.Array:
+    """Histogram-level biased scheduler at strength >= 1 (strict priority).
+
+    The dense biased scheduler adds ``adversary_strength`` to the delays of
+    edges carrying the value the receiver's parity class is starved of
+    (ops/scheduler.py): even receivers' 1-carrying edges, odd receivers'
+    0-carrying edges.  At strength >= 1 every favored delay (U[0,1]) sorts
+    strictly before every starved delay (U[s, 1+s], s >= 1), so the tallied
+    multiset is EXACTLY: all m from the favored classes if they suffice,
+    else all favored plus a uniform without-replacement fill from the
+    starved class.  Within the favored classes the selection is unbiased, so
+    the class split is plain (exact/approx) hypergeometric — reusing
+    ops/sampling.py.  KS-tested against the dense path.
+
+    u0: float32 [T, N] per-lane uniforms (the starved fill is deterministic,
+    so one draw suffices); hist: int32 [T, 3] global (c0, c1, cq);
+    node_ids: global receiver ids [N] (parity decides the starved class).
+    Returns int32 [T, N, 3] summing to m.
+    """
+    c0, c1, cq = hist[:, 0:1], hist[:, 1:2], hist[:, 2:3]   # [T, 1]
+    even = (node_ids % 2 == 0)[None, :]                     # [1, N]
+    starved_c = jnp.where(even, c1, c0)                     # [T, N]
+    fav_val = jnp.where(even, c0, c1)     # favored value-class count
+    fav_total = fav_val + cq
+    n_fav = jnp.minimum(fav_total, m)                       # favored taken
+    # cap by the starved population: alive >= N-F guarantees the cap is
+    # loose today, but a future fault model must not report phantom sends
+    n_starved = jnp.minimum(m - n_fav, starved_c)           # starved fill
+    # unbiased split of n_fav between the favored value-class and "?"
+    h_favval = sampling.hypergeom_normal_approx(
+        u0, fav_total, fav_val, n_fav,
+        skew_correct=(m > sampling.EXACT_TABLE_MAX))
+    # exact regime: replace the approx with the shared-table sampler when
+    # parameters are trial-global (they are: fav_total/fav_val depend only
+    # on (trial, parity)); two parity classes -> two exact tables.
+    if m <= sampling.EXACT_TABLE_MAX:
+        h_even = sampling.hypergeom_exact_shared(
+            u0, (c0 + cq)[:, 0], c0[:, 0], m)   # capped below
+        h_odd = sampling.hypergeom_exact_shared(
+            u0, (c1 + cq)[:, 0], c1[:, 0], m)
+        # the exact tables sample n=m draws; when fav_total < m the actual
+        # draw count is fav_total — fall back to the per-lane approx there
+        full_fav = fav_total >= m
+        h_exact = jnp.where(even, h_even, h_odd)
+        h_favval = jnp.where(full_fav, h_exact, h_favval)
+    hq = n_fav - h_favval
+    h0 = jnp.where(even, h_favval, n_starved)
+    h1 = jnp.where(even, n_starved, h_favval)
+    return jnp.stack([h0, h1, hq], axis=-1)
 
 
 def adversarial_counts(hist: jax.Array, m: int) -> jax.Array:

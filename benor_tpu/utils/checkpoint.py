@@ -68,7 +68,10 @@ def load_checkpoint(path: str):
     """Load a checkpoint; returns (cfg, state, faults, next_round, base_key)."""
     with np.load(path, allow_pickle=False) as z:
         version = int(z["version"])
-        if version != _FORMAT_VERSION:
+        # v1 archives that already carry key_data (written during the
+        # pre-version-bump window) are fully loadable; bare v1 is not.
+        if version != _FORMAT_VERSION and not (
+                version == 1 and "key_data" in z.files):
             raise ValueError(f"unsupported checkpoint version {version}")
         raw = json.loads(bytes(z["config_json"]).decode())
         if raw.get("mesh_shape") is not None:

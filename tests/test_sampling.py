@@ -119,6 +119,60 @@ def _rounds_to_decide(path: str, seed: int, trials: int = 192) -> np.ndarray:
     return k[decided].ravel()
 
 
+class TestBiasedPriorityCounts:
+    """Histogram-level biased scheduler (strength >= 1, strict priority)."""
+
+    def test_counts_invariants(self):
+        from benor_tpu.ops import rng as _rng
+        from benor_tpu.ops.tally import biased_priority_counts
+        T, N, m = 4, 32, 20
+        hist = jnp.tile(jnp.array([[12, 10, 6]], jnp.int32), (T, 1))
+        u0 = jax.random.uniform(jax.random.key(7), (T, N))
+        u1 = jax.random.uniform(jax.random.key(8), (T, N))
+        out = np.asarray(biased_priority_counts(u0, hist, m, _rng.ids(N)))
+        np.testing.assert_array_equal(out.sum(-1), m)
+        assert out.min() >= 0
+        # even receivers: favored = {0, ?} = 18 < m=20 -> all favored taken,
+        # exactly 2 starved 1s leak through; odd receivers: favored
+        # {1, ?} = 16 -> 4 starved 0s leak
+        even = out[:, 0::2]
+        odd = out[:, 1::2]
+        np.testing.assert_array_equal(even[..., 0], 12)
+        np.testing.assert_array_equal(even[..., 2], 6)
+        np.testing.assert_array_equal(even[..., 1], 2)
+        np.testing.assert_array_equal(odd[..., 1], 10)
+        np.testing.assert_array_equal(odd[..., 0], 4)
+
+    @staticmethod
+    def _stats(path, seed):
+        from benor_tpu.sweep import run_point
+        from benor_tpu.config import SimConfig
+        cfg = SimConfig(n_nodes=80, n_faulty=24, trials=128, max_rounds=32,
+                        delivery="quorum", scheduler="biased",
+                        adversary_strength=1.5, path=path, seed=seed)
+        pt = run_point(cfg)
+        return pt.decided_frac, pt.mean_k, pt.ones_frac
+
+    def test_dense_histogram_agree_statistically(self):
+        """Both paths implement the same strict-priority adversary: their
+        MC-aggregate behavior must match (different RNG realizations, so
+        statistical, not bitwise)."""
+        d = self._stats("dense", 31)
+        h = self._stats("histogram", 32)
+        assert abs(d[0] - h[0]) < 0.1, f"decided_frac {d[0]} vs {h[0]}"
+        assert abs(d[1] - h[1]) < 0.5, f"mean_k {d[1]} vs {h[1]}"
+        assert abs(d[2] - h[2]) < 0.15, f"ones_frac {d[2]} vs {h[2]}"
+
+    def test_fractional_strength_rejected_on_histogram(self):
+        from benor_tpu.config import SimConfig
+        from benor_tpu.sim import simulate
+        cfg = SimConfig(n_nodes=16, n_faulty=4, trials=2, path="histogram",
+                        delivery="quorum", scheduler="biased",
+                        adversary_strength=0.5)
+        with pytest.raises(NotImplementedError, match="strength >= 1"):
+            simulate(cfg, [1] * 16, [True] * 4 + [False] * 12)
+
+
 class TestPathParity:
     """Two-sample KS: dense (exact) vs histogram (sampled) rounds-to-decide."""
 
