@@ -109,6 +109,12 @@ class SimConfig:
     # with 'express', ~100x faster drain loop, for large-N differential
     # testing).
     backend: str = "tpu"
+    # Message-delivery serialization for the event-loop oracles.  The
+    # reference's fire-and-forget fetches make ANY interleaving legal
+    # (SURVEY §5.8); 'fifo' is the canonical one (and what the native
+    # oracle implements), 'shuffle' replays a seeded random interleaving —
+    # protocol properties must hold under both.
+    oracle_order: str = "fifo"
     debug: bool = False               # enable host-callback tracing / profiling
 
     def __post_init__(self) -> None:
@@ -130,6 +136,8 @@ class SimConfig:
             raise ValueError(f"unknown fault_model: {self.fault_model}")
         if self.backend not in ("tpu", "express", "native"):
             raise ValueError(f"unknown backend: {self.backend}")
+        if self.oracle_order not in ("fifo", "shuffle"):
+            raise ValueError(f"unknown oracle_order: {self.oracle_order}")
 
     @property
     def quorum(self) -> int:
