@@ -18,7 +18,17 @@ behavioral quirks (SURVEY §2.1) are preserved deliberately:
     quirk 5 / sub-behavior 5e),
   * faulty nodes are crash-from-birth with all-null state (node.ts:21-26).
 
-No HTTP, no threads: deterministic given (seed, scenario).
+No HTTP, no threads: deterministic given (seed, scenario, oracle_order).
+
+Delivery order (``cfg.oracle_order``): the reference's fire-and-forget
+fetches (node.ts:72-80) make EVERY interleaving of in-flight messages a
+legal execution, so the oracle supports two seeded serializations —
+'fifo' (queue order, the canonical event-loop schedule) and 'shuffle'
+(each step delivers a uniformly random pending message, drawn from a
+dedicated PRNG stream so the protocol's coin stream is unaffected).
+Protocol properties must hold under both; the native C++ oracle implements
+the identical algorithm and RNG, so traces are bit-equal across languages
+for either order.
 """
 
 from __future__ import annotations
@@ -132,7 +142,15 @@ class ExpressNetwork:
         self.f = f
         self.max_rounds = cfg.max_rounds
         self.rng = random.Random(cfg.seed)
-        self.queue: deque = deque()
+        self.order = cfg.oracle_order
+        if self.order == "shuffle":
+            # Dedicated delivery stream (seed derivation shared with the C++
+            # oracle) so scheduling draws never perturb the coin stream.
+            self.delivery_rng = random.Random((cfg.seed ^ 0x9E3779B9)
+                                              & 0xFFFFFFFF)
+            self.queue: list = []   # swap-pop bag; order is random anyway
+        else:
+            self.queue = deque()
         self._halt_pending = False
         self._started = False
         # Worst-case message volume per round is O(N^2) broadcasts (quirk-8
@@ -207,14 +225,23 @@ class ExpressNetwork:
     # -- the event loop --------------------------------------------------
     def _drain(self) -> None:
         steps = 0
-        while self.queue:
+        q = self.queue
+        shuffle = self.order == "shuffle"
+        while q:
             if steps >= self._step_cap:
                 raise RuntimeError(
                     f"express oracle exceeded its step cap ({self._step_cap} "
                     f"deliveries) before settling — results would be "
                     f"truncated mid-protocol; raise step_cap or lower "
                     f"max_rounds/N")
-            dest, k, x, mtype = self.queue.popleft()
+            if shuffle:
+                # uniformly random pending message via swap-pop (identical
+                # algorithm + RNG consumption as the C++ oracle's drain)
+                j = self.delivery_rng.randrange(len(q))
+                q[j], q[-1] = q[-1], q[j]
+                dest, k, x, mtype = q.pop()
+            else:
+                dest, k, x, mtype = q.popleft()
             self.nodes[dest].on_message(k, x, mtype)
             if self._halt_pending:
                 self._run_halt_probe()

@@ -101,8 +101,12 @@ class NodeHttpCluster:
             raise
 
     def serve(self) -> "NodeHttpCluster":
+        """Start the listener threads (idempotent: ``serve_network`` already
+        serves, and entering the result as a context manager must not try to
+        start the threads a second time)."""
         for t in self.threads:
-            t.start()
+            if t.ident is None:        # never started
+                t.start()
         return self
 
     def stop_all(self) -> None:

@@ -53,12 +53,13 @@ def load_library() -> ctypes.CDLL:
         lib.benor_express_run.argtypes = [
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,   # n, f, max_r
             ctypes.c_uint32, ctypes.c_int64,                  # seed, cap
+            ctypes.c_uint8,                                   # order
             np.ctypeslib.ndpointer(np.int8, flags="C_CONTIGUOUS"),
             np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
             np.ctypeslib.ndpointer(np.int8, flags="C_CONTIGUOUS"),
             np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
             np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
-            np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),  # in/out
         ]
         _lib = lib
         return lib
@@ -116,9 +117,13 @@ class NativeExpressNetwork:
             return
         self._started = True
         lib = load_library()
+        # _killed is an in/out buffer: pre-start stop()/stop_node() calls
+        # are honored as the initial killed mask (parity with the Python
+        # oracle, where a pre-start stop changes the consensus outcome).
         steps = lib.benor_express_run(
             self.n, self.f, self.cfg.max_rounds, self.cfg.seed,
-            self._step_cap, self._vals, self._faulty, self._x,
+            self._step_cap, 1 if self.cfg.oracle_order == "shuffle" else 0,
+            self._vals, self._faulty, self._x,
             self._decided, self._k, self._killed)
         if steps < 0:
             raise RuntimeError(

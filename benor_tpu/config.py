@@ -62,9 +62,10 @@ class SimConfig:
     # subset selection when delivery == 'quorum':
     # 'uniform':     uniformly random N-F subset of live senders per receiver
     # 'biased':      split adversary delaying starved-class edges by
-    #                adversary_strength.  Dense path: any strength;
-    #                histogram path: strength >= 1 (strict priority, exact
-    #                at histogram level).
+    #                adversary_strength.  Any strength on both paths: the
+    #                dense path races per-edge delays; the histogram path is
+    #                exact strict priority at strength >= 1 and the
+    #                uniform-race model (ops/sampling.py) at 0 < s < 1.
     # 'adversarial': worst-case count-controlling adversary — forces tied
     #                0/1 tallies at every receiver (both paths)
     scheduler: str = "uniform"
@@ -111,9 +112,11 @@ class SimConfig:
     backend: str = "tpu"
     # Message-delivery serialization for the event-loop oracles.  The
     # reference's fire-and-forget fetches make ANY interleaving legal
-    # (SURVEY §5.8); 'fifo' is the canonical one (and what the native
-    # oracle implements), 'shuffle' replays a seeded random interleaving —
-    # protocol properties must hold under both.
+    # (SURVEY §5.8); 'fifo' delivers in queue order (the canonical
+    # event-loop schedule), 'shuffle' delivers a uniformly random pending
+    # message each step from a dedicated seeded stream.  Both oracles
+    # (Python and C++) implement both orders bit-identically; protocol
+    # properties must hold under both (tests/test_scenarios.py).
     oracle_order: str = "fifo"
     debug: bool = False               # enable host-callback tracing / profiling
 

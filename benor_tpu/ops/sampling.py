@@ -115,6 +115,62 @@ def hypergeom_normal_approx(u: jax.Array, total: jax.Array, good: jax.Array,
     return jnp.clip(draw, lo, hi).astype(jnp.int32)
 
 
+def uniform_race_favored_count(u: jax.Array, nf: jax.Array, ns: jax.Array,
+                               m: int, s: float) -> jax.Array:
+    """#favored among the m smallest of a two-population uniform delay race.
+
+    The dense biased scheduler (ops/scheduler.py) gives favored edges delays
+    ~ U[0, 1) and starved edges ~ U[s, 1+s); a receiver tallies the m
+    smallest.  The favored count J has an exact mean-field solution because
+    both delay CDFs are piecewise linear: with threshold tau solving
+    nf*F_f(tau) + ns*F_s(tau) = m,
+
+        F_f(t) = clip(t, 0, 1),  F_s(t) = clip(t - s, 0, 1),
+
+    tau has three closed-form regimes (before the starved window opens; in
+    the competition window; all favored exhausted).  Fluctuations come from
+    the delta method on the counting processes: with densities lam_f =
+    nf*f_f(tau), lam_s = ns*f_s(tau) and binomial variances sig2_f/sig2_s at
+    tau,  Var(J) = (lam_s^2 sig2_f + lam_f^2 sig2_s) / (lam_f + lam_s)^2 —
+    which correctly degenerates to 0 when either population's density
+    vanishes at tau (validated against brute-force races over the regime
+    grid in tests/test_sampling.py).
+
+    u: per-lane uniforms [...]; nf/ns: int32 population sizes broadcastable
+    to u; m: static draw count; s: strength in (0, 1).
+    Returns int32 J in [max(0, m-ns), min(nf, m)]; when nf + ns < m
+    (deliverable messages short of the quorum) returns nf (all favored).
+    """
+    nf_f = nf.astype(jnp.float32)
+    ns_f = ns.astype(jnp.float32)
+    m_f = jnp.float32(m)
+    safe_nf = jnp.maximum(nf_f, 1e-6)
+    safe_ns = jnp.maximum(ns_f, 1e-6)
+    # threshold regimes (each guard also keeps the previous regime's tau)
+    tau = m_f / safe_nf                                   # m <= nf*s
+    tau2 = (m_f + ns_f * s) / jnp.maximum(nf_f + ns_f, 1e-6)
+    tau = jnp.where(m_f > nf_f * s, tau2, tau)            # competition window
+    tau3 = s + (m_f - nf_f) / safe_ns
+    tau = jnp.where(tau2 > 1.0, tau3, tau)                # favored exhausted
+    ff = jnp.clip(tau, 0.0, 1.0)
+    fs = jnp.clip(tau - s, 0.0, 1.0)
+    mu = nf_f * ff
+    # delta-method variance of the favored count at the threshold (closed
+    # upper interval ends: at a saturating tau the clip below keeps the
+    # distribution one-sided, matching the true truncation)
+    lam_f = nf_f * ((tau > 0.0) & (tau <= 1.0))
+    lam_s = ns_f * ((tau > s) & (tau <= 1.0 + s))
+    sig2_f = nf_f * ff * (1.0 - ff)
+    sig2_s = ns_f * fs * (1.0 - fs)
+    denom = jnp.maximum((lam_f + lam_s) ** 2, 1e-6)
+    var = (lam_s ** 2 * sig2_f + lam_f ** 2 * sig2_s) / denom
+    z = jax.scipy.special.ndtri(jnp.clip(u, 1e-7, 1 - 1e-7))
+    draw = jnp.round(mu + z * jnp.sqrt(var))
+    hi = jnp.minimum(nf_f, m_f)
+    lo = jnp.minimum(jnp.maximum(0.0, m_f - ns_f), hi)
+    return jnp.clip(draw, lo, hi).astype(jnp.int32)
+
+
 def multivariate_hypergeom_counts(u0: jax.Array, u1: jax.Array,
                                   class_counts: jax.Array, m: int) -> jax.Array:
     """Sample per-lane tallied class counts (h0, h1, hq) without replacement.

@@ -10,10 +10,11 @@ schedulers, selected by ``SimConfig.scheduler``:
   biased:       uniform delays plus ``adversary_strength`` added to edges
                 whose message carries the value the receiver's parity class
                 is being starved of — a *delay-bounded* adversary whose
-                power is limited by quorum overlap.  Fractional strengths
-                need the per-edge delays built here (dense path); at
-                strength >= 1 the bias is strict priority and the histogram
-                path implements it exactly (tally.biased_priority_counts).
+                power is limited by quorum overlap.  The histogram path
+                mirrors this at any strength: strict priority (exact) at
+                strength >= 1 (tally.biased_priority_counts), the
+                uniform-race model at 0 < s < 1
+                (tally.biased_fractional_counts).
   adversarial:  the worst-case *count-controlling* adversary — handled in
                 ops/tally.py (both paths): every receiver tallies a multiset
                 whose 0/1 counts tie, so phase-1 yields "?" and private-coin

@@ -101,9 +101,11 @@ def receiver_counts(cfg: SimConfig, base_key: jax.Array, r: jax.Array,
                                               trial_ids, node_ids)
         if cfg.use_pallas:
             from .pallas_tally import dense_counts_pallas
+            # compile for any accelerator (the axon TPU plugin reports
+            # platform 'axon'); interpret only on plain CPU
             return dense_counts_pallas(
                 mask, sent_g, alive_g,
-                interpret=jax.default_backend() != "tpu")
+                interpret=jax.default_backend() == "cpu")
         return dense_counts(mask, sent_g, alive_g)
 
     # histogram path
@@ -111,14 +113,12 @@ def receiver_counts(cfg: SimConfig, base_key: jax.Array, r: jax.Array,
     u0 = rng.grid_uniforms(base_key, r, phase, trial_ids, node_ids)
     u1 = rng.grid_uniforms(base_key, r, phase + 16, trial_ids, node_ids)
     if cfg.scheduler == "biased":
-        if cfg.adversary_strength < 1.0:
-            raise NotImplementedError(
-                "histogram path supports the biased scheduler only at "
-                "adversary_strength >= 1 (strict priority, exact at "
-                "histogram level); fractional delay bias needs per-edge "
-                "delays — use path='dense', or scheduler='adversarial' for "
-                "the unbounded worst case")
-        return biased_priority_counts(u0, hist, cfg.quorum, node_ids)
+        if cfg.adversary_strength >= 1.0:
+            return biased_priority_counts(u0, hist, cfg.quorum, node_ids)
+        if cfg.adversary_strength > 0.0:
+            return biased_fractional_counts(
+                cfg.adversary_strength, u0, u1, hist, cfg.quorum, node_ids)
+        # strength 0: the dense scheduler adds no delay — plain uniform
     return sampling.multivariate_hypergeom_counts(u0, u1, hist, cfg.quorum)
 
 
@@ -171,6 +171,41 @@ def biased_priority_counts(u0: jax.Array, hist: jax.Array,
     hq = n_fav - h_favval
     h0 = jnp.where(even, h_favval, n_starved)
     h1 = jnp.where(even, n_starved, h_favval)
+    return jnp.stack([h0, h1, hq], axis=-1)
+
+
+def biased_fractional_counts(s: float, u_race: jax.Array, u_split: jax.Array,
+                             hist: jax.Array, m: int,
+                             node_ids: jax.Array) -> jax.Array:
+    """Histogram-level biased scheduler at fractional strength 0 < s < 1.
+
+    Models the dense per-edge delay race (ops/scheduler.py: favored edges
+    U[0,1), starved edges U[s, 1+s)) per (trial, receiver) lane with the
+    exact two-population uniform-race sampler
+    (sampling.uniform_race_favored_count): closed-form piecewise-linear
+    mean-field threshold + delta-method fluctuation.
+
+    Limits: s -> 0 recovers the uniform hypergeometric; s -> 1 recovers
+    biased_priority_counts (strict priority).  The within-favored split
+    (favored value vs "?") stays uniform — delays are iid across favored
+    edges — so it is plain hypergeometric, like the strict path.
+    MC-aggregate-tested against the dense path (tests/test_sampling.py).
+
+    u_race/u_split: float32 [T, N] independent per-lane uniforms;
+    hist: int32 [T, 3] global (c0, c1, cq); returns int32 [T, N, 3].
+    """
+    c0, c1, cq = hist[:, 0:1], hist[:, 1:2], hist[:, 2:3]   # [T, 1]
+    even = (node_ids % 2 == 0)[None, :]                     # [1, N]
+    fav_val = jnp.where(even, c0, c1)                       # [T, N]
+    starved_c = jnp.where(even, c1, c0)
+    n_fav = fav_val + cq
+    j = sampling.uniform_race_favored_count(u_race, n_fav, starved_c, m, s)
+    k_starved = jnp.minimum(m - j, starved_c)               # starved taken
+    # unbiased split of j between the favored value-class and "?"
+    h_favval = sampling.hypergeom_normal_approx(u_split, n_fav, fav_val, j)
+    hq = j - h_favval
+    h0 = jnp.where(even, h_favval, k_starved)
+    h1 = jnp.where(even, k_starved, h_favval)
     return jnp.stack([h0, h1, hq], axis=-1)
 
 

@@ -48,6 +48,18 @@ class PyMT19937 {
     return (a * 67108864.0 + b) * (1.0 / 9007199254740992.0);
   }
 
+  // CPython Random.getrandbits(k) for k <= 32.
+  uint32_t getrandbits(int k) { return genrand() >> (32 - k); }
+
+  // CPython Random._randbelow_with_getrandbits(n): rejection sampling over
+  // n.bit_length() bits — matches random.Random.randrange(n) draw-for-draw.
+  uint32_t randbelow(uint32_t n) {
+    int k = 32 - __builtin_clz(n);  // bit_length; caller ensures n >= 1
+    uint32_t r = getrandbits(k);
+    while (r >= n) r = getrandbits(k);
+    return r;
+  }
+
  private:
   static constexpr int N = 624;
   static constexpr int M = 397;
@@ -134,8 +146,12 @@ struct Message {
 struct Oracle {
   int32_t n, f, max_rounds;
   int64_t step_cap;
-  PyMT19937 rng;
-  std::deque<Message> queue;
+  bool shuffle;        // delivery order: false = fifo, true = seeded shuffle
+  PyMT19937 rng;       // the protocol coin stream (node.ts:111)
+  PyMT19937 drng;      // delivery-order stream (seed derivation matches
+                       // backends/express.py: (seed ^ 0x9E3779B9) & 2^32-1)
+  std::deque<Message> queue;  // fifo order
+  std::vector<Message> bag;   // shuffle order: swap-pop bag
   bool halt_pending = false;
 
   std::vector<uint8_t> killed, is_faulty, decided;
@@ -152,12 +168,17 @@ struct Oracle {
   std::vector<std::vector<Tally>> proposals, votes;  // [node][round]
 
   Oracle(int32_t n_, int32_t f_, int32_t max_rounds_, uint32_t seed,
-         int64_t step_cap_, const int8_t *vals, const uint8_t *faulty)
+         int64_t step_cap_, uint8_t order, const int8_t *vals,
+         const uint8_t *faulty, const uint8_t *initial_killed)
       : n(n_), f(f_), max_rounds(max_rounds_), step_cap(step_cap_),
-        rng(seed), killed(n_), is_faulty(faulty, faulty + n_), decided(n_),
+        shuffle(order != 0), rng(seed), drng((seed ^ 0x9E3779B9U)),
+        killed(n_), is_faulty(faulty, faulty + n_), decided(n_),
         x(n_), k(n_, 0), proposals(n_), votes(n_) {
     for (int32_t i = 0; i < n; i++) {
-      killed[i] = is_faulty[i];
+      // pre-start /stop calls arrive via initial_killed (a healthy node
+      // stopped before /start keeps its state but never participates —
+      // parity with the Python oracle's stop_node-before-start behavior)
+      killed[i] = is_faulty[i] | initial_killed[i];
       x[i] = is_faulty[i] ? -1 : vals[i];
       decided[i] = 0;
       if (is_faulty[i]) k[i] = -1;  // projected to null in the wrapper
@@ -168,7 +189,11 @@ struct Oracle {
 
   void broadcast(int32_t round, int8_t val, uint8_t phase) {
     if (round > max_rounds) return;  // round cap bounds livelock configs
-    for (int32_t i = 0; i < n; i++) queue.push_back({i, round, val, phase});
+    if (shuffle) {
+      for (int32_t i = 0; i < n; i++) bag.push_back({i, round, val, phase});
+    } else {
+      for (int32_t i = 0; i < n; i++) queue.push_back({i, round, val, phase});
+    }
   }
 
   static void bump(Tally &t, int8_t v) {
@@ -229,13 +254,26 @@ struct Oracle {
       }
     }
     int64_t steps = 0;
-    while (!queue.empty()) {
-      if (steps >= step_cap) return -1;
-      Message m = queue.front();
-      queue.pop_front();
-      on_message(m);
-      if (halt_pending) run_halt_probe();
-      steps++;
+    if (shuffle) {
+      while (!bag.empty()) {
+        if (steps >= step_cap) return -1;
+        uint32_t j = drng.randbelow(static_cast<uint32_t>(bag.size()));
+        std::swap(bag[j], bag.back());
+        Message m = bag.back();
+        bag.pop_back();
+        on_message(m);
+        if (halt_pending) run_halt_probe();
+        steps++;
+      }
+    } else {
+      while (!queue.empty()) {
+        if (steps >= step_cap) return -1;
+        Message m = queue.front();
+        queue.pop_front();
+        on_message(m);
+        if (halt_pending) run_halt_probe();
+        steps++;
+      }
     }
     return steps;
   }
@@ -246,19 +284,23 @@ struct Oracle {
 extern "C" {
 
 // Runs the full oracle; writes final per-node state into the out arrays.
+// `order`: 0 = fifo, 1 = seeded-shuffle delivery.  `killed_io` is in/out:
+// on entry the initial killed mask (faulty nodes plus any pre-start /stop
+// calls), on exit the final one.
 // Returns delivered-message count, or -1 if the safety step cap tripped.
 int64_t benor_express_run(int32_t n, int32_t f, int32_t max_rounds,
-                          uint32_t seed, int64_t step_cap,
+                          uint32_t seed, int64_t step_cap, uint8_t order,
                           const int8_t *initial_values,
                           const uint8_t *faulty, int8_t *out_x,
                           uint8_t *out_decided, int32_t *out_k,
-                          uint8_t *out_killed) {
-  Oracle o(n, f, max_rounds, seed, step_cap, initial_values, faulty);
+                          uint8_t *killed_io) {
+  Oracle o(n, f, max_rounds, seed, step_cap, order, initial_values, faulty,
+           killed_io);
   int64_t steps = o.start();
   std::memcpy(out_x, o.x.data(), n);
   std::memcpy(out_decided, o.decided.data(), n);
   std::memcpy(out_k, o.k.data(), n * sizeof(int32_t));
-  std::memcpy(out_killed, o.killed.data(), n);
+  std::memcpy(killed_io, o.killed.data(), n);
   return steps;
 }
 

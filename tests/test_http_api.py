@@ -75,3 +75,14 @@ class TestHttpParity:
             state = json.loads(_get(BASE, "/getState")[1])
             assert state == {"killed": True, "x": None,
                              "decided": None, "k": None}
+
+
+def test_serve_network_usable_as_context_manager():
+    """serve_network() returns an already-serving cluster; entering it as a
+    context manager must be a no-op start (regression: threads were started
+    twice -> RuntimeError)."""
+    from benor_tpu.backends.http_api import serve_network
+    net = launch_network(2, 0, [1, 1], [False, False], backend="tpu")
+    with serve_network(net, BASE + 50):
+        assert _get(BASE + 50, "/status") == (200, "live")
+    net.close()

@@ -43,16 +43,78 @@ SCENARIOS = [
 ]
 
 
+@pytest.mark.parametrize("order", ["fifo", "shuffle"])
 @pytest.mark.parametrize("n,f,seed,values,faulty", SCENARIOS)
-def test_native_matches_python_oracle_exactly(n, f, seed, values, faulty):
+def test_native_matches_python_oracle_exactly(n, f, seed, values, faulty,
+                                              order):
     _mt_reference_check()
     nets = {}
     for backend in ("express", "native"):
         net = launch_network(n, f, values, faulty, backend=backend,
-                             seed=seed, max_rounds=12)
+                             seed=seed, max_rounds=12, oracle_order=order)
         net.start()
         nets[backend] = net.get_states()
     assert nets["express"] == nets["native"]
+
+
+def test_shuffle_changes_delivery_order():
+    """The oracle_order flag must actually change the execution.
+
+    Final states alone cannot distinguish orders here: tally multisets are
+    permutation-invariant, so once plurality-adopt re-unanimizes x the
+    endpoint coincides.  The *delivery trace* is the honest observable —
+    record each (dest, k, x, phase) delivery and assert the interleavings
+    differ while both traces carry the same message multiset."""
+    from collections import Counter
+
+    from benor_tpu.backends.express import _ExpressNode
+
+    n, f = 9, 5                   # healthy = quorum = 4: ties -> coins
+    values = [1, 0, 1, 0, 1, 0, 0, 1, 1]
+    faulty = [True] * 5 + [False] * 4
+    traces = {}
+    orig = _ExpressNode.on_message
+    try:
+        for order in ("fifo", "shuffle"):
+            net = launch_network(n, f, values, faulty, backend="express",
+                                 seed=0, max_rounds=3, oracle_order=order)
+            trace = []
+
+            def rec(self, k, x, mt, _t=trace):
+                _t.append((self.node_id, k, x, mt))
+                return orig(self, k, x, mt)
+
+            _ExpressNode.on_message = rec
+            net.start()
+            _ExpressNode.on_message = orig
+            traces[order] = trace
+    finally:
+        _ExpressNode.on_message = orig
+    assert traces["fifo"] != traces["shuffle"]
+    # same deliveries, different interleaving (shuffle loses no messages)
+    assert Counter(t[:2] + t[3:] for t in traces["fifo"]) == \
+        Counter(t[:2] + t[3:] for t in traces["shuffle"])
+
+
+def test_native_pre_start_stop_matches_python():
+    """A healthy node stopped BEFORE /start must not participate (it keeps
+    its state but never broadcasts) — identically in both oracles.  With
+    node 4 (the only 0-holder among quorum members) silenced, the outcome
+    shifts, so divergence here is observable."""
+    n, f = 5, 1
+    values = [1, 1, 1, 0, 0]
+    faulty = [False, False, False, False, True]
+    finals = {}
+    for backend in ("express", "native"):
+        net = launch_network(n, f, values, faulty, backend=backend,
+                             seed=7, max_rounds=12)
+        net.stop_node(3)          # pre-start kill of a healthy node
+        net.start()
+        finals[backend] = net.get_states()
+    assert finals["express"] == finals["native"]
+    # the stopped node kept its state but was killed and never advanced
+    st = finals["native"][3]
+    assert st["killed"] is True and st["k"] == 0 and st["decided"] is False
 
 
 def test_native_large_n_runs_fast():

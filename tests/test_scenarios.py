@@ -14,6 +14,12 @@ from benor_tpu.api import (get_nodes_state, launch_network, reached_finality,
                            start_consensus, stop_consensus)
 
 BACKENDS = ["tpu", "express"]
+# The express oracle runs every scenario under BOTH legal delivery
+# serializations (cfg.oracle_order — the reference's fire-and-forget fetches
+# make any interleaving legal, SURVEY §5.8).  The tpu backend has no event
+# loop; its delivery model is the N9 scheduler, so order is moot there.
+BACKEND_ORDERS = [("tpu", "fifo"), ("express", "fifo"),
+                  ("express", "shuffle")]
 
 
 def _launch(faulty, values, backend, **kw):
@@ -52,7 +58,7 @@ class TestSetup:
         net.close()
 
 
-@pytest.mark.parametrize("backend", BACKENDS)
+@pytest.mark.parametrize("backend,order", BACKEND_ORDERS)
 class TestBenOr:
     """'Testing Ben-Or implementation' (test.ts:120-492)."""
 
@@ -62,10 +68,10 @@ class TestBenOr:
         assert state["x"] is None
         assert state["k"] is None
 
-    def test_unanimous_agreement(self, backend):
+    def test_unanimous_agreement(self, backend, order):
         # test.ts:133-175: N=5, F=0, all 1 -> all decide 1, k <= 2
         faulty = [False] * 5
-        net = _launch(faulty, [1] * 5, backend)
+        net = _launch(faulty, [1] * 5, backend, oracle_order=order)
         states = _run_to_finality(net)
         assert reached_finality(states)
         for st in states:
@@ -74,10 +80,10 @@ class TestBenOr:
             assert st["k"] <= 2
         net.close()
 
-    def test_simple_majority(self, backend):
+    def test_simple_majority(self, backend, order):
         # test.ts:179-223: N=5, F=1, vals 1,1,1,0,(0 faulty) -> decide 1, k <= 2
         faulty = [False, False, False, False, True]
-        net = _launch(faulty, [1, 1, 1, 0, 0], backend)
+        net = _launch(faulty, [1, 1, 1, 0, 0], backend, oracle_order=order)
         states = _run_to_finality(net)
         for st, f in zip(states, faulty):
             if f:
@@ -88,10 +94,10 @@ class TestBenOr:
                 assert st["k"] <= 2
         net.close()
 
-    def test_fault_tolerance_threshold(self, backend):
+    def test_fault_tolerance_threshold(self, backend, order):
         # test.ts:227-286: N=9, F=4, mixed -> all healthy decide, same value
         faulty = [True] * 4 + [False] * 5
-        net = _launch(faulty, [0, 0, 1, 1, 1, 0, 0, 1, 1], backend)
+        net = _launch(faulty, [0, 0, 1, 1, 1, 0, 0, 1, 1], backend, oracle_order=order)
         states = _run_to_finality(net)
         consensus = []
         for st, f in zip(states, faulty):
@@ -105,11 +111,11 @@ class TestBenOr:
         assert all(v == consensus[0] for v in consensus)
         net.close()
 
-    def test_exceeding_fault_tolerance_livelock(self, backend):
+    def test_exceeding_fault_tolerance_livelock(self, backend, order):
         # test.ts:292-345: N=10, F=5 -> healthy never decide, k > 10
         faulty = [True] * 5 + [False] * 5
         net = _launch(faulty, [0, 0, 1, 1, 1, 0, 0, 1, 1, 0], backend,
-                      max_rounds=15)
+                      max_rounds=15, oracle_order=order)
         states = _run_to_finality(net)
         for st, f in zip(states, faulty):
             if f:
@@ -120,10 +126,10 @@ class TestBenOr:
                 assert st["x"] is not None
         net.close()
 
-    def test_no_faulty_nodes(self, backend):
+    def test_no_faulty_nodes(self, backend, order):
         # test.ts:351-393: N=5, F=0, vals 0,1,0,1,1 -> all decide 1, k <= 2
         faulty = [False] * 5
-        net = _launch(faulty, [0, 1, 0, 1, 1], backend)
+        net = _launch(faulty, [0, 1, 0, 1, 1], backend, oracle_order=order)
         states = _run_to_finality(net)
         for st in states:
             assert st["decided"] is True
@@ -131,13 +137,13 @@ class TestBenOr:
             assert st["k"] <= 2
         net.close()
 
-    def test_randomized(self, backend):
+    def test_randomized(self, backend, order):
         # test.ts:399-450: N=7, F=2, random bits -> healthy all decide,
         # identical value
         rng = np.random.default_rng(42)
         faulty = [False, False, True, False, True, False, False]
         values = [int(v) for v in rng.integers(0, 2, size=7)]
-        net = _launch(faulty, values, backend)
+        net = _launch(faulty, values, backend, oracle_order=order)
         states = _run_to_finality(net)
         consensus = []
         for st, f in zip(states, faulty):
@@ -150,20 +156,20 @@ class TestBenOr:
         assert all(v == consensus[0] for v in consensus)
         net.close()
 
-    def test_one_node(self, backend):
+    def test_one_node(self, backend, order):
         # test.ts:454-486: N=1 decides its own value (self-broadcast,
         # quirk 6, makes the quorum of 1 reachable)
-        net = _launch([False], [1], backend)
+        net = _launch([False], [1], backend, oracle_order=order)
         states = _run_to_finality(net)
         assert len(states) == 1
         assert states[0]["decided"] is True
         assert states[0]["x"] == 1
         net.close()
 
-    def test_stop_consensus_kills_all(self, backend):
+    def test_stop_consensus_kills_all(self, backend, order):
         # consensus.ts:10-15 + node.ts:191-194: /stop flips killed
         faulty = [False] * 3
-        net = _launch(faulty, [1, 1, 1], backend)
+        net = _launch(faulty, [1, 1, 1], backend, oracle_order=order)
         start_consensus(net)
         stop_consensus(net)
         for i in range(3):
