@@ -16,10 +16,11 @@ Semantics notes:
     fixed point the reference's pollers converge to.
   * /stop kills only the receiving node (consensus.ts fans /stop out to all
     ports to stop the network, and so does ``stop_all``).
-  * POST /message is intentionally absent: peer messages are device-array
-    data movement, not RPCs (SURVEY §5.8); external injection would bypass
-    the deterministic scheduler.  The routes above are the ones the
-    reference's control plane and test harness actually consume.
+  * POST /message (node.ts:43-163) answers 405 with an explanation: peer
+    messages are device-array data movement, not RPCs (SURVEY §5.8);
+    external injection would bypass the deterministic scheduler.  The GET
+    routes above are the ones the reference's control plane and test
+    harness actually consume (PARITY.md, 'Deliberate non-parities').
 
 This layer exists for wire-level interop (curl, the reference's own test
 utilities pointed at localhost) at demo-scale N; in-process code should use
@@ -68,6 +69,30 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, "killed", as_json=False)
         elif self.path == "/getState":
             self._send(200, net.get_state(nid), as_json=True)
+        else:
+            self._send(404, {"error": f"no route {self.path}"}, as_json=True)
+
+    def do_POST(self):
+        # drain the request body first: replying with unread data pending
+        # makes the close an RST, which can discard the in-flight response
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        while length > 0:
+            chunk = self.rfile.read(min(length, 1 << 16))
+            if not chunk:
+                break
+            length -= len(chunk)
+        if self.path == "/message":
+            # Deliberate non-parity with node.ts:43-163 (see PARITY.md):
+            # peer messages are device-array data movement under the seeded
+            # N9 scheduler; accepting external injections would bypass it
+            # and break reproducibility.  405 spells that out on the wire.
+            self._send(405, {
+                "error": "message injection not supported",
+                "detail": "peer messages are simulated on-device under a "
+                          "deterministic seeded scheduler; this control "
+                          "plane serves /status /start /stop /getState "
+                          "(see PARITY.md, 'Deliberate non-parities')",
+            }, as_json=True)
         else:
             self._send(404, {"error": f"no route {self.path}"}, as_json=True)
 

@@ -53,6 +53,11 @@ def _local_run(cfg: SimConfig, state: NetState, faults: FaultSpec,
     def body(carry):
         r, st, _ = carry
         st = benor_round(cfg, st, faults, base_key, r, ctx)
+        if cfg.debug:  # per-round host callback (SURVEY §5.1) — globalized
+            # counts, emitted once per round by the (0, 0) shard; unordered
+            # (ordered effects unsupported on >1 device, see tracing.py)
+            from ..utils.tracing import emit_round_event
+            emit_round_event(st, ctx)
         return (r + 1, st, all_settled(st, ctx))
 
     def cond(carry):

@@ -45,17 +45,44 @@ def round_callback(r, n_decided, n_killed) -> None:
         sink(int(r), int(n_decided), int(n_killed))
 
 
-def emit_round_event(state) -> None:
+def emit_round_event(state, ctx=None) -> None:
     """Called from the jitted round loop when cfg.debug is set.
 
-    ``ordered=True`` threads a sequencing token through the loop so sinks
-    observe rounds in execution order even with async host dispatch; the
-    cost only exists when cfg.debug is set (otherwise nothing is traced in).
+    Single device: ``ordered=True`` threads a sequencing token through the
+    loop so sinks observe rounds in execution order even with async host
+    dispatch; the cost only exists when cfg.debug is set (otherwise nothing
+    is traced in).
+
+    Under ``shard_map`` (pass the kernel's ``ShardCtx``): counts are first
+    globalized with ``psum`` over every mesh axis, then exactly ONE shard —
+    mesh coordinate (0, 0) — emits the callback via ``lax.cond``, so sinks
+    see one event per round with network-global numbers, same as the
+    single-device path.  Limitation: ordered effects are unsupported on >1
+    device (jax raises "ordered effects are not supported for more than 1
+    device"), so the sharded emission is ``ordered=False`` — events carry
+    the round index and in practice arrive in order from the single emitting
+    shard, but cross-round ordering is best-effort, not guaranteed.
     """
     import jax.numpy as jnp
-    jax.debug.callback(round_callback, state.k.max(),
-                       jnp.sum(state.decided), jnp.sum(state.killed),
-                       ordered=True)
+    from jax import lax
+    if ctx is None or (ctx.trial_axis is None and ctx.node_axis is None):
+        jax.debug.callback(round_callback, state.k.max(),
+                           jnp.sum(state.decided), jnp.sum(state.killed),
+                           ordered=True)
+        return
+    k_max = lax.pmax(state.k.max(), tuple(
+        a for a in (ctx.trial_axis, ctx.node_axis) if a is not None))
+    n_dec = ctx.psum_all(jnp.sum(state.decided))
+    n_kil = ctx.psum_all(jnp.sum(state.killed))
+    is_origin = jnp.bool_(True)
+    for a in (ctx.trial_axis, ctx.node_axis):
+        if a is not None:
+            is_origin &= lax.axis_index(a) == 0
+    lax.cond(
+        is_origin,
+        lambda: jax.debug.callback(round_callback, k_max, n_dec, n_kil,
+                                   ordered=False),
+        lambda: None)
 
 
 @contextlib.contextmanager

@@ -67,6 +67,23 @@ class TestHttpParity:
         with NodeHttpCluster(net, BASE):
             assert _get(BASE, "/nope")[0] == 404
 
+    def test_post_message_405_explains_non_parity(self, backend):
+        """Deliberate non-parity with node.ts:43-163 (PARITY.md): external
+        message injection is refused with an explanation, not a 404."""
+        net = launch_network(1, 0, [1], [False], backend=backend)
+        with NodeHttpCluster(net, BASE):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{BASE}/message", method="POST",
+                data=json.dumps({"k": 1, "x": 1,
+                                 "messageType": "proposal phase"}).encode())
+            try:
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    code, body = resp.status, resp.read().decode()
+            except urllib.error.HTTPError as e:
+                code, body = e.code, e.read().decode()
+            assert code == 405
+            assert "scheduler" in json.loads(body)["detail"]
+
     def test_faulty_node_state_is_null(self, backend):
         """faulty nodes report all-null state (node.ts:21-26)."""
         net = launch_network(3, 1, [1, 1, 1], [True, False, False],

