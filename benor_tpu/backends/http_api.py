@@ -45,12 +45,15 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # silence default stderr chatter
         pass
 
-    def _send(self, code: int, body, as_json: bool) -> None:
+    def _send(self, code: int, body, as_json: bool,
+              extra_headers=()) -> None:
         data = (json.dumps(body) if as_json else str(body)).encode()
         self.send_response(code)
         self.send_header(
             "Content-Type",
             "application/json" if as_json else "text/plain; charset=utf-8")
+        for name, value in extra_headers:
+            self.send_header(name, value)
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         self.wfile.write(data)
@@ -73,9 +76,22 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(404, {"error": f"no route {self.path}"}, as_json=True)
 
     def do_POST(self):
-        # drain the request body first: replying with unread data pending
-        # makes the close an RST, which can discard the in-flight response
-        length = int(self.headers.get("Content-Length", 0) or 0)
+        # A chunked body has no Content-Length and cannot be drained by
+        # byte count — reject it outright (RFC 9112 allows 411 for that)
+        # and close the connection so no response races unread data.
+        if "chunked" in (self.headers.get("Transfer-Encoding") or "").lower():
+            self.close_connection = True
+            self._send(411, {"error": "chunked bodies not supported"},
+                       as_json=True)
+            return
+        # Drain the request body first: replying with unread data pending
+        # makes the close an RST, which can discard the in-flight response.
+        # A malformed Content-Length must not crash the handler mid-request.
+        try:
+            length = int(self.headers.get("Content-Length", 0) or 0)
+        except (TypeError, ValueError):
+            length = 0
+            self.close_connection = True
         while length > 0:
             chunk = self.rfile.read(min(length, 1 << 16))
             if not chunk:
@@ -92,7 +108,7 @@ class _Handler(BaseHTTPRequestHandler):
                           "deterministic seeded scheduler; this control "
                           "plane serves /status /start /stop /getState "
                           "(see PARITY.md, 'Deliberate non-parities')",
-            }, as_json=True)
+            }, as_json=True, extra_headers=(("Allow", "GET"),))
         else:
             self._send(404, {"error": f"no route {self.path}"}, as_json=True)
 

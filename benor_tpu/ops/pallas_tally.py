@@ -41,18 +41,32 @@ def _tally_kernel(mask_ref, sent_ref, alive_ref, out_ref):
     """One (trial, receiver-tile) grid step.
 
     mask_ref:  bool [1, TILE_R, S]   this tile's delivery mask
-    sent_ref:  int8 [1, S]           all senders' values (this trial)
-    alive_ref: bool [1, S]           sender liveness (this trial)
+    sent_ref:  int8 [T, S]           ALL trials' sender values (full-array
+                                     block: a [1, S] block would violate the
+                                     TPU (8, 128) block-divisibility rule on
+                                     its second-to-last dim; [T, S] is only
+                                     ~T*S bytes of VMEM and equal-to-array
+                                     dims are always legal)
+    alive_ref: bool [T, S]           sender liveness, same layout
     out_ref:   f32  [1, TILE_R, LANES]
     """
+    t = pl.program_id(0)
     mask = mask_ref[0].astype(jnp.float32)                  # [TILE_R, S]
-    sent = sent_ref[0]                                      # [S]
-    alive = alive_ref[0]
+    # Select this trial's row WITHOUT a dynamic sublane index (Mosaic can't
+    # prove alignment for sent_ref[t]): one-hot the trial axis and reduce.
+    # Everything is widened to 32-bit immediately — Mosaic supports minor-dim
+    # reshapes ([:, None]) only for 32-bit element types.
+    n_trials = sent_ref.shape[0]
+    sel = jax.lax.broadcasted_iota(jnp.int32, (n_trials, 1), 0) == t
+    sent = jnp.sum(jnp.where(sel, sent_ref[...].astype(jnp.int32), 0),
+                   axis=0)                                  # int32 [S]
+    alive = jnp.sum(jnp.where(sel, alive_ref[...].astype(jnp.int32), 0),
+                    axis=0)                                 # int32 0/1 [S]
     s = sent.shape[0]
     # one-hot [S, LANES]: column c in {0,1,2} is (sent == c) & alive
-    class_ids = jax.lax.broadcasted_iota(jnp.int8, (s, LANES), 1)
-    onehot = ((sent[:, None] == class_ids) & alive[:, None] &
-              (class_ids < 3)).astype(jnp.float32)
+    class_ids = jax.lax.broadcasted_iota(jnp.int32, (s, LANES), 1)
+    onehot = ((sent[:, None] == class_ids) & (class_ids < 3)
+              ).astype(jnp.float32) * alive[:, None].astype(jnp.float32)
     out_ref[0] = jnp.dot(mask, onehot,
                          preferred_element_type=jnp.float32)
 
@@ -79,9 +93,9 @@ def dense_counts_pallas(mask: jax.Array, sent: jax.Array, alive: jax.Array,
         in_specs=[
             pl.BlockSpec((1, TILE_R, S), lambda t, i: (t, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, S), lambda t, i: (t, 0),
+            pl.BlockSpec((T, S), lambda t, i: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, S), lambda t, i: (t, 0),
+            pl.BlockSpec((T, S), lambda t, i: (0, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((1, TILE_R, LANES), lambda t, i: (t, i, 0),

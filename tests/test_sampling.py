@@ -221,6 +221,91 @@ class TestBiasedFractionalCounts:
             _biased_path_stats("histogram", 42, 0.5, no_crash=True))
 
 
+def _cf_trial_mean_k(n: int, f: int, trials: int, seed: int,
+                     table_max: int, monkeypatch) -> np.ndarray:
+    """Per-trial mean rounds-to-decide under a forced sampler regime.
+
+    ``table_max`` monkeypatches ``sampling.EXACT_TABLE_MAX`` (read at trace
+    time), steering ``multivariate_hypergeom_counts`` between the exact
+    shared-CDF sampler and the Cornish-Fisher normal sampler for the SAME
+    protocol config.  Distinct seeds give distinct static configs, so the
+    jit cache cannot serve a trace from the other regime.
+
+    Workload: perfectly balanced inputs, zero crashes (alive > quorum, so
+    the sampler has real slack — with crashes pinned to F the draw is the
+    whole population and every sampler is trivially identical), F > N/3 so
+    vote counts straddle the decide threshold and runs take a random 1-4
+    rounds.  Aggregation is PER TRIAL: lanes within a trial share the global
+    histogram trajectory and are strongly correlated, so pooled per-lane KS
+    wildly overstates significance; per-trial means are iid by construction.
+    """
+    from benor_tpu.sim import run_consensus
+    from benor_tpu.state import FaultSpec, init_state
+
+    monkeypatch.setattr(sampling, "EXACT_TABLE_MAX", table_max)
+    cfg = SimConfig(n_nodes=n, n_faulty=f, trials=trials, max_rounds=64,
+                    delivery="quorum", scheduler="uniform", path="histogram",
+                    seed=seed)
+    no_crash = FaultSpec(faulty=jnp.zeros((trials, n), bool),
+                         crash_round=jnp.zeros((trials, n), jnp.int32))
+    balanced = np.tile(np.arange(n, dtype=np.int8) % 2, (trials, 1))
+    state = init_state(cfg, balanced, no_crash)
+    _, final = run_consensus(cfg, state, no_crash, jax.random.key(seed))
+    dec = np.asarray(final.decided)
+    k = np.asarray(final.k)
+    # per-trial guard: lanes within a trial converge (or not) together, so a
+    # single dead trial would make its mean 0/0 NaN and poison the KS gate
+    # with a misleading "CF shifts outcomes" failure
+    assert dec.any(axis=1).all(), "some trial failed to converge entirely"
+    assert dec.mean() > 0.99, "failed to converge"
+    return (k * dec).sum(axis=1) / dec.sum(axis=1)
+
+
+class TestApproxRegimeProtocol:
+    """End-to-end protocol validation of the Cornish-Fisher sampler — the
+    entire N=1M operating point (m > EXACT_TABLE_MAX) previously had no
+    protocol-level check (round-2 VERDICT weak #3; SURVEY §7 hard-part 3)."""
+
+    def test_cf_forced_matches_exact_table_m495(self, monkeypatch):
+        """Force CF at m=495 (deep inside the exact regime, where the exact
+        shared-CDF table is available as ground truth): rounds-to-decide
+        must be distributionally indistinguishable."""
+        exact = _cf_trial_mean_k(750, 255, 128, 101, 4096, monkeypatch)
+        cf = _cf_trial_mean_k(750, 255, 128, 102, 64, monkeypatch)
+        res = st.ks_2samp(exact, cf)
+        assert res.pvalue > 1e-3, (
+            f"CF sampler shifts protocol outcomes at m=495: "
+            f"KS={res.statistic:.4f} p={res.pvalue:.2e} "
+            f"(exact mean {exact.mean():.3f}, cf mean {cf.mean():.3f})")
+        # mean drift gate: catches a systematic quantile bias even if the
+        # shapes happen to KS-match (4 x combined SEM ~ 0.12 rounds)
+        sem = np.hypot(exact.std() / len(exact) ** 0.5,
+                       cf.std() / len(cf) ** 0.5)
+        assert abs(exact.mean() - cf.mean()) < 4 * sem + 1e-9
+
+    def test_cf_forced_seed_control_m495(self, monkeypatch):
+        """Control: two seeds of the SAME (exact) regime pass the same
+        gates, so the comparison above is calibrated, not vacuous."""
+        a = _cf_trial_mean_k(750, 255, 128, 101, 4096, monkeypatch)
+        b = _cf_trial_mean_k(750, 255, 128, 103, 4096, monkeypatch)
+        assert st.ks_2samp(a, b).pvalue > 1e-3
+
+    def test_production_cf_matches_exact_table_m4506(self, monkeypatch):
+        """The production boundary: m=4506 > EXACT_TABLE_MAX runs CF by
+        default; raising the table cap to 8192 forces the exact shared-CDF
+        sampler at the same m.  The protocol statistics must agree — this is
+        the direct certificate for the samplers the N=1M flagship uses."""
+        cf = _cf_trial_mean_k(8192, 3686, 64, 201, 4096, monkeypatch)
+        exact = _cf_trial_mean_k(8192, 3686, 64, 202, 8192, monkeypatch)
+        res = st.ks_2samp(cf, exact)
+        assert res.pvalue > 1e-3, (
+            f"production CF regime diverges from exact sampling at m=4506: "
+            f"KS={res.statistic:.4f} p={res.pvalue:.2e}")
+        sem = np.hypot(cf.std() / len(cf) ** 0.5,
+                       exact.std() / len(exact) ** 0.5)
+        assert abs(cf.mean() - exact.mean()) < 4 * sem + 1e-9
+
+
 class TestPathParity:
     """Two-sample KS: dense (exact) vs histogram (sampled) rounds-to-decide."""
 
