@@ -149,7 +149,7 @@ def _balanced(trials: int, n: int) -> np.ndarray:
     return np.tile((np.arange(n) % 2).astype(np.int8), (trials, 1))
 
 
-def _regimes(n, trials, fracs, max_rounds, seed):
+def _regimes(n, trials, fracs, max_rounds, seed, use_pallas_hist=False):
     """The measured workload set -> [(name, cfg, state, faults)].
 
     Three families (round-2 VERDICT item 1 — each exercises multi-round
@@ -178,12 +178,15 @@ def _regimes(n, trials, fracs, max_rounds, seed):
     import jax.numpy as jnp
 
     def no_crash(cfg):
-        return FaultSpec(faulty=jnp.zeros((trials, n), bool),
-                         crash_round=jnp.zeros((trials, n), jnp.int32))
+        return FaultSpec.none(trials, n)
 
+    # The fused pallas sampler serves the uniform-scheduler CF regime (the
+    # flagship path) ~5x faster; engaged on TPU only — its interpret-mode
+    # fallback would dominate the CPU smoke run.  Statistically identical
+    # stream (tests/test_pallas_hist.py), so the curve is the same science.
     base = dict(n_nodes=n, trials=trials, max_rounds=max_rounds,
                 delivery="quorum", path="histogram", fault_model="crash",
-                seed=seed)
+                seed=seed, use_pallas_hist=use_pallas_hist)
     bal = _balanced(trials, n)
     regs = []
 
@@ -224,6 +227,30 @@ def _regimes(n, trials, fracs, max_rounds, seed):
     return regs
 
 
+def _dense_parity_case(seed: int, trials: int, n: int):
+    """The dense-tally parity fixture + bit-equality assertion shared by the
+    embedded default-mode check and the standalone BENCH_MODE=pallas mode —
+    one copy so both artifacts always validate the same workload.
+    Returns (mask, sent, alive, interpret)."""
+    import jax
+    import jax.numpy as jnp
+
+    from benor_tpu.ops.pallas_tally import dense_counts_pallas
+    from benor_tpu.ops.tally import dense_counts
+
+    interpret = jax.default_backend() == "cpu"
+    k1, k2, k3 = jax.random.split(jax.random.key(seed), 3)
+    mask = jax.random.bernoulli(k1, 0.8, (trials, n, n))
+    sent = jax.random.randint(k2, (trials, n), 0, 3, dtype=jnp.int8)
+    alive = jax.random.bernoulli(k3, 0.9, (trials, n))
+
+    a = np.asarray(jax.jit(dense_counts)(mask, sent, alive))
+    b = np.asarray(dense_counts_pallas(mask, sent, alive,
+                                       interpret=interpret))
+    np.testing.assert_array_equal(a, b)
+    return mask, sent, alive, interpret
+
+
 def _pallas_check(seed: int) -> dict:
     """Compact on-chip pallas artifact inside the default bench (round-2
     VERDICT item 4: BENCH_MODE=pallas existed but the driver only captures
@@ -236,18 +263,7 @@ def _pallas_check(seed: int) -> dict:
     from benor_tpu.ops.tally import dense_counts
 
     trials, n = 8, 2048
-    interpret = jax.default_backend() == "cpu"
-    key = jax.random.key(seed)
-    k1, k2, k3 = jax.random.split(key, 3)
-    mask = jax.random.bernoulli(k1, 0.8, (trials, n, n))
-    sent = jax.random.randint(k2, (trials, n), 0, 3, dtype=jnp.int8)
-    alive = jax.random.bernoulli(k3, 0.9, (trials, n))
-
-    xla_fn = jax.jit(dense_counts)
-    a = np.asarray(xla_fn(mask, sent, alive))
-    b = np.asarray(dense_counts_pallas(mask, sent, alive,
-                                       interpret=interpret))
-    np.testing.assert_array_equal(a, b)
+    mask, sent, alive, interpret = _dense_parity_case(seed, trials, n)
 
     # Time with an IN-GRAPH repetition loop: a per-dispatch host loop would
     # measure mostly tunnel round-trip latency (~60 ms), not the kernel.
@@ -276,6 +292,65 @@ def _pallas_check(seed: int) -> dict:
     }
 
 
+def _pallas_hist_check(n: int, trials: int, seed: int) -> dict:
+    """On-chip proof + timing for the flagship-path kernel
+    (ops/pallas_hist.py): the fused threefry+CF sampler vs the XLA
+    grid_uniforms pipeline at the bench's own (N, T) operating point.
+    In-graph repetition loops, so tunnel dispatch latency cancels."""
+    import jax
+    import jax.numpy as jnp
+
+    from benor_tpu.ops import rng, sampling
+    from benor_tpu.ops.pallas_hist import cf_counts_pallas
+
+    interpret = jax.default_backend() == "cpu"
+    m = int(0.55 * n)
+    hist = jnp.tile(jnp.array(
+        [[int(0.4 * n), int(0.38 * n), n - int(0.4 * n) - int(0.38 * n)]],
+        jnp.int32), (trials, 1))
+    loops = 2 if interpret else 10
+
+    @jax.jit
+    def xla_loop(key):
+        def body(i, acc):
+            tid, nid = rng.ids(trials), rng.ids(n)
+            u0 = rng.grid_uniforms(key, i, 0, tid, nid)
+            u1 = rng.grid_uniforms(key, i, 16, tid, nid)
+            c = sampling.multivariate_hypergeom_counts(u0, u1, hist, m)
+            return acc + jnp.sum(c[0, 0])
+        return jax.lax.fori_loop(0, loops, body, jnp.int32(0))
+
+    @jax.jit
+    def pallas_loop(key):
+        def body(i, acc):
+            c = cf_counts_pallas(key, i, 0, hist, m, n,
+                                 interpret=interpret)
+            return acc + jnp.sum(c[0, 0])
+        return jax.lax.fori_loop(0, loops, body, jnp.int32(0))
+
+    key = jax.random.key(seed)
+    int(xla_loop(key)); int(pallas_loop(key))    # warm-up barriers
+    t0 = time.perf_counter(); int(xla_loop(key))
+    t_xla = (time.perf_counter() - t0) / loops
+    t0 = time.perf_counter(); int(pallas_loop(key))
+    t_pallas = (time.perf_counter() - t0) / loops
+
+    # moment sanity on one draw (exact mean m*c0/total, std per sampler)
+    c = np.asarray(cf_counts_pallas(key, jnp.int32(1), 0, hist, m, n,
+                                    interpret=interpret))
+    h0 = c[..., 0].astype(np.float64)
+    exp_mean = m * 0.4
+    assert abs(h0.mean() - exp_mean) < 0.01 * exp_mean
+    assert (c.sum(-1) == m).all()
+
+    return {
+        "interpret": interpret, "n": n, "trials": trials, "m": m,
+        "xla_ms": round(t_xla * 1e3, 3),
+        "pallas_ms": round(t_pallas * 1e3, 3),
+        "speedup": round(t_xla / t_pallas, 3) if t_pallas > 0 else None,
+    }
+
+
 def bench_sweep(platform: str, fallback: bool) -> dict:
     """The north-star workload: multi-regime rounds-vs-f science sweep at
     N=1M (TPU) / 50k (CPU smoke), with hardware-capability accounting."""
@@ -297,7 +372,8 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
     log(f"bench: N={n} trials={trials} f_fracs={fracs} on {dev.platform} "
         f"({dev.device_kind})")
 
-    regimes = _regimes(n, trials, fracs, max_rounds, seed)
+    regimes = _regimes(n, trials, fracs, max_rounds, seed,
+                       use_pallas_hist=not on_cpu)
     base_key = jax.random.key(seed)
 
     # Warm-up: compile every (shape-distinct) config once; compile time is
@@ -379,6 +455,11 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
     except Exception as e:  # noqa: BLE001
         pallas = {"error": f"{type(e).__name__}: {e}"}
     log(f"bench: pallas check {pallas}")
+    try:
+        pallas_hist = _pallas_hist_check(n, trials, seed)
+    except Exception as e:  # noqa: BLE001
+        pallas_hist = {"error": f"{type(e).__name__}: {e}"}
+    log(f"bench: pallas hist check {pallas_hist}")
 
     total_trials = trials * len(regimes)
     log(f"bench: sweep elapsed {elapsed:.2f}s for {total_trials} trials; "
@@ -401,6 +482,7 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
         "curve_mean_k_spread": curve_spread,
         "coin_contrast": coin_contrast,
         "pallas_check": pallas,
+        "pallas_hist_check": pallas_hist,
     }
 
 
@@ -421,19 +503,13 @@ def bench_pallas(platform: str, fallback: bool) -> dict:
     trials = int(os.environ.get("BENCH_TRIALS", 8))
     reps = int(os.environ.get("BENCH_REPS", 20))
     seed = int(os.environ.get("BENCH_SEED", 0))
-    # compile for any accelerator backend (the axon plugin reports platform
-    # 'axon', not 'tpu'); interpret only on plain CPU
-    interpret = jax.default_backend() == "cpu"
 
     dev = jax.devices()[0]
+    # bit-equality on the real lowering (the parity claim of the kernel);
+    # same fixture as the embedded default-mode check (_dense_parity_case)
+    mask, sent, alive, interpret = _dense_parity_case(seed, trials, n)
     log(f"bench[pallas]: T={trials} N={n} on {dev.platform} "
-        f"({dev.device_kind}) interpret={interpret}")
-
-    key = jax.random.key(seed)
-    k1, k2, k3 = jax.random.split(key, 3)
-    mask = jax.random.bernoulli(k1, 0.8, (trials, n, n))
-    sent = jax.random.randint(k2, (trials, n), 0, 3, dtype=jnp.int8)
-    alive = jax.random.bernoulli(k3, 0.9, (trials, n))
+        f"({dev.device_kind}) interpret={interpret}; bit-equality OK")
 
     xla_fn = jax.jit(dense_counts)
 
@@ -443,13 +519,6 @@ def bench_pallas(platform: str, fallback: bool) -> dict:
     def run_pallas():
         return int(jnp.sum(dense_counts_pallas(mask, sent, alive,
                                                interpret=interpret)))
-
-    # bit-equality on the real lowering (the parity claim of the kernel)
-    a = np.asarray(xla_fn(mask, sent, alive))
-    b = np.asarray(dense_counts_pallas(mask, sent, alive,
-                                       interpret=interpret))
-    np.testing.assert_array_equal(a, b)
-    log("bench[pallas]: bit-equality OK")
 
     run_xla(); run_pallas()  # warm-up / compile
     t0 = time.perf_counter()

@@ -65,6 +65,13 @@ def _coins(args) -> int:
     return 0
 
 
+def _results(args) -> int:
+    from .results import generate
+    generate(out_dir=args.out, n_large=args.n, trials_large=args.trials,
+             seed=args.seed, presets=not args.no_presets)
+    return 0
+
+
 def _preset(args) -> int:
     from .sweep import baseline_configs, run_point
     cfgs = baseline_configs()
@@ -112,14 +119,23 @@ def main(argv=None) -> int:
     p = sub.add_parser("preset", help="run a BASELINE.json preset config")
     p.add_argument("name")
 
+    r = sub.add_parser("results",
+                       help="generate RESULTS/ (curves + presets artifact)")
+    r.add_argument("--out", default="RESULTS")
+    r.add_argument("--n", type=int, default=1_000_000)
+    r.add_argument("--trials", type=int, default=32)
+    r.add_argument("--seed", type=int, default=0)
+    r.add_argument("--no-presets", action="store_true",
+                   help="skip the BASELINE presets (quick smoke)")
+
     argv = list(sys.argv[1:] if argv is None else argv)
     # bare `python -m benor_tpu [-n N -f F ...]` == the start.ts demo
-    if not argv or argv[0] not in ("demo", "sweep", "coins", "preset", "-h",
-                                   "--help"):
+    if not argv or argv[0] not in ("demo", "sweep", "coins", "preset",
+                                   "results", "-h", "--help"):
         argv = ["demo"] + argv
     args = ap.parse_args(argv)
     return {"demo": _demo, "sweep": _sweep, "coins": _coins,
-            "preset": _preset}[args.cmd](args)
+            "preset": _preset, "results": _results}[args.cmd](args)
 
 
 if __name__ == "__main__":

@@ -75,23 +75,49 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._send(404, {"error": f"no route {self.path}"}, as_json=True)
 
+    def _drain_best_effort(self, cap: int = 1 << 20) -> None:
+        """Read whatever body bytes are in flight (bounded, short timeout)
+        BEFORE responding: replying and closing with unread data pending
+        turns the close into a TCP RST that can discard the in-flight
+        response.  Used when the body length is unknowable (chunked /
+        malformed Content-Length)."""
+        try:
+            old_timeout = self.connection.gettimeout()
+            self.connection.settimeout(0.5)
+            try:
+                drained = 0
+                while drained < cap:
+                    chunk = self.rfile.read1(1 << 16)
+                    if not chunk:
+                        break
+                    drained += len(chunk)
+            finally:
+                self.connection.settimeout(old_timeout)
+        except OSError:
+            pass
+
     def do_POST(self):
         # A chunked body has no Content-Length and cannot be drained by
-        # byte count — reject it outright (RFC 9112 allows 411 for that)
-        # and close the connection so no response races unread data.
+        # byte count — best-effort drain, then reject (RFC 9112 allows 411)
+        # and close the connection.
         if "chunked" in (self.headers.get("Transfer-Encoding") or "").lower():
             self.close_connection = True
+            self._drain_best_effort()
             self._send(411, {"error": "chunked bodies not supported"},
                        as_json=True)
             return
-        # Drain the request body first: replying with unread data pending
-        # makes the close an RST, which can discard the in-flight response.
-        # A malformed Content-Length must not crash the handler mid-request.
+        # A malformed Content-Length must not crash the handler (no response
+        # at all) or dispatch the route with the body unread: drain what we
+        # can, answer 400, close.
         try:
             length = int(self.headers.get("Content-Length", 0) or 0)
         except (TypeError, ValueError):
-            length = 0
             self.close_connection = True
+            self._drain_best_effort()
+            self._send(400, {"error": "malformed Content-Length"},
+                       as_json=True)
+            return
+        # Drain the declared body before replying (same RST consideration).
         while length > 0:
             chunk = self.rfile.read(min(length, 1 << 16))
             if not chunk:

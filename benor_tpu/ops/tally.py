@@ -110,6 +110,18 @@ def receiver_counts(cfg: SimConfig, base_key: jax.Array, r: jax.Array,
 
     # histogram path
     hist = class_histogram(sent, alive, ctx)
+    if (cfg.use_pallas_hist and cfg.scheduler == "uniform"
+            and cfg.quorum > sampling.EXACT_TABLE_MAX
+            and ctx.trial_axis is None and ctx.node_axis is None):
+        # Fused pallas sampler (the flagship-path kernel): bits + quantile +
+        # CF draws in one VMEM pass.  Own stream keyed on base_key (NOT
+        # cfg.seed — distinct-key MC replications must stay independent);
+        # statistically identical to the grid_uniforms pipeline below,
+        # KS-gated by tests/test_pallas_hist.py.
+        from .pallas_hist import cf_counts_pallas
+        return cf_counts_pallas(
+            base_key, r, phase, hist, cfg.quorum, N,
+            interpret=jax.default_backend() == "cpu")
     u0 = rng.grid_uniforms(base_key, r, phase, trial_ids, node_ids)
     u1 = rng.grid_uniforms(base_key, r, phase + 16, trial_ids, node_ids)
     if cfg.scheduler == "biased":

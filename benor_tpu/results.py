@@ -1,0 +1,232 @@
+"""Science deliverable generator: the curves the BASELINE north star asks for.
+
+The reference repo never produces a curve (its only experiment is the
+hardcoded 10-node demo, src/start.ts:7-20).  This module runs the five
+BASELINE.json presets plus the three N=1M science studies and writes the
+results as JSON (RESULTS/*.json) and a human-readable RESULTS.md — the
+"expected-rounds-vs-f curves" artifact itself, checked into the repo.
+
+Studies beyond the presets:
+
+  balanced_curve  — expected rounds vs fault fraction with perfectly
+                    balanced inputs and ZERO crashes (F purely a protocol
+                    parameter).  For f > 1/3 the decide threshold
+                    count > F exceeds the typical class count (N-F)/2, so
+                    convergence needs the sampling-noise random walk to
+                    amplify a majority: mean_k steps from 2 to ~3.
+  margin_sweep    — outcomes vs initial margin delta (1-count = N/2 +
+                    delta*sqrt(N)/2) at f = 0.4.  The per-lane round-1
+                    adoption probability is Phi(~1.2*delta); two distinct
+                    transitions appear as delta grows: the decided VALUE
+                    locks to the majority input by delta ~ 0.1, while the
+                    round count only drops once the margin survives both
+                    amplification phases of round 1 (delta ~ 0.4) — the
+                    margin-inside-sampling-noise physics made visible.
+  coin_contrast   — private vs shared common coin under the worst-case
+                    count-controlling adversary at N=1M: private coins
+                    livelock (decided ~ 0 at the cap), the common coin
+                    escapes in O(1) rounds (Ben-Or vs Rabin).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List
+
+import numpy as np
+
+from .config import SimConfig
+from .state import FaultSpec
+from .sweep import SweepPoint, baseline_configs, coin_comparison, run_point
+
+#: Default fault fractions for the balanced rounds-vs-f curve.
+CURVE_FRACS = (0.10, 0.25, 0.35, 0.40, 0.45)
+#: Margin multipliers (x sqrt(N)) for the margin sweep.  The interesting
+#: window is delta < ~0.5: the value bias (ones_frac) saturates by
+#: delta ~ 0.1 while the round count only drops once the margin survives
+#: BOTH amplification phases of round 1 (delta ~ 0.4) — two distinct
+#: transitions, both inside sampling noise scale.
+MARGINS = (0.0, 0.05, 0.1, 0.2, 0.3, 0.5, 1.0)
+
+
+def _balanced(trials: int, n: int, extra_ones: int = 0) -> np.ndarray:
+    """Inputs with exactly floor(N/2) + extra_ones ones per trial."""
+    ones = n // 2 + extra_ones
+    row = np.zeros(n, np.int8)
+    row[:ones] = 1
+    return np.tile(row, (trials, 1))
+
+
+def balanced_curve(n: int, trials: int, seed: int = 0,
+                   fracs=CURVE_FRACS, verbose=True) -> List[SweepPoint]:
+    pts = []
+    for frac in fracs:
+        cfg = SimConfig(n_nodes=n, n_faulty=int(frac * n), trials=trials,
+                        max_rounds=64, delivery="quorum",
+                        scheduler="uniform", path="histogram", seed=seed)
+        pt = run_point(cfg, initial_values=_balanced(trials, n),
+                       faults=FaultSpec.none(trials, n))
+        pts.append(pt)
+        if verbose:
+            print(f"  f={frac:.2f}: mean_k={pt.mean_k:.3f} "
+                  f"decided={pt.decided_frac:.3f} ones={pt.ones_frac:.3f} "
+                  f"{pt.trials_per_sec:.1f} trials/s", flush=True)
+    return pts
+
+
+def margin_sweep(n: int, trials: int, seed: int = 0, f_frac: float = 0.40,
+                 margins=MARGINS, verbose=True) -> List[Dict]:
+    rows = []
+    for delta in margins:
+        extra = int(round(delta * np.sqrt(n) / 2))  # 1-count - N/2
+        cfg = SimConfig(n_nodes=n, n_faulty=int(f_frac * n), trials=trials,
+                        max_rounds=64, delivery="quorum",
+                        scheduler="uniform", path="histogram", seed=seed)
+        pt = run_point(cfg, initial_values=_balanced(trials, n, extra),
+                       faults=FaultSpec.none(trials, n))
+        rows.append({"delta": delta, "extra_ones": extra, **pt.to_dict()})
+        if verbose:
+            print(f"  delta={delta}: mean_k={pt.mean_k:.3f} "
+                  f"ones={pt.ones_frac:.3f}", flush=True)
+    return rows
+
+
+def coin_contrast(n: int, trials: int, seed: int = 0,
+                  f_frac: float = 0.20) -> Dict[str, List[SweepPoint]]:
+    f = int(f_frac * n)
+    f += (n - f) % 2                       # even quorum for a perfect tie
+    cfg = SimConfig(n_nodes=n, n_faulty=f, trials=trials, max_rounds=16,
+                    seed=seed, path="histogram")
+    return coin_comparison(cfg)
+
+
+def generate(out_dir: str = "RESULTS", n_large: int = 1_000_000,
+             trials_large: int = 32, seed: int = 0,
+             presets=True) -> Dict[str, object]:
+    """Run every study, write JSON artifacts + RESULTS.md, return the data."""
+    import jax
+    os.makedirs(out_dir, exist_ok=True)
+    dev = jax.devices()[0]
+    meta = {"device": str(dev.device_kind), "platform": dev.platform,
+            "n_large": n_large, "trials_large": trials_large, "seed": seed}
+    out: Dict[str, object] = {"meta": meta}
+
+    print(f"results: device={dev.device_kind} N={n_large}", flush=True)
+
+    print("balanced rounds-vs-f curve:", flush=True)
+    pts = balanced_curve(n_large, trials_large, seed)
+    out["balanced_curve"] = [
+        {"f_frac": fr, **p.to_dict()} for fr, p in zip(CURVE_FRACS, pts)]
+
+    print("margin sweep (f=0.40):", flush=True)
+    out["margin_sweep"] = margin_sweep(n_large, trials_large, seed)
+
+    print("coin contrast (adversarial):", flush=True)
+    cc = coin_contrast(n_large, trials_large, seed)
+    out["coin_contrast"] = {k: [p.to_dict() for p in v]
+                            for k, v in cc.items()}
+
+    if presets:
+        for name, cfg in baseline_configs().items():
+            if cfg.n_nodes > n_large:      # CPU smoke scaling
+                continue
+            print(f"preset {name}:", flush=True)
+            pt = run_point(cfg)
+            print(f"  mean_k={pt.mean_k:.3f} decided={pt.decided_frac:.3f} "
+                  f"{pt.trials_per_sec:.1f} trials/s", flush=True)
+            out[f"preset_{name}"] = pt.to_dict()
+
+    with open(os.path.join(out_dir, "results.json"), "w") as fh:
+        json.dump(out, fh, indent=1)
+    _write_markdown(out_dir, out)
+    print(f"results: wrote {out_dir}/results.json and {out_dir}/RESULTS.md",
+          flush=True)
+    return out
+
+
+def _write_markdown(out_dir: str, out: Dict) -> None:
+    meta = out["meta"]
+    lines = [
+        "# RESULTS — expected-rounds curves (BASELINE.json north star)",
+        "",
+        f"Generated on `{meta['device']}` ({meta['platform']}), "
+        f"N={meta['n_large']:,}, {meta['trials_large']} MC trials, "
+        f"seed={meta['seed']}.  Regenerate with "
+        "`python -m benor_tpu results`.",
+        "",
+        "## Expected rounds vs fault fraction "
+        "(balanced inputs, zero crashes)",
+        "",
+        "Decide threshold is `count > F` of `m = N-F` tallied votes: for "
+        "f > 1/3 the threshold exceeds the typical class count m/2 and "
+        "deciding requires the sampling-noise random walk to amplify a "
+        "network-wide majority first.",
+        "",
+        "(ones frac = 0.000 for f < 1/3 is the reference's decide0-first "
+        "quirk, node.ts:99-104: with balanced votes BOTH classes exceed F, "
+        "and the 0-branch is checked first — every lane decides 0.)",
+        "",
+        "| f | mean k | decided | ones frac | trials/s |",
+        "|---|---|---|---|---|",
+    ]
+    for row in out["balanced_curve"]:
+        lines.append(
+            f"| {row['f_frac']:.2f} | {row['mean_k']:.3f} "
+            f"| {row['decided_frac']:.3f} | {row['ones_frac']:.3f} "
+            f"| {row['trials_per_sec']:.1f} |")
+    lines += [
+        "",
+        "## Rounds vs initial margin (f = 0.40)",
+        "",
+        "1-count = N/2 + delta*sqrt(N)/2 per trial: the transition from "
+        "sampling-noise-dominated (multi-round) to margin-dominated "
+        "(1-round) decisions.",
+        "",
+        "| delta (x sqrt(N)) | mean k | ones frac |",
+        "|---|---|---|",
+    ]
+    for row in out["margin_sweep"]:
+        lines.append(f"| {row['delta']} | {row['mean_k']:.3f} "
+                     f"| {row['ones_frac']:.3f} |")
+    cc = out["coin_contrast"]
+    priv, comm = cc["private"][0], cc["common"][0]
+    lines += [
+        "",
+        "## Private vs common coin under the count-controlling adversary",
+        "",
+        "The adversary delivers every receiver a tied 0/1 multiset; private "
+        "coins cannot break network-wide symmetry (livelock at the round "
+        "cap), the shared common coin does so in O(1) expected rounds — "
+        "the Ben-Or vs Rabin contrast at N=1M:",
+        "",
+        "| coin | decided | mean k | rounds executed |",
+        "|---|---|---|---|",
+        f"| private | {priv['decided_frac']:.3f} | {priv['mean_k']:.2f} "
+        f"| {priv['rounds_executed']} |",
+        f"| common | {comm['decided_frac']:.3f} | {comm['mean_k']:.2f} "
+        f"| {comm['rounds_executed']} |",
+        "",
+        "## BASELINE.json presets",
+        "",
+        "As literally specified: crash-from-birth faults pin the live "
+        "population to exactly the quorum N-F, so every receiver tallies "
+        "the whole population deterministically and iid inputs decide in "
+        "one round (mean k ~ 2) — including the adversarial preset, whose "
+        "scheduler has no delivery slack to exploit.  The studies above "
+        "decouple F from the crash count (zero crashes) to expose the "
+        "multi-round regimes.",
+        "",
+        "| preset | N | F | trials | mean k | decided | trials/s |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for key, row in out.items():
+        if not key.startswith("preset_"):
+            continue
+        lines.append(
+            f"| {key[7:]} | {row['n_nodes']:,} | {row['n_faulty']:,} "
+            f"| {row['trials']} | {row['mean_k']:.3f} "
+            f"| {row['decided_frac']:.3f} | {row['trials_per_sec']:.1f} |")
+    lines.append("")
+    with open(os.path.join(out_dir, "RESULTS.md"), "w") as fh:
+        fh.write("\n".join(lines))

@@ -86,6 +86,18 @@ class FaultSpec:
             crash_round = jnp.zeros((cfg.trials, cfg.n_nodes), jnp.int32)
         return cls(faulty=faulty, crash_round=crash_round)
 
+    @classmethod
+    def none(cls, trials: int, n_nodes: int) -> "FaultSpec":
+        """Zero-crash spec: every node alive, F purely a protocol parameter.
+
+        The science workloads use this to decouple F from the crash count —
+        with crash-from-birth faults the live population equals the quorum
+        and every tally is the deterministic full-population draw (the
+        reference pins them equal, launchNodes.ts:12-13; an asynchronous
+        adversary is strongest with all N alive)."""
+        return cls(faulty=jnp.zeros((trials, n_nodes), bool),
+                   crash_round=jnp.zeros((trials, n_nodes), jnp.int32))
+
 
 def init_state(cfg: SimConfig, initial_values, faults: FaultSpec) -> NetState:
     """Build the T x N state arrays from per-node initial values.

@@ -159,8 +159,7 @@ def coin_comparison(base_cfg: SimConfig,
             f"coin_comparison needs an even quorum N-F for a perfect-tie "
             f"adversary (got N-F={base_cfg.quorum}); adjust N or F")
     T, N = base_cfg.trials, base_cfg.n_nodes
-    no_crash = FaultSpec(faulty=jnp.zeros((T, N), bool),
-                         crash_round=jnp.zeros((T, N), jnp.int32))
+    no_crash = FaultSpec.none(T, N)
     balanced = np.tile(np.arange(N, dtype=np.int8) % 2, (T, 1))
     out: Dict[str, List[SweepPoint]] = {}
     for coin in ("private", "common"):

@@ -1,0 +1,63 @@
+"""Shared protocol-level statistical harness for sampler-parity tests.
+
+Used by tests/test_sampling.py (CF-forced vs exact-table regimes) and
+tests/test_pallas_hist.py (fused pallas sampler vs the XLA pipeline).  The
+load-bearing choices live here ONCE:
+
+  * balanced inputs + zero crashes (alive > quorum): with crash-from-birth
+    faults the live population equals the quorum and every sampler draws
+    the whole population — trivially identical, vacuous comparison;
+  * F > N/3 so the decide threshold sits above the typical class count and
+    runs take a random 1-4 rounds (otherwise everything decides in round 1
+    and distributions are constants);
+  * PER-TRIAL aggregation: lanes within a trial share the global histogram
+    trajectory and are strongly correlated, so pooled per-lane KS wildly
+    overstates significance; per-trial means are iid by construction;
+  * per-trial convergence guard: a single dead trial would make its mean
+    0/0 NaN and poison the KS gate with a misleading failure.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from benor_tpu.config import SimConfig
+from benor_tpu.ops import sampling
+from benor_tpu.state import FaultSpec, init_state
+
+
+def trial_mean_k(n: int, f: int, trials: int, seed: int, *,
+                 table_max: int | None = None,
+                 use_pallas_hist: bool = False) -> np.ndarray:
+    """Per-trial mean rounds-to-decide under a forced sampler regime.
+
+    ``table_max`` (if given) overrides ``sampling.EXACT_TABLE_MAX`` for the
+    duration of the run, steering the histogram path between the exact
+    shared-CDF sampler and the Cornish-Fisher sampler (and gating the
+    pallas kernel, which serves only the CF regime).  Distinct seeds give
+    distinct static configs, so the jit cache cannot serve a trace from
+    another regime.
+    """
+    from benor_tpu.sim import run_consensus
+
+    old = sampling.EXACT_TABLE_MAX
+    if table_max is not None:
+        sampling.EXACT_TABLE_MAX = table_max
+    try:
+        cfg = SimConfig(n_nodes=n, n_faulty=f, trials=trials, max_rounds=64,
+                        delivery="quorum", scheduler="uniform",
+                        path="histogram", use_pallas_hist=use_pallas_hist,
+                        seed=seed)
+        no_crash = FaultSpec.none(trials, n)
+        balanced = np.tile(np.arange(n, dtype=np.int8) % 2, (trials, 1))
+        state = init_state(cfg, balanced, no_crash)
+        _, final = run_consensus(cfg, state, no_crash, jax.random.key(seed))
+    finally:
+        sampling.EXACT_TABLE_MAX = old
+    dec = np.asarray(final.decided)
+    k = np.asarray(final.k)
+    assert dec.any(axis=1).all(), "some trial failed to converge entirely"
+    assert dec.mean() > 0.99, "failed to converge"
+    return (k * dec).sum(axis=1) / dec.sum(axis=1)

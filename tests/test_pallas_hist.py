@@ -1,0 +1,127 @@
+"""Fused histogram-path pallas sampler (ops/pallas_hist.py).
+
+Runs in interpreter mode on the CPU test mesh (the kernel's threefry is
+hand-rolled uint32 arithmetic precisely so interpret mode works — the pltpu
+PRNG primitives have no interpret lowering).  Gates:
+
+  * AS241 ndtri accuracy,
+  * draw moments vs scipy's exact hypergeometric,
+  * determinism + (round, phase, seed) stream separation,
+  * feasibility clamps at degenerate histograms,
+  * protocol-level KS: a full consensus run with use_pallas_hist=True must
+    be distributionally indistinguishable from the XLA sampler path (the
+    streams differ by design, so the comparison is per-trial statistical,
+    same harness as TestApproxRegimeProtocol in test_sampling.py).
+"""
+
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import jax
+import jax.numpy as jnp
+
+from benor_tpu.config import SimConfig
+from benor_tpu.ops import sampling
+from benor_tpu.ops.pallas_hist import cf_counts_pallas, _ndtri_as241
+
+
+def _counts(seed, r, phase, hist, m, n, trials=None):
+    h = jnp.tile(jnp.asarray(hist, jnp.int32)[None, :], (trials or 4, 1))
+    return np.asarray(cf_counts_pallas(
+        jax.random.key(seed), jnp.int32(r), phase, h, m, n, interpret=True))
+
+
+class TestKernel:
+    def test_ndtri_accuracy(self):
+        p = np.linspace(1e-7, 1 - 1e-7, 50001).astype(np.float32)
+        z = np.asarray(_ndtri_as241(jnp.asarray(p)))
+        ref = st.norm.ppf(p.astype(np.float64))
+        assert np.abs(z - ref).max() < 2e-6
+
+    def test_moments_match_exact_hypergeometric(self):
+        m, n = 5000, 4096
+        c = _counts(42, 3, 0, [4000, 3000, 1000], m, n, trials=8)
+        np.testing.assert_array_equal(c.sum(-1), m)
+        h0 = c[..., 0].ravel().astype(np.float64)
+        d = st.hypergeom(8000, 4000, m)
+        assert abs(h0.mean() - d.mean()) < 0.05 * d.std()
+        assert abs(h0.std() - d.std()) < 0.05 * d.std()
+
+    def test_deterministic_and_stream_separated(self):
+        args = ([4000, 3000, 1000], 5000, 1024)
+        a = _counts(42, 3, 0, *args)
+        assert np.array_equal(a, _counts(42, 3, 0, *args))       # same
+        assert not np.array_equal(a, _counts(42, 4, 0, *args))   # round
+        assert not np.array_equal(a, _counts(42, 3, 1, *args))   # phase
+        assert not np.array_equal(a, _counts(43, 3, 0, *args))   # base key
+
+    def test_keys_on_base_key_not_config_seed(self):
+        """Independent MC replications run the supported way — same config,
+        distinct base keys (e.g. fold_in(key, batch)) — must draw
+        independent message-plane randomness (regression: the kernel once
+        keyed on cfg.seed, silently correlating replications)."""
+        h = jnp.tile(jnp.array([[4000, 3000, 1000]], jnp.int32), (4, 1))
+        k = jax.random.key(42)
+        a = np.asarray(cf_counts_pallas(jax.random.fold_in(k, 0),
+                                        jnp.int32(1), 0, h, 5000, 1024,
+                                        interpret=True))
+        b = np.asarray(cf_counts_pallas(jax.random.fold_in(k, 1),
+                                        jnp.int32(1), 0, h, 5000, 1024,
+                                        interpret=True))
+        assert not np.array_equal(a, b)
+
+    def test_clamps_at_degenerate_histograms(self):
+        m, n = 600, 512
+        # all mass in class 0: h0 == m exactly
+        c = _counts(1, 1, 0, [1000, 0, 0], m, n)
+        np.testing.assert_array_equal(c[..., 0], m)
+        np.testing.assert_array_equal(c[..., 1], 0)
+        # total == m: the draw is the whole population
+        c = _counts(1, 1, 0, [300, 200, 100], m, n)
+        np.testing.assert_array_equal(c[..., 0], 300)
+        np.testing.assert_array_equal(c[..., 1], 200)
+        np.testing.assert_array_equal(c[..., 2], 100)
+
+    def test_ragged_n_padding(self):
+        # N not a multiple of TILE_N exercises the pad+slice path
+        c = _counts(7, 2, 0, [900, 800, 300], 1500, 700)
+        assert c.shape == (4, 700, 3)
+        np.testing.assert_array_equal(c.sum(-1), 1500)
+
+
+class TestProtocolParity:
+    """use_pallas_hist=True vs False through the full consensus loop.
+    Shared harness (balanced inputs, zero crashes, F > N/3, per-trial
+    aggregation — see tests/stat_harness.py for why each matters); the CF
+    regime is forced at m=495 via table_max so the kernel engages on CPU."""
+
+    def test_ks_vs_xla_sampler(self):
+        from stat_harness import trial_mean_k
+        xla = trial_mean_k(750, 255, 128, 301, table_max=64,
+                           use_pallas_hist=False)
+        pallas = trial_mean_k(750, 255, 128, 302, table_max=64,
+                              use_pallas_hist=True)
+        res = st.ks_2samp(xla, pallas)
+        assert res.pvalue > 1e-3, (
+            f"pallas sampler shifts protocol outcomes: "
+            f"KS={res.statistic:.4f} p={res.pvalue:.2e} "
+            f"(xla mean {xla.mean():.3f}, pallas mean {pallas.mean():.3f})")
+        sem = np.hypot(xla.std() / len(xla) ** 0.5,
+                       pallas.std() / len(pallas) ** 0.5)
+        assert abs(xla.mean() - pallas.mean()) < 4 * sem + 1e-9
+
+    def test_flag_ignored_outside_cf_regime(self):
+        """In the exact-table regime the flag must be a no-op (bitwise)."""
+        from benor_tpu.sim import simulate
+        n, f = 64, 16
+        cfg = SimConfig(n_nodes=n, n_faulty=f, trials=8, delivery="quorum",
+                        scheduler="uniform", path="histogram", seed=5)
+        r1, s1, _ = simulate(cfg, [i % 2 for i in range(n)],
+                             [True] * f + [False] * (n - f))
+        cfg2 = cfg.replace(use_pallas_hist=True)
+        r2, s2, _ = simulate(cfg2, [i % 2 for i in range(n)],
+                             [True] * f + [False] * (n - f))
+        assert int(r1) == int(r2)
+        np.testing.assert_array_equal(np.asarray(s1.x), np.asarray(s2.x))
+        np.testing.assert_array_equal(np.asarray(s1.k), np.asarray(s2.k))
