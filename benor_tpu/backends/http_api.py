@@ -76,23 +76,24 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(404, {"error": f"no route {self.path}"}, as_json=True)
 
     def _drain_best_effort(self, cap: int = 1 << 20) -> None:
-        """Read whatever body bytes are in flight (bounded, short timeout)
-        BEFORE responding: replying and closing with unread data pending
-        turns the close into a TCP RST that can discard the in-flight
-        response.  Used when the body length is unknowable (chunked /
-        malformed Content-Length)."""
+        """Read whatever body bytes are ALREADY in flight before responding:
+        replying and closing with unread data pending turns the close into a
+        TCP RST that can discard the in-flight response.  Used when the body
+        length is unknowable (chunked / malformed Content-Length).  Each
+        read is gated on select() readability so a client that has finished
+        sending and is awaiting the reply costs at most one 50 ms wait —
+        not a blocking read that stalls until timeout."""
+        import select
         try:
-            old_timeout = self.connection.gettimeout()
-            self.connection.settimeout(0.5)
-            try:
-                drained = 0
-                while drained < cap:
-                    chunk = self.rfile.read1(1 << 16)
-                    if not chunk:
-                        break
-                    drained += len(chunk)
-            finally:
-                self.connection.settimeout(old_timeout)
+            drained = 0
+            while drained < cap:
+                ready, _, _ = select.select([self.connection], [], [], 0.05)
+                if not ready:
+                    break
+                chunk = self.rfile.read1(1 << 16)
+                if not chunk:
+                    break
+                drained += len(chunk)
         except OSError:
             pass
 

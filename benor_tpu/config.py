@@ -87,11 +87,12 @@ class SimConfig:
     # Use the fused pallas kernel (ops/pallas_hist.py) for the histogram-path
     # quorum sampler: threefry bits + normal quantile + CF hypergeometric
     # draws in one VMEM pass (~100x less HBM traffic than the XLA pipeline,
-    # measured ~5x faster at N=1M on v5e).  Applies only on the single-device
-    # uniform-scheduler histogram path in the CF regime
-    # (quorum > EXACT_TABLE_MAX); silently ignored elsewhere.  Uses its own
-    # documented random stream keyed on the run's base_key, so results are
-    # statistically (not bitwise) identical to the XLA path.
+    # measured ~5x faster at N=1M on v5e).  Applies on the uniform-scheduler
+    # histogram path in the CF regime (quorum > EXACT_TABLE_MAX), single
+    # device or shard_map mesh (draws key on global ids, so results are
+    # bit-identical across mesh shapes); silently ignored elsewhere.  Uses
+    # its own documented random stream keyed on the run's base_key, so
+    # results are statistically (not bitwise) identical to the XLA path.
     use_pallas_hist: bool = False
 
     # --- Monte-Carlo ----------------------------------------------------

@@ -150,21 +150,25 @@ def _cf_kernel(m, scal_ref, c0_ref, c1_ref, cq_ref,
                h0_ref, h1_ref, hq_ref):
     """One lane-tile: fused uniforms + CF draws for all T trials.
 
-    scal_ref: SMEM uint32 [4] = (k0, k1) key pairs for the two uniform
-    streams, derived per (base_key, round, phase, stream) on the XLA side
-    of the call.
+    scal_ref: SMEM uint32 [4] = the (k0, k1) threefry key — derived per
+    (base_key, round, phase) on the XLA side of the call — plus this
+    shard's (node_offset, trial_offset) global-id bases (0 on a single
+    device).  ONE threefry block per lane yields BOTH uniforms (the two
+    output words of the 2x32 PRF are independent).
     c0/c1/cq_ref: VMEM f32 [T, 1] global class counts per trial.
     h0/h1/hq_ref: VMEM int32 [T, TILE_N] outputs (this tile's lanes).
     """
     j = pl.program_id(0)
     n_trials, tile = h0_ref.shape
-    # counters: x0 = global lane (node) id, x1 = trial id — unique per lane,
-    # independent of the grid tiling
+    # counters: x0 = GLOBAL lane (node) id, x1 = GLOBAL trial id — unique
+    # per lane, independent of the grid tiling AND of mesh sharding (under
+    # shard_map the shard's id offsets ride in scal_ref[4:6]), so the
+    # stream is bit-identical for every mesh shape.
     node = (jax.lax.broadcasted_iota(jnp.uint32, (n_trials, tile), 1) +
-            jnp.uint32(j * tile))
-    trial = jax.lax.broadcasted_iota(jnp.uint32, (n_trials, tile), 0)
-    b0, _ = _threefry2x32(scal_ref[0], scal_ref[1], node, trial)
-    b1, _ = _threefry2x32(scal_ref[2], scal_ref[3], node, trial)
+            jnp.uint32(j * tile) + scal_ref[2])
+    trial = (jax.lax.broadcasted_iota(jnp.uint32, (n_trials, tile), 0) +
+             scal_ref[3])
+    b0, b1 = _threefry2x32(scal_ref[0], scal_ref[1], node, trial)
     u0 = _bits_to_uniform(b0)
     u1 = _bits_to_uniform(b1)
 
@@ -187,7 +191,9 @@ def _cf_kernel(m, scal_ref, c0_ref, c1_ref, cq_ref,
                    static_argnames=("m", "n_nodes", "interpret"))
 def cf_counts_pallas(base_key: jax.Array, r: jax.Array, phase: int,
                      hist: jax.Array, m: int, n_nodes: int,
-                     interpret: bool = False) -> jax.Array:
+                     interpret: bool = False,
+                     node_offset: jax.Array | int = 0,
+                     trial_offset: jax.Array | int = 0) -> jax.Array:
     """Fused histogram-path quorum sampler -> int32 [T, N, 3].
 
     base_key: a jax PRNG key — the SAME run key every runner threads
@@ -196,6 +202,10 @@ def cf_counts_pallas(base_key: jax.Array, r: jax.Array, phase: int,
     would silently correlate them); r: int32 round index (traced — flows
     into the threefry key, not the trace); phase: static phase tag;
     hist: int32 [T, 3] global class counts; m: static quorum size.
+    node_offset/trial_offset: this shard's global-id bases when called
+    inside ``shard_map`` (hist must already be the psum'd GLOBAL
+    histogram) — draws are keyed on global ids, so results are
+    bit-identical across mesh shapes, single device included.
 
     Drop-in statistical replacement for
     ops.sampling.multivariate_hypergeom_counts in the CF regime
@@ -206,19 +216,19 @@ def cf_counts_pallas(base_key: jax.Array, r: jax.Array, phase: int,
     n_pad = (-n_nodes) % TILE_N
     np_total = n_nodes + n_pad
 
-    # Per-(key, round, phase, stream) kernel keys, derived by one scalar
-    # threefry application OUTSIDE the kernel: key words = base_key data,
-    # counter words = (r, phase*2 + stream).  Collision-free in all inputs;
-    # stream 0/1 are the two independent uniforms (the XLA path's
-    # phase / phase+16 split).  uint32 up front: in-kernel scalar bitcasts
-    # are unsupported.
+    # Per-(key, round, phase) kernel key, derived by one scalar threefry
+    # application OUTSIDE the kernel: key words = base_key data, counter
+    # words = (r, phase).  Collision-free in all inputs; inside the kernel
+    # one PRF block per lane yields both uniforms (the XLA path's
+    # phase / phase+16 split becomes the block's two output words).
+    # uint32 up front: in-kernel scalar bitcasts are unsupported.
     kd = jax.random.key_data(base_key).astype(jnp.uint32).reshape(-1)
-    r32 = r.astype(jnp.uint32)
-    k0_s0, k1_s0 = _threefry2x32(kd[0], kd[-1], r32,
-                                 jnp.uint32(phase * 2 + 0))
-    k0_s1, k1_s1 = _threefry2x32(kd[0], kd[-1], r32,
-                                 jnp.uint32(phase * 2 + 1))
-    scal = jnp.stack([k0_s0, k1_s0, k0_s1, k1_s1])
+    k0, k1 = _threefry2x32(kd[0], kd[-1], r.astype(jnp.uint32),
+                           jnp.uint32(phase))
+    scal = jnp.stack([
+        k0, k1,
+        jnp.asarray(node_offset).astype(jnp.uint32),
+        jnp.asarray(trial_offset).astype(jnp.uint32)])
 
     cls = hist.astype(jnp.float32)[..., None]               # [T, 3, 1]
     c0, c1, cq = cls[:, 0], cls[:, 1], cls[:, 2]            # [T, 1] each

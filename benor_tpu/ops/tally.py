@@ -111,17 +111,20 @@ def receiver_counts(cfg: SimConfig, base_key: jax.Array, r: jax.Array,
     # histogram path
     hist = class_histogram(sent, alive, ctx)
     if (cfg.use_pallas_hist and cfg.scheduler == "uniform"
-            and cfg.quorum > sampling.EXACT_TABLE_MAX
-            and ctx.trial_axis is None and ctx.node_axis is None):
+            and cfg.quorum > sampling.EXACT_TABLE_MAX):
         # Fused pallas sampler (the flagship-path kernel): bits + quantile +
         # CF draws in one VMEM pass.  Own stream keyed on base_key (NOT
         # cfg.seed — distinct-key MC replications must stay independent);
         # statistically identical to the grid_uniforms pipeline below,
-        # KS-gated by tests/test_pallas_hist.py.
+        # KS-gated by tests/test_pallas_hist.py.  Under a mesh the draws
+        # are keyed on this shard's GLOBAL (trial, node) id bases and the
+        # psum'd global histogram, so results stay bit-identical across
+        # mesh shapes (tests/test_pallas_hist.py::test_sharded_bit_identical).
         from .pallas_hist import cf_counts_pallas
         return cf_counts_pallas(
             base_key, r, phase, hist, cfg.quorum, N,
-            interpret=jax.default_backend() == "cpu")
+            interpret=jax.default_backend() == "cpu",
+            node_offset=node_ids[0], trial_offset=trial_ids[0])
     u0 = rng.grid_uniforms(base_key, r, phase, trial_ids, node_ids)
     u1 = rng.grid_uniforms(base_key, r, phase + 16, trial_ids, node_ids)
     if cfg.scheduler == "biased":

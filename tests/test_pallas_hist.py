@@ -111,6 +111,37 @@ class TestProtocolParity:
                        pallas.std() / len(pallas) ** 0.5)
         assert abs(xla.mean() - pallas.mean()) < 4 * sem + 1e-9
 
+    def test_sharded_bit_identical(self):
+        """use_pallas_hist under shard_map: global-id counters + the psum'd
+        global histogram make the sharded run bit-identical to the
+        single-device run for every mesh shape (SURVEY §7 hard-part 5,
+        extended to the pallas sampler)."""
+        from benor_tpu.parallel import make_mesh, run_consensus_sharded
+        from benor_tpu.sim import run_consensus
+        from benor_tpu.state import FaultSpec, init_state
+
+        old = sampling.EXACT_TABLE_MAX
+        sampling.EXACT_TABLE_MAX = 8     # CF regime at m=12
+        try:
+            n, f, trials = 16, 4, 8
+            cfg = SimConfig(n_nodes=n, n_faulty=f, trials=trials,
+                            delivery="quorum", scheduler="uniform",
+                            path="histogram", use_pallas_hist=True, seed=13)
+            no_crash = FaultSpec.none(trials, n)
+            state = init_state(cfg, [i % 2 for i in range(n)], no_crash)
+            key = jax.random.key(13)
+            r1, s1 = run_consensus(cfg, state, no_crash, key)
+            for mesh_shape in ((2, 4), (1, 8), (4, 1)):
+                r2, s2 = run_consensus_sharded(cfg, state, no_crash, key,
+                                               make_mesh(*mesh_shape))
+                assert int(r1) == int(r2), mesh_shape
+                np.testing.assert_array_equal(
+                    np.asarray(s1.x), np.asarray(s2.x), err_msg=str(mesh_shape))
+                np.testing.assert_array_equal(
+                    np.asarray(s1.k), np.asarray(s2.k), err_msg=str(mesh_shape))
+        finally:
+            sampling.EXACT_TABLE_MAX = old
+
     def test_flag_ignored_outside_cf_regime(self):
         """In the exact-table regime the flag must be a no-op (bitwise)."""
         from benor_tpu.sim import simulate
