@@ -403,22 +403,26 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
 
     # Timed sweep: the whole regime set end-to-end, repeated BENCH_REPS
     # times.  NOTE: block_until_ready does not actually wait under the axon
-    # tunnel runtime — fetching the scalar `rounds` output is what forces
-    # (and therefore times) program completion.
+    # tunnel runtime — fetching a scalar output is what forces completion.
+    # All dispatches are queued first and the scalars fetched AFTER the
+    # loops: a fetch inside the loop would serialize every run on a ~60 ms
+    # tunnel round-trip and the "throughput" would mostly measure latency.
     results = []
     t0 = time.perf_counter()
     for rep in range(reps):
         results = []
         for name, cfg, state, faults in regimes:
             rounds, final = run_consensus(cfg, state, faults, base_key)
-            results.append((name, cfg, int(rounds), final, faults))
+            results.append((name, cfg, rounds, final, faults))
+    results = [(name, cfg, int(rounds), final, faults)
+               for name, cfg, rounds, final, faults in results]
     elapsed = (time.perf_counter() - t0) / reps
 
     curve = []
     total_node_rounds = 0
     total_bytes = 0.0
     for name, cfg, rounds, final, faults in results:
-        dec_frac, mean_k, ones_frac, _ = summarize_final(
+        dec_frac, mean_k, ones_frac, _, disagree = summarize_final(
             final, faults.faulty, cfg.max_rounds)
         row = {
             "regime": name, "f_frac": round(cfg.n_faulty / n, 3),
@@ -427,6 +431,7 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
             "decided": round(float(dec_frac), 4),
             "mean_k": round(float(mean_k), 3),
             "ones_frac": round(float(ones_frac), 4),
+            "disagree_frac": round(float(disagree), 4),
         }
         curve.append(row)
         total_node_rounds += rounds * n * trials

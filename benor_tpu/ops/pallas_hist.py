@@ -162,8 +162,8 @@ def _cf_kernel(m, scal_ref, c0_ref, c1_ref, cq_ref,
     n_trials, tile = h0_ref.shape
     # counters: x0 = GLOBAL lane (node) id, x1 = GLOBAL trial id — unique
     # per lane, independent of the grid tiling AND of mesh sharding (under
-    # shard_map the shard's id offsets ride in scal_ref[4:6]), so the
-    # stream is bit-identical for every mesh shape.
+    # shard_map the shard's id offsets ride in scal_ref[2] / scal_ref[3]),
+    # so the stream is bit-identical for every mesh shape.
     node = (jax.lax.broadcasted_iota(jnp.uint32, (n_trials, tile), 1) +
             jnp.uint32(j * tile) + scal_ref[2])
     trial = (jax.lax.broadcasted_iota(jnp.uint32, (n_trials, tile), 0) +

@@ -26,6 +26,13 @@ Studies beyond the presets:
                     count-controlling adversary at N=1M: private coins
                     livelock (decided ~ 0 at the cap), the common coin
                     escapes in O(1) rounds (Ben-Or vs Rabin).
+  disagreement    — agreement-SAFETY violation rate vs split-adversary
+                    strength s at N=1M: the reference's decide rule
+                    (count > F) is only safe when at most N-F senders are
+                    alive; with all N alive and the delay adversary
+                    starving each parity class of one value, healthy nodes
+                    decide OPPOSITE values (PARITY.md "Findings beyond the
+                    reference"), quantified here per strength.
 """
 
 from __future__ import annotations
@@ -92,6 +99,35 @@ def margin_sweep(n: int, trials: int, seed: int = 0, f_frac: float = 0.40,
     return rows
 
 
+#: Split-adversary strengths for the disagreement study — spaced to frame
+#: the sharp safety phase transition (s_c ~ 0.45 at f = 0.25: below it the
+#: quorum overlap still forces enough starved-class messages through to
+#: keep both halves on the same majority; above it each parity class
+#: decides its own favored value).  Stops at 1.0: on the histogram path
+#: every s >= 1 is exact strict priority (biased_priority_counts ignores
+#: the magnitude), so larger strengths are bit-identical repeats.
+STRENGTHS = (0.0, 0.25, 0.4, 0.45, 0.5, 0.75, 1.0)
+
+
+def disagreement_sweep(n: int, trials: int, seed: int = 0,
+                       f_frac: float = 0.25, strengths=STRENGTHS,
+                       verbose=True) -> List[Dict]:
+    rows = []
+    for s in strengths:
+        cfg = SimConfig(n_nodes=n, n_faulty=int(f_frac * n), trials=trials,
+                        max_rounds=64, delivery="quorum",
+                        scheduler="biased" if s > 0 else "uniform",
+                        adversary_strength=s, path="histogram", seed=seed)
+        pt = run_point(cfg, initial_values=_balanced(trials, n),
+                       faults=FaultSpec.none(trials, n))
+        rows.append({"strength": s, **pt.to_dict()})
+        if verbose:
+            print(f"  s={s}: disagree={pt.disagree_frac:.3f} "
+                  f"decided={pt.decided_frac:.3f} mean_k={pt.mean_k:.2f}",
+                  flush=True)
+    return rows
+
+
 def coin_contrast(n: int, trials: int, seed: int = 0,
                   f_frac: float = 0.20) -> Dict[str, List[SweepPoint]]:
     f = int(f_frac * n)
@@ -126,6 +162,9 @@ def generate(out_dir: str = "RESULTS", n_large: int = 1_000_000,
     cc = coin_contrast(n_large, trials_large, seed)
     out["coin_contrast"] = {k: [p.to_dict() for p in v]
                             for k, v in cc.items()}
+
+    print("disagreement vs adversary strength (f=0.25):", flush=True)
+    out["disagreement"] = disagreement_sweep(n_large, trials_large, seed)
 
     if presets:
         for name, cfg in baseline_configs().items():
@@ -206,6 +245,27 @@ def _write_markdown(out_dir: str, out: Dict) -> None:
         f"| {priv['rounds_executed']} |",
         f"| common | {comm['decided_frac']:.3f} | {comm['mean_k']:.2f} "
         f"| {comm['rounds_executed']} |",
+        "",
+        "## Agreement-safety violations vs split-adversary strength "
+        "(f = 0.25)",
+        "",
+        "The reference's decide rule `count > F` is only safe while at most "
+        "N-F senders are alive (its crash model guarantees that).  With all "
+        "N alive, a delay adversary that starves even receivers of 1s and "
+        "odd receivers of 0s makes the two halves decide OPPOSITE values — "
+        "`disagree` is the fraction of trials whose decided healthy nodes "
+        "hold both values.  (Every s >= 1 is exact strict priority on the "
+        "histogram path — the curve is flat beyond 1.0 by construction.)",
+        "",
+        "| strength s | disagree | decided | mean k | ones frac |",
+        "|---|---|---|---|---|",
+    ]
+    for row in out["disagreement"]:
+        lines.append(
+            f"| {row['strength']} | {row['disagree_frac']:.3f} "
+            f"| {row['decided_frac']:.3f} | {row['mean_k']:.2f} "
+            f"| {row['ones_frac']:.3f} |")
+    lines += [
         "",
         "## BASELINE.json presets",
         "",

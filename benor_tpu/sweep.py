@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .config import SimConfig, VAL1
+from .config import SimConfig, VAL0, VAL1
 from .sim import run_consensus
 from .state import FaultSpec, NetState, init_state
 
@@ -45,6 +45,11 @@ class SweepPoint:
     ones_frac: float            # decided-1 fraction among decided healthy
     seconds: float              # wall-clock for the batch (post-compile)
     trials_per_sec: float
+    #: Fraction of trials where decided healthy lanes hold BOTH values — an
+    #: agreement-safety violation (impossible under the reference's crash
+    #: model, reachable under quorum sampling + split adversaries or
+    #: byzantine faults; see PARITY.md "Findings beyond the reference").
+    disagree_frac: float = 0.0
 
     def to_dict(self) -> Dict:
         d = dataclasses.asdict(self)
@@ -54,7 +59,7 @@ class SweepPoint:
 
 @functools.partial(jax.jit, static_argnums=2)
 def summarize_final(final: NetState, faulty: jax.Array, max_rounds: int):
-    """On-device reduction -> 4 scalars + a small k histogram."""
+    """On-device reduction -> 5 scalars + a small k histogram."""
     healthy = ~faulty
     hd = final.decided & healthy
     n_hd = jnp.maximum(jnp.sum(hd), 1)
@@ -64,7 +69,12 @@ def summarize_final(final: NetState, faulty: jax.Array, max_rounds: int):
     k_hist = jnp.bincount(jnp.where(hd, final.k, 0).ravel(),
                           weights=hd.ravel().astype(jnp.int32),
                           length=max_rounds + 2)
-    return decided_frac, mean_k, ones_frac, k_hist
+    # per-trial agreement check: decided healthy lanes holding both values
+    # in the same trial is a safety violation (PARITY.md findings)
+    got0 = jnp.any(hd & (final.x == VAL0), axis=-1)
+    got1 = jnp.any(hd & (final.x == VAL1), axis=-1)
+    disagree_frac = jnp.mean((got0 & got1).astype(jnp.float32))
+    return decided_frac, mean_k, ones_frac, k_hist, disagree_frac
 
 
 def random_inputs(seed: int, trials: int, n: int) -> np.ndarray:
@@ -103,14 +113,16 @@ def run_point(cfg: SimConfig, initial_values=None, faulty_list=None,
     rounds = int(r)  # completion barrier inside the timed window
     seconds = time.perf_counter() - t0
 
-    dec, mk, ones, khist = summarize_final(final, faults.faulty, cfg.max_rounds)
+    dec, mk, ones, khist, disagree = summarize_final(
+        final, faults.faulty, cfg.max_rounds)
     return SweepPoint(
         n_nodes=cfg.n_nodes, n_faulty=cfg.n_faulty, trials=cfg.trials,
         coin_mode=cfg.coin_mode, scheduler=cfg.scheduler,
         rounds_executed=rounds, decided_frac=float(dec), mean_k=float(mk),
         k_hist=np.asarray(khist).astype(np.int64), ones_frac=float(ones),
         seconds=seconds,
-        trials_per_sec=cfg.trials / seconds if seconds > 0 else float("inf"))
+        trials_per_sec=cfg.trials / seconds if seconds > 0 else float("inf"),
+        disagree_frac=float(disagree))
 
 
 def rounds_vs_f(base_cfg: SimConfig, f_values: Sequence[int],
