@@ -1,0 +1,34 @@
+"""Persistent XLA compilation cache (best-effort).
+
+Under the axon tunnel every distinct SimConfig costs an ~8-40 s remote
+compile; the persistent cache cuts repeat invocations (bench reps, results
+regeneration, driver re-runs) to seconds — measured 52.7 s -> 12.7 s for
+the bench's 10-regime warm-up.  Failures are logged and ignored: a cache
+problem must never take down a run.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def enable_compile_cache(cache_dir: str | None = None) -> None:
+    """Point jax at a persistent compilation cache directory.
+
+    Default location: `.jax_cache/` next to the repository root (one level
+    above this package) — kept inside the workspace so it survives across
+    driver invocations and is .gitignore'd.
+    """
+    try:
+        import jax
+        if cache_dir is None:
+            pkg_root = os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))))
+            cache_dir = os.path.join(pkg_root, ".jax_cache")
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:  # noqa: BLE001 — strictly best-effort
+        print(f"[benor_tpu] compile cache unavailable: {e}",
+              file=sys.stderr, flush=True)
