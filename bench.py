@@ -130,10 +130,11 @@ def _enable_compile_cache() -> None:
     """Repo-local persistent compilation cache: the 10-regime warm-up costs
     ~50-75 s of (remote) compiles per cold bench invocation; the cache cuts
     repeats to ~13 s.  Best-effort — a failure must not take the bench
-    down."""
+    down (the helper defaults to the same repo-root .jax_cache and catches
+    everything except a broken import)."""
     try:
         from benor_tpu.utils.cache import enable_compile_cache
-        enable_compile_cache(os.path.join(HERE, ".jax_cache"))
+        enable_compile_cache()
     except Exception as e:  # noqa: BLE001
         log(f"bench: compile cache unavailable: {e}")
 

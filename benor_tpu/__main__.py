@@ -14,9 +14,21 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 import numpy as np
+
+
+def _honor_platform_env() -> None:
+    """Make ``JAX_PLATFORMS=cpu python -m benor_tpu ...`` actually work:
+    the axon TPU plugin overrides the env var at import time (and then
+    hangs if the chip is unreachable), so re-assert the user's explicit
+    choice via the config API, which wins."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
 
 
 def _demo(args) -> int:
@@ -38,15 +50,34 @@ def _demo(args) -> int:
 
 def _sweep(args) -> int:
     from .config import SimConfig
-    from .sweep import rounds_vs_f, save_points
+    from .sweep import rounds_vs_f, run_point, save_points
     f_values = [int(x) for x in args.f_values.split(",")]
     cfg = SimConfig(n_nodes=args.n, n_faulty=0, trials=args.trials,
                     max_rounds=args.max_rounds, delivery="quorum",
                     scheduler=args.scheduler, coin_mode=args.coin,
                     seed=args.seed)
+    mode = "balanced/no-crash" if args.balanced else "iid/crash"
     print(f"rounds-vs-f sweep: N={args.n}, trials={args.trials}, "
-          f"scheduler={args.scheduler}, coin={args.coin}")
-    points = rounds_vs_f(cfg, f_values)
+          f"scheduler={args.scheduler}, coin={args.coin}, inputs={mode}")
+    if args.balanced:
+        # the science regime: balanced inputs, F purely a protocol
+        # parameter (crash-pinned faults make every tally the deterministic
+        # full-population draw and the curve degenerates — see RESULTS.md)
+        from .state import FaultSpec
+        bal = np.tile((np.arange(args.n) % 2).astype(np.int8),
+                      (args.trials, 1))
+        points = []
+        for f in f_values:
+            pt = run_point(cfg.replace(n_faulty=int(f)),
+                           initial_values=bal,
+                           faults=FaultSpec.none(args.trials, args.n))
+            points.append(pt)
+            print(f"  f={f}: mean_k={pt.mean_k:.2f} "
+                  f"decided={pt.decided_frac:.3f} "
+                  f"disagree={pt.disagree_frac:.3f} "
+                  f"{pt.trials_per_sec:.1f} trials/s", flush=True)
+    else:
+        points = rounds_vs_f(cfg, f_values)
     if args.out:
         save_points(args.out, points)
         print(f"wrote {args.out}")
@@ -107,6 +138,10 @@ def main(argv=None) -> int:
                    default="uniform")
     s.add_argument("--coin", choices=("private", "common"), default="private")
     s.add_argument("--seed", type=int, default=0)
+    s.add_argument("--balanced", action="store_true",
+                   help="balanced inputs + zero crashes (the multi-round "
+                        "science regime; default is the reference-style "
+                        "iid-inputs/crash-faults workload)")
     s.add_argument("--out", help="write points to this JSON file")
 
     c = sub.add_parser("coins", help="private vs common coin, adversarial")
@@ -134,6 +169,7 @@ def main(argv=None) -> int:
                                    "results", "-h", "--help"):
         argv = ["demo"] + argv
     args = ap.parse_args(argv)
+    _honor_platform_env()
     return {"demo": _demo, "sweep": _sweep, "coins": _coins,
             "preset": _preset, "results": _results}[args.cmd](args)
 

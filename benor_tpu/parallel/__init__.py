@@ -2,9 +2,11 @@
 
 from .mesh import (AXIS_NODES, AXIS_TRIALS, STATE_SPEC, make_mesh,
                    state_sharding)
-from .sharded import MESH_CTX, run_consensus_sharded, shard_inputs
+from .sharded import (MESH_CTX, resume_consensus_sharded,
+                      run_consensus_sharded, shard_inputs)
 
 __all__ = [
     "AXIS_NODES", "AXIS_TRIALS", "STATE_SPEC", "make_mesh", "state_sharding",
-    "MESH_CTX", "run_consensus_sharded", "shard_inputs",
+    "MESH_CTX", "resume_consensus_sharded", "run_consensus_sharded",
+    "shard_inputs",
 ]

@@ -39,16 +39,27 @@ MESH_CTX = ShardCtx(trial_axis=meshlib.AXIS_TRIALS,
                     node_axis=meshlib.AXIS_NODES)
 
 
-def _local_run(cfg: SimConfig, state: NetState, faults: FaultSpec,
-               base_key: jax.Array) -> Tuple[jax.Array, NetState]:
-    """Per-shard body: full /start -> termination loop on local blocks.
+def _local_run(cfg: SimConfig, fresh: bool, state: NetState,
+               faults: FaultSpec, base_key: jax.Array,
+               from_round: jax.Array) -> Tuple[jax.Array, NetState]:
+    """Per-shard body: /start (or checkpoint re-entry) -> termination loop.
+
+    ``fresh`` (static) applies the /start transition; a resume re-enters
+    the loop at ``from_round`` (a TRACED replicated scalar, so every resume
+    round reuses one compiled executable — baking it into the trace would
+    cost an 8-40 s remote compile per distinct checkpoint round) — the
+    sharded counterpart of sim.resume_consensus (checkpoint/resume, SURVEY
+    §5.4).  Randomness keys on (base_key, round, phase, global ids), never
+    loop history, so a resumed run is bit-identical to an uninterrupted one
+    on ANY mesh shape.
 
     The loop carries a replicated ``settled`` flag computed via psum so all
     shards take identical trip counts (a shard-local predicate would
     deadlock the collectives inside the body).
     """
     ctx = MESH_CTX
-    state = start_state(cfg, state)
+    if fresh:
+        state = start_state(cfg, state)
 
     def body(carry):
         r, st, _ = carry
@@ -65,17 +76,18 @@ def _local_run(cfg: SimConfig, state: NetState, faults: FaultSpec,
         return (r <= cfg.max_rounds) & ~settled
 
     r, state, _ = jax.lax.while_loop(
-        cond, body, (jnp.int32(1), state, all_settled(state, ctx)))
+        cond, body,
+        (from_round.astype(jnp.int32), state, all_settled(state, ctx)))
     return r - 1, state
 
 
 @functools.lru_cache(maxsize=None)
-def _compiled(cfg: SimConfig, mesh: Mesh):
+def _compiled(cfg: SimConfig, mesh: Mesh, fresh: bool = True):
     sspec = meshlib.STATE_SPEC
     fn = shard_map(
-        functools.partial(_local_run, cfg),
+        functools.partial(_local_run, cfg, fresh),
         mesh=mesh,
-        in_specs=(sspec, sspec, P()),
+        in_specs=(sspec, sspec, P(), P()),
         out_specs=(P(), sspec),
         check_vma=False,  # while_loop results can't be proven replicated
     )
@@ -102,4 +114,21 @@ def run_consensus_sharded(cfg: SimConfig, state: NetState, faults: FaultSpec,
     """
     meshlib.check_divisible(cfg.trials, cfg.n_nodes, mesh)
     state, faults = shard_inputs(state, faults, mesh)
-    return _compiled(cfg, mesh)(state, faults, base_key)
+    return _compiled(cfg, mesh)(state, faults, base_key, jnp.int32(1))
+
+
+def resume_consensus_sharded(cfg: SimConfig, state: NetState,
+                             faults: FaultSpec, base_key: jax.Array,
+                             mesh: Mesh,
+                             from_round: int) -> Tuple[jax.Array, NetState]:
+    """Re-enter the round loop from a checkpointed round index on a mesh.
+
+    Sharded counterpart of sim.resume_consensus: a checkpoint written by a
+    single-device (or any-mesh) run resumes bit-identically on any mesh
+    shape.  ``from_round`` is the 1-based next round (checkpoint's
+    ``next_round``); it is traced, so resumes at different rounds share one
+    compiled executable."""
+    meshlib.check_divisible(cfg.trials, cfg.n_nodes, mesh)
+    state, faults = shard_inputs(state, faults, mesh)
+    return _compiled(cfg, mesh, fresh=False)(state, faults, base_key,
+                                             jnp.int32(from_round))

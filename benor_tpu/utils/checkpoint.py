@@ -87,15 +87,23 @@ def load_checkpoint(path: str):
     return cfg, state, faults, next_round, base_key
 
 
-def resume_from(path: str):
+def resume_from(path: str, mesh=None):
     """Load ``path`` and run the loop to termination.
 
     Returns (rounds_executed_total, final_state, faults) — ``rounds`` counts
     from the start of the original run, matching an uninterrupted
-    ``run_consensus``.
+    ``run_consensus``.  Pass a ``jax.sharding.Mesh`` to resume on a device
+    mesh: checkpoints are mesh-agnostic (randomness keys on global ids), so
+    a single-device checkpoint resumes bit-identically on any mesh shape
+    and vice versa.
     """
-    from ..sim import resume_consensus
-
     cfg, state, faults, next_round, base_key = load_checkpoint(path)
-    rounds, final = resume_consensus(cfg, state, faults, base_key, next_round)
+    if mesh is not None:
+        from ..parallel import resume_consensus_sharded
+        rounds, final = resume_consensus_sharded(
+            cfg, state, faults, base_key, mesh, next_round)
+    else:
+        from ..sim import resume_consensus
+        rounds, final = resume_consensus(cfg, state, faults, base_key,
+                                         next_round)
     return rounds, final, faults

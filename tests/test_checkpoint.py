@@ -19,9 +19,9 @@ from benor_tpu.utils.checkpoint import (load_checkpoint, resume_from,
 
 def _setup(**overrides):
     n, f = 120, 40
-    cfg = SimConfig(n_nodes=n, n_faulty=f, trials=32, max_rounds=48,
-                    delivery="quorum", scheduler="uniform", path="dense",
-                    seed=7, **overrides)
+    kw = dict(delivery="quorum", scheduler="uniform", path="dense", seed=7)
+    kw.update(overrides)
+    cfg = SimConfig(n_nodes=n, n_faulty=f, trials=32, max_rounds=48, **kw)
     faulty = [True] * f + [False] * (n - f)
     vals = [1] * f + [1] * 40 + [0] * 40  # balanced healthy inputs
     faults = FaultSpec.from_faulty_list(cfg, faulty)
@@ -54,6 +54,32 @@ def test_resume_bit_identical(tmp_path):
                                   np.asarray(final_full.k))
     np.testing.assert_array_equal(np.asarray(final_res.killed),
                                   np.asarray(final_full.killed))
+
+
+def test_resume_on_mesh_bit_identical(tmp_path):
+    """A single-device checkpoint resumes on a device mesh (and the result
+    is bit-identical to the uninterrupted single-device run): checkpoints
+    are mesh-agnostic because randomness keys on global ids."""
+    from benor_tpu.parallel import make_mesh
+
+    cfg, state, faults = _setup(path="histogram")
+    base_key = jax.random.key(cfg.seed)
+    rounds_full, final_full = run_consensus(cfg, state, faults, base_key)
+    assert int(rounds_full) >= 3, "config must take several rounds"
+
+    cfg_cap = cfg.replace(max_rounds=2)
+    rounds_cap, mid = run_consensus(cfg_cap, state, faults, base_key)
+    path = str(tmp_path / "ckpt_mesh.npz")
+    save_checkpoint(path, cfg, mid, faults, next_round=int(rounds_cap) + 1)
+
+    rounds_res, final_res, _ = resume_from(path, mesh=make_mesh(2, 4))
+    assert int(rounds_res) == int(rounds_full)
+    np.testing.assert_array_equal(np.asarray(final_res.x),
+                                  np.asarray(final_full.x))
+    np.testing.assert_array_equal(np.asarray(final_res.decided),
+                                  np.asarray(final_full.decided))
+    np.testing.assert_array_equal(np.asarray(final_res.k),
+                                  np.asarray(final_full.k))
 
 
 def test_resume_preserves_custom_base_key(tmp_path):

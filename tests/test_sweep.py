@@ -100,3 +100,14 @@ class TestCli:
         assert main(["sweep", "--n", "12", "--f-values", "0,3",
                      "--trials", "16", "--out", out]) == 0
         assert len(json.load(open(out))) == 2
+
+    def test_sweep_cli_balanced(self, tmp_path, capsys):
+        """--balanced: zero crashes + balanced inputs (the science regime);
+        points carry the disagree_frac field."""
+        from benor_tpu.__main__ import main
+        out = str(tmp_path / "sb.json")
+        assert main(["sweep", "--n", "24", "--f-values", "4,9",
+                     "--trials", "16", "--balanced", "--out", out]) == 0
+        pts = json.load(open(out))
+        assert len(pts) == 2 and all("disagree_frac" in p for p in pts)
+        assert "balanced/no-crash" in capsys.readouterr().out
