@@ -13,17 +13,17 @@ from benor_tpu.config import SimConfig, VALQ
 from benor_tpu.sim import simulate
 
 
-def _run(n, f, trials, seed, *, vals=None, faulty=None, **overrides):
-    kw = dict(delivery="quorum", scheduler="uniform")
+def _run(n, f, trials, seed, *, vals=None, faulty=None, faults=None,
+         **overrides):
+    kw = dict(delivery="quorum", scheduler="uniform", max_rounds=64)
     kw.update(overrides)
-    cfg = SimConfig(n_nodes=n, n_faulty=f, trials=trials, max_rounds=64,
-                    seed=seed, **kw)
+    cfg = SimConfig(n_nodes=n, n_faulty=f, trials=trials, seed=seed, **kw)
     if vals is None:
         vals = np.random.default_rng(seed).integers(
             0, 2, size=(trials, n), dtype=np.int8)
-    if faulty is None:
+    if faulty is None and faults is None:
         faulty = [True] * f + [False] * (n - f)
-    rounds, final, faults = simulate(cfg, vals, faulty)
+    rounds, final, faults = simulate(cfg, vals, faulty, faults=faults)
     healthy = ~np.asarray(faults.faulty)
     return (np.asarray(final.x), np.asarray(final.decided),
             np.asarray(final.k), healthy)
@@ -60,6 +60,39 @@ def test_termination_under_threshold(scheduler):
         30, 14, 64, 13, scheduler=scheduler, path="dense",
         adversary_strength=0.75 if scheduler == "biased" else 0.0)
     assert (decided | ~healthy).all()
+
+
+@pytest.mark.parametrize("path", ["dense", "histogram"])
+def test_textbook_rule_agreement_and_termination(path):
+    """rule='textbook' (coin whenever no value has > F votes — classic
+    Ben-Or, no plurality-adopt) still satisfies agreement and terminates
+    under the crash model; only the kernel's decision-rule flag differs
+    from the reference-mode runs above."""
+    x, decided, _, healthy = _run(60, 15, 64, 5, path=path,
+                                  rule="textbook")
+    hd = healthy & decided
+    assert (hd | ~healthy).all(), "healthy lanes must all decide"
+    for t in range(x.shape[0]):
+        vals = x[t][hd[t]]
+        assert (vals == vals[0]).all(), f"trial {t} disagrees"
+
+
+def test_textbook_coin_contrast_under_adversary():
+    """Textbook mode preserves the classic contrast: the count-controlling
+    adversary livelocks private coins but not the shared common coin."""
+    from benor_tpu.state import FaultSpec
+    n, trials = 100, 16
+    vals = np.tile(np.arange(n, dtype=np.int8) % 2, (trials, 1))
+    # zero crashes (FaultSpec.none — the launch validation pins list-born
+    # faults to exactly F), leaving the adversary its full delivery slack
+    base = dict(n=n, f=40, trials=trials, seed=6, vals=vals,
+                scheduler="adversarial", rule="textbook",
+                faults=FaultSpec.none(trials, n))
+    x, dec, _, healthy = _run(**{**base}, coin_mode="private",
+                              max_rounds=24)
+    assert not dec[healthy.astype(bool)].any(), "private coin must livelock"
+    x, dec, k, healthy = _run(**{**base}, coin_mode="common")
+    assert dec[healthy.astype(bool)].all(), "common coin must converge"
 
 
 def test_no_decision_value_is_question_mark():
