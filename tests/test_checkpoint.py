@@ -82,6 +82,47 @@ def test_resume_on_mesh_bit_identical(tmp_path):
                                   np.asarray(final_full.k))
 
 
+def test_resume_with_crash_at_round_bit_identical(tmp_path):
+    """Mid-run crashes scheduled AFTER the checkpoint round still fire on
+    resume: FaultSpec.crash_round is persisted and the kernel re-derives
+    killed-at-round-r from it, so interrupting before a scheduled crash
+    cannot lose it."""
+    n, f = 60, 25
+    # F > N/3 (decide threshold above the typical class count) + balanced
+    # inputs so the run takes several rounds; crashes staggered across
+    # rounds 1..5 — some fire after the round-2 checkpoint cut
+    cfg = SimConfig(n_nodes=n, n_faulty=f, trials=16, max_rounds=48,
+                    delivery="quorum", scheduler="uniform", path="dense",
+                    fault_model="crash_at_round", seed=11)
+    faulty = [True] * f + [False] * (n - f)
+    crash_rounds = [1 + (i % 5) for i in range(f)] + [0] * (n - f)
+    vals = [i % 2 for i in range(n)]
+    faults = FaultSpec.from_faulty_list(cfg, faulty, crash_rounds)
+    state = init_state(cfg, vals, faults)
+    base_key = jax.random.key(cfg.seed)
+
+    rounds_full, final_full = run_consensus(cfg, state, faults, base_key)
+    assert int(rounds_full) >= 3
+
+    cfg_cap = cfg.replace(max_rounds=2)
+    rounds_cap, mid = run_consensus(cfg_cap, state, faults, base_key)
+    path = str(tmp_path / "ckpt_car.npz")
+    save_checkpoint(path, cfg, mid, faults, next_round=int(rounds_cap) + 1)
+
+    rounds_res, final_res, _ = resume_from(path)
+    assert int(rounds_res) == int(rounds_full)
+    np.testing.assert_array_equal(np.asarray(final_res.x),
+                                  np.asarray(final_full.x))
+    np.testing.assert_array_equal(np.asarray(final_res.killed),
+                                  np.asarray(final_full.killed))
+    # every crash scheduled at-or-before the last executed round really
+    # fired post-resume (later ones can't: the loop exits on termination)
+    cr = np.asarray(crash_rounds[:f])
+    due = cr <= int(rounds_res)
+    assert due[2:].any(), "test must cover crashes after the round-2 cut"
+    assert np.asarray(final_res.killed)[:, :f][:, due].all()
+
+
 def test_resume_preserves_custom_base_key(tmp_path):
     """A run started with a non-default key resumes on the SAME streams."""
     cfg, state, faults = _setup()
