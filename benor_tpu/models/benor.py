@@ -103,8 +103,20 @@ def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
 
     decide0 = v0 > F                                         # node.ts:99
     decide1 = v1 > F                                         # node.ts:102
-    coin = rng.coin_flips(base_key, r, ctx.trial_ids(T), ctx.node_ids(N),
-                          common=(cfg.coin_mode == "common"))
+    if tally.pallas_hist_active(cfg) and cfg.coin_mode == "private":
+        # One threefry block per lane in VMEM instead of the chained
+        # fold_in pipeline — switches together with the sampler kernel so
+        # use_pallas_hist selects ONE coherent alternative stream
+        # (statistically identical; KS-gated in tests/test_pallas_hist.py).
+        from ..ops.pallas_hist import coin_flips_pallas
+        coin = coin_flips_pallas(
+            base_key, r, T, N, interpret=jax.default_backend() == "cpu",
+            node_offset=ctx.node_ids(N)[0],
+            trial_offset=ctx.trial_ids(T)[0])
+    else:
+        coin = rng.coin_flips(base_key, r, ctx.trial_ids(T),
+                              ctx.node_ids(N),
+                              common=(cfg.coin_mode == "common"))
     if cfg.rule == "reference":
         # plurality-adopt before coin (node.ts:106-112)
         any_votes = (v0 + v1) > 0

@@ -83,6 +83,43 @@ def _threefry2x32(k0, k1, x0, x1):
     return x0, x1
 
 
+def _lane_ids(scal_ref, shape):
+    """GLOBAL (node, trial) uint32 counter grids for the current tile.
+
+    x0 = global lane (node) id, x1 = global trial id — unique per lane,
+    independent of the grid tiling AND of mesh sharding (under shard_map
+    the shard's id offsets ride in scal_ref[2] / scal_ref[3]), so every
+    stream built on these counters is bit-identical for every mesh shape.
+    Shared by ALL kernels in this module — the paired-stream guarantee
+    depends on a single counter scheme.
+    """
+    j = pl.program_id(0)
+    n_trials, tile = shape
+    node = (jax.lax.broadcasted_iota(jnp.uint32, (n_trials, tile), 1) +
+            jnp.uint32(j * tile) + scal_ref[2])
+    trial = (jax.lax.broadcasted_iota(jnp.uint32, (n_trials, tile), 0) +
+             scal_ref[3])
+    return node, trial
+
+
+def _stream_scal(base_key: jax.Array, r: jax.Array, salt: int,
+                 node_offset, trial_offset) -> jax.Array:
+    """SMEM scalar vector [4] = (k0, k1, node_offset, trial_offset).
+
+    The kernel key is one scalar threefry application OUTSIDE the kernel:
+    key words = base_key data, counter words = (round, salt) — collision-
+    free across rounds/streams.  uint32 up front: in-kernel scalar
+    bitcasts are unsupported.  Shared by all kernels in this module.
+    """
+    kd = jax.random.key_data(base_key).astype(jnp.uint32).reshape(-1)
+    k0, k1 = _threefry2x32(kd[0], kd[-1], r.astype(jnp.uint32),
+                           jnp.uint32(salt))
+    return jnp.stack([
+        k0, k1,
+        jnp.asarray(node_offset).astype(jnp.uint32),
+        jnp.asarray(trial_offset).astype(jnp.uint32)])
+
+
 def _bits_to_uniform(bits: jax.Array) -> jax.Array:
     """uint32 bits -> f32 uniform in (0, 1), Mosaic-safe (no int->float
     cast): splice the top 23 bits into a [1, 2) mantissa and subtract 1."""
@@ -158,16 +195,7 @@ def _cf_kernel(m, scal_ref, c0_ref, c1_ref, cq_ref,
     c0/c1/cq_ref: VMEM f32 [T, 1] global class counts per trial.
     h0/h1/hq_ref: VMEM int32 [T, TILE_N] outputs (this tile's lanes).
     """
-    j = pl.program_id(0)
-    n_trials, tile = h0_ref.shape
-    # counters: x0 = GLOBAL lane (node) id, x1 = GLOBAL trial id — unique
-    # per lane, independent of the grid tiling AND of mesh sharding (under
-    # shard_map the shard's id offsets ride in scal_ref[2] / scal_ref[3]),
-    # so the stream is bit-identical for every mesh shape.
-    node = (jax.lax.broadcasted_iota(jnp.uint32, (n_trials, tile), 1) +
-            jnp.uint32(j * tile) + scal_ref[2])
-    trial = (jax.lax.broadcasted_iota(jnp.uint32, (n_trials, tile), 0) +
-             scal_ref[3])
+    node, trial = _lane_ids(scal_ref, h0_ref.shape)
     b0, b1 = _threefry2x32(scal_ref[0], scal_ref[1], node, trial)
     u0 = _bits_to_uniform(b0)
     u1 = _bits_to_uniform(b1)
@@ -185,6 +213,55 @@ def _cf_kernel(m, scal_ref, c0_ref, c1_ref, cq_ref,
     h0_ref[...] = h0.astype(jnp.int32)
     h1_ref[...] = h1.astype(jnp.int32)
     hq_ref[...] = hq.astype(jnp.int32)
+
+
+def _coin_kernel(scal_ref, out_ref):
+    """Private fair coin per lane: one threefry block, bit 0.
+
+    scal_ref: SMEM uint32 [4] = (k0, k1, node_offset, trial_offset)."""
+    node, trial = _lane_ids(scal_ref, out_ref.shape)
+    bits, _ = _threefry2x32(scal_ref[0], scal_ref[1], node, trial)
+    # int32 store: narrow int8 vector stores are a Mosaic constraint risk
+    # (cf. the minor-dim-reshape rule); the cast to int8 happens outside
+    out_ref[...] = (bits & jnp.uint32(1)).astype(jnp.int32)
+
+
+#: Key-derivation counter word (the second threefry counter, the first is
+#: the round index) for the coin stream.  Reserved words: cf_counts_pallas
+#: uses its raw ``phase`` tag here (rng.PHASE_PROPOSAL=0 / PHASE_VOTE=1);
+#: any new stream must pick a word outside {0, 1, 255}.
+_COIN_SALT = 255
+
+
+@functools.partial(jax.jit, static_argnames=("trials", "n_nodes",
+                                             "interpret"))
+def coin_flips_pallas(base_key: jax.Array, r: jax.Array, trials: int,
+                      n_nodes: int, interpret: bool = False,
+                      node_offset: jax.Array | int = 0,
+                      trial_offset: jax.Array | int = 0) -> jax.Array:
+    """Private per-(trial, node, round) fair coins -> int8 [T, N].
+
+    Drop-in statistical replacement for ops.rng.coin_flips(common=False)
+    on the pallas-accelerated path: the XLA pipeline spends a chained
+    fold_in (two threefry blocks + key materialization) per lane per
+    round; this is ONE block per lane in VMEM.  Same global-id counter
+    scheme as cf_counts_pallas, so results are bit-identical across mesh
+    shapes.  (The common coin stays on the XLA path — it is one draw per
+    trial, not a per-lane op.)
+    """
+    n_pad = (-n_nodes) % TILE_N
+    np_total = n_nodes + n_pad
+    scal = _stream_scal(base_key, r, _COIN_SALT, node_offset, trial_offset)
+    out = pl.pallas_call(
+        _coin_kernel,
+        out_shape=jax.ShapeDtypeStruct((trials, np_total), jnp.int32),
+        grid=(np_total // TILE_N,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=pl.BlockSpec((trials, TILE_N), lambda j: (0, j),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(scal)
+    return out[:, :n_nodes].astype(jnp.int8)
 
 
 @functools.partial(jax.jit,
@@ -216,19 +293,10 @@ def cf_counts_pallas(base_key: jax.Array, r: jax.Array, phase: int,
     n_pad = (-n_nodes) % TILE_N
     np_total = n_nodes + n_pad
 
-    # Per-(key, round, phase) kernel key, derived by one scalar threefry
-    # application OUTSIDE the kernel: key words = base_key data, counter
-    # words = (r, phase).  Collision-free in all inputs; inside the kernel
-    # one PRF block per lane yields both uniforms (the XLA path's
-    # phase / phase+16 split becomes the block's two output words).
-    # uint32 up front: in-kernel scalar bitcasts are unsupported.
-    kd = jax.random.key_data(base_key).astype(jnp.uint32).reshape(-1)
-    k0, k1 = _threefry2x32(kd[0], kd[-1], r.astype(jnp.uint32),
-                           jnp.uint32(phase))
-    scal = jnp.stack([
-        k0, k1,
-        jnp.asarray(node_offset).astype(jnp.uint32),
-        jnp.asarray(trial_offset).astype(jnp.uint32)])
+    # stream salt = the raw phase tag; inside the kernel one PRF block per
+    # lane yields both uniforms (the XLA path's phase / phase+16 split
+    # becomes the block's two output words)
+    scal = _stream_scal(base_key, r, phase, node_offset, trial_offset)
 
     cls = hist.astype(jnp.float32)[..., None]               # [T, 3, 1]
     c0, c1, cq = cls[:, 0], cls[:, 1], cls[:, 2]            # [T, 1] each

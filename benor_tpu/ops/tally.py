@@ -23,6 +23,17 @@ from . import rng, sampling, scheduler
 from .collectives import SINGLE, ShardCtx
 
 
+def pallas_hist_active(cfg: SimConfig) -> bool:
+    """True iff the fused pallas sampler serves this config's histogram
+    tallies (and, for private coins, the coin kernel) — the uniform-
+    scheduler CF regime.  One predicate so the sampler and coin streams
+    switch together."""
+    return (cfg.use_pallas_hist and cfg.scheduler == "uniform"
+            and cfg.delivery == "quorum"
+            and cfg.resolved_path == "histogram"
+            and cfg.quorum > sampling.EXACT_TABLE_MAX)
+
+
 def dense_gather_needed(cfg: SimConfig) -> bool:
     """True iff receiver_counts will take the dense masked path (and thus
     gather sender arrays).  Callers use this to prefetch the round-constant
@@ -110,8 +121,7 @@ def receiver_counts(cfg: SimConfig, base_key: jax.Array, r: jax.Array,
 
     # histogram path
     hist = class_histogram(sent, alive, ctx)
-    if (cfg.use_pallas_hist and cfg.scheduler == "uniform"
-            and cfg.quorum > sampling.EXACT_TABLE_MAX):
+    if pallas_hist_active(cfg):
         # Fused pallas sampler (the flagship-path kernel): bits + quantile +
         # CF draws in one VMEM pass.  Own stream keyed on base_key (NOT
         # cfg.seed — distinct-key MC replications must stay independent);

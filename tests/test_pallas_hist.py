@@ -83,6 +83,25 @@ class TestKernel:
         np.testing.assert_array_equal(c[..., 1], 200)
         np.testing.assert_array_equal(c[..., 2], 100)
 
+    def test_coin_kernel_fair_and_deterministic(self):
+        from benor_tpu.ops.pallas_hist import coin_flips_pallas
+        k = jax.random.key(3)
+        a = np.asarray(coin_flips_pallas(k, jnp.int32(2), 16, 2048,
+                                         interpret=True))
+        assert a.shape == (16, 2048) and set(np.unique(a)) <= {0, 1}
+        # fair within binomial noise (32768 draws, sigma ~ 0.0028)
+        assert abs(a.mean() - 0.5) < 0.012
+        b = np.asarray(coin_flips_pallas(k, jnp.int32(2), 16, 2048,
+                                         interpret=True))
+        assert np.array_equal(a, b)                          # deterministic
+        c = np.asarray(coin_flips_pallas(k, jnp.int32(3), 16, 2048,
+                                         interpret=True))
+        assert not np.array_equal(a, c)                      # round stream
+        # global-id offsets: shard (offset 1024) == right half of full grid
+        d = np.asarray(coin_flips_pallas(k, jnp.int32(2), 16, 1024,
+                                         interpret=True, node_offset=1024))
+        np.testing.assert_array_equal(a[:, 1024:], d)
+
     def test_ragged_n_padding(self):
         # N not a multiple of TILE_N exercises the pad+slice path
         c = _counts(7, 2, 0, [900, 800, 300], 1500, 700)
