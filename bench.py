@@ -394,15 +394,23 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
     # pallas-kernel compile failure on this chip generation demotes that
     # regime to the XLA path instead of killing the whole artifact.
     t0 = time.perf_counter()
+    demoted = []
     for i, (name, cfg, state, faults) in enumerate(regimes):
         try:
             r, final = run_consensus(cfg, state, faults, base_key)
             int(r)  # scalar fetch = real completion barrier under the tunnel
         except Exception as e:  # noqa: BLE001
-            if not cfg.use_pallas_hist:
+            # demote ONLY for kernel-lowering failures: an unrelated error
+            # (e.g. OOM) would hit the XLA path too — fail fast with the
+            # right attribution instead of paying a doomed second compile
+            if not cfg.use_pallas_hist or not any(
+                    s in f"{type(e).__name__}: {e}"
+                    for s in ("Mosaic", "mosaic", "pallas", "Pallas")):
                 raise
-            log(f"bench: {name} pallas path failed ({type(e).__name__}); "
+            log(f"bench: {name} pallas kernel failed ({type(e).__name__}); "
                 f"falling back to the XLA sampler for this regime")
+            demoted.append({"regime": name,
+                            "error": f"{type(e).__name__}: {e}"[:300]})
             cfg = cfg.replace(use_pallas_hist=False)
             regimes[i] = (name, cfg, state, faults)
             r, final = run_consensus(cfg, state, faults, base_key)
@@ -458,6 +466,7 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
         row = {
             "regime": name, "f_frac": round(cfg.n_faulty / n, 3),
             "scheduler": cfg.scheduler, "coin": cfg.coin_mode,
+            "pallas": cfg.use_pallas_hist,
             "rounds_executed": rounds,
             "decided": round(float(dec_frac), 4),
             "mean_k": round(float(mean_k), 3),
@@ -519,6 +528,7 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
         "coin_contrast": coin_contrast,
         "pallas_check": pallas,
         "pallas_hist_check": pallas_hist,
+        "pallas_demoted": demoted,
     }
 
 
