@@ -94,6 +94,50 @@ class TestHttpParity:
                              "decided": None, "k": None}
 
 
+def _raw_request(port: int, payload: bytes) -> bytes:
+    import socket
+    with socket.create_connection(("127.0.0.1", port), timeout=10) as s:
+        s.sendall(payload)
+        s.settimeout(10)
+        chunks = []
+        try:
+            while True:
+                b = s.recv(4096)
+                if not b:
+                    break
+                chunks.append(b)
+        except OSError:
+            pass
+    return b"".join(chunks)
+
+
+def test_post_chunked_body_411():
+    """A chunked body cannot be drained by count: 411 + connection close,
+    and the response must actually arrive (no RST discard)."""
+    net = launch_network(1, 0, [1], [False], backend="tpu")
+    with NodeHttpCluster(net, BASE + 60):
+        resp = _raw_request(
+            BASE + 60,
+            b"POST /message HTTP/1.1\r\nHost: x\r\n"
+            b"Transfer-Encoding: chunked\r\n\r\n"
+            b"5\r\nhello\r\n0\r\n\r\n")
+        assert b"411" in resp.split(b"\r\n", 1)[0]
+        assert b"chunked" in resp
+
+
+def test_post_malformed_content_length_400():
+    """A garbage Content-Length must produce a 400, not a handler crash
+    with no response at all."""
+    net = launch_network(1, 0, [1], [False], backend="tpu")
+    with NodeHttpCluster(net, BASE + 61):
+        resp = _raw_request(
+            BASE + 61,
+            b"POST /message HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Length: abc\r\n\r\nxx")
+        assert b"400" in resp.split(b"\r\n", 1)[0]
+        assert b"Content-Length" in resp
+
+
 def test_serve_network_usable_as_context_manager():
     """serve_network() returns an already-serving cluster; entering it as a
     context manager must be a no-op start (regression: threads were started
