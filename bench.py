@@ -155,13 +155,6 @@ def _hbm_peak_for(device_kind: str):
     return None
 
 
-def _balanced(trials: int, n: int) -> np.ndarray:
-    """Exactly ceil(N/2)/floor(N/2) split — the margin is 0, so phase
-    outcomes are decided by sampling noise, not by the inputs (the round-2
-    degenerate curve came from iid inputs whose sqrt(N) margin drowned it)."""
-    return np.tile((np.arange(n) % 2).astype(np.int8), (trials, 1))
-
-
 def _regimes(n, trials, fracs, max_rounds, seed, use_pallas_hist=False):
     """The measured workload set -> [(name, cfg, state, faults)].
 
@@ -187,7 +180,7 @@ def _regimes(n, trials, fracs, max_rounds, seed, use_pallas_hist=False):
     """
     from benor_tpu.config import SimConfig
     from benor_tpu.state import FaultSpec, init_state
-    from benor_tpu.sweep import random_inputs
+    from benor_tpu.sweep import balanced_inputs, random_inputs
     import jax.numpy as jnp
 
     def no_crash(cfg):
@@ -200,7 +193,9 @@ def _regimes(n, trials, fracs, max_rounds, seed, use_pallas_hist=False):
     base = dict(n_nodes=n, trials=trials, max_rounds=max_rounds,
                 delivery="quorum", path="histogram", fault_model="crash",
                 seed=seed, use_pallas_hist=use_pallas_hist)
-    bal = _balanced(trials, n)
+    # zero-margin inputs (the round-2 degenerate curve came from iid
+    # inputs whose sqrt(N) margin drowned the sampling noise)
+    bal = balanced_inputs(trials, n)
     regs = []
 
     # r2-continuity point: iid inputs, crash-from-birth faults, f=0.2

@@ -64,8 +64,8 @@ def _sweep(args) -> int:
         # parameter (crash-pinned faults make every tally the deterministic
         # full-population draw and the curve degenerates — see RESULTS.md)
         from .state import FaultSpec
-        bal = np.tile((np.arange(args.n) % 2).astype(np.int8),
-                      (args.trials, 1))
+        from .sweep import balanced_inputs
+        bal = balanced_inputs(args.trials, args.n)
         points = []
         for f in f_values:
             pt = run_point(cfg.replace(n_faulty=int(f)),

@@ -83,6 +83,13 @@ def random_inputs(seed: int, trials: int, n: int) -> np.ndarray:
         0, 2, size=(trials, n), dtype=np.int8)
 
 
+def balanced_inputs(trials: int, n: int) -> np.ndarray:
+    """Interleaved perfectly-balanced bits (node i starts with i mod 2) —
+    the zero-margin worst case every multi-round science regime uses
+    (margin 0 puts phase outcomes entirely inside sampling noise)."""
+    return np.tile((np.arange(n) % 2).astype(np.int8), (trials, 1))
+
+
 def run_point(cfg: SimConfig, initial_values=None, faulty_list=None,
               faults: Optional[FaultSpec] = None) -> SweepPoint:
     """Run one MC batch to termination; returns its on-device summary.
@@ -172,7 +179,7 @@ def coin_comparison(base_cfg: SimConfig,
             f"adversary (got N-F={base_cfg.quorum}); adjust N or F")
     T, N = base_cfg.trials, base_cfg.n_nodes
     no_crash = FaultSpec.none(T, N)
-    balanced = np.tile(np.arange(N, dtype=np.int8) % 2, (T, 1))
+    balanced = balanced_inputs(T, N)
     out: Dict[str, List[SweepPoint]] = {}
     for coin in ("private", "common"):
         cfg = base_cfg.replace(coin_mode=coin, scheduler="adversarial",

@@ -51,7 +51,8 @@ def trial_mean_k(n: int, f: int, trials: int, seed: int, *,
                         path="histogram", use_pallas_hist=use_pallas_hist,
                         seed=seed)
         no_crash = FaultSpec.none(trials, n)
-        balanced = np.tile(np.arange(n, dtype=np.int8) % 2, (trials, 1))
+        from benor_tpu.sweep import balanced_inputs
+        balanced = balanced_inputs(trials, n)
         state = init_state(cfg, balanced, no_crash)
         _, final = run_consensus(cfg, state, no_crash, jax.random.key(seed))
     finally:

@@ -82,7 +82,8 @@ def test_textbook_coin_contrast_under_adversary():
     adversary livelocks private coins but not the shared common coin."""
     from benor_tpu.state import FaultSpec
     n, trials = 100, 16
-    vals = np.tile(np.arange(n, dtype=np.int8) % 2, (trials, 1))
+    from benor_tpu.sweep import balanced_inputs
+    vals = balanced_inputs(trials, n)
     # zero crashes (FaultSpec.none — the launch validation pins list-born
     # faults to exactly F), leaving the adversary its full delivery slack
     base = dict(n=n, f=40, trials=trials, seed=6, vals=vals,
