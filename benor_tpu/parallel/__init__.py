@@ -2,6 +2,9 @@
 
 from .mesh import (AXIS_NODES, AXIS_TRIALS, STATE_SPEC, make_mesh,
                    state_sharding)
+from .multihost import (faults_to_global, global_mesh, init_multihost,
+                        local_block, resume_consensus_multihost,
+                        run_consensus_multihost, state_to_global)
 from .sharded import (MESH_CTX, resume_consensus_sharded,
                       run_consensus_sharded, shard_inputs)
 
@@ -9,4 +12,7 @@ __all__ = [
     "AXIS_NODES", "AXIS_TRIALS", "STATE_SPEC", "make_mesh", "state_sharding",
     "MESH_CTX", "resume_consensus_sharded", "run_consensus_sharded",
     "shard_inputs",
+    "init_multihost", "global_mesh", "local_block", "state_to_global",
+    "faults_to_global", "run_consensus_multihost",
+    "resume_consensus_multihost",
 ]

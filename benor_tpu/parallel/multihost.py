@@ -1,0 +1,196 @@
+"""Multi-host (multi-process) runtime — the DCN half of the N7 backend.
+
+The reference's "distributed" story is N Express servers in ONE OS process
+exchanging localhost HTTP (SURVEY.md §5.8; src/nodes/node.ts:202).  The
+single-process mesh (parallel/mesh.py + sharded.py) already replaces that
+message plane with ICI collectives across the chips of one host; this module
+extends the SAME ('trials', 'nodes') mesh across *processes* — one JAX
+process per host of a pod slice, jax.distributed coordination, XLA
+collectives riding DCN between hosts — the way a torch framework would scale
+out with NCCL/MPI ranks, re-hosted on jax's SPMD runtime.
+
+Layout doctrine (mesh.py's, now with a process dimension):
+
+  'trials' — maps across PROCESSES (DCN): trials never exchange data; the
+             only cross-host collective is the scalar termination psum in
+             the while-loop condition, so DCN latency is off the round's
+             critical path.
+  'nodes'  — stays INSIDE a process (ICI): the per-round histogram psum
+             (and the dense path's all-gather) never leaves the host.
+
+Because every random draw keys on GLOBAL (trial, node, round) ids
+(ops/rng.py, ops/pallas_hist.py), a multi-host run is bit-identical to the
+single-device run — the same guarantee tests/test_parallel.py pins for
+single-process meshes, extended across process boundaries by
+tests/test_multihost.py (two real OS processes, Gloo CPU collectives).
+
+No host ever materializes the full [T, N] arrays: each process builds only
+its addressable slab and `jax.make_array_from_process_local_data` assembles
+the global array (the jax-native equivalent of per-rank shard loading).
+
+Usage (same program runs on every process, SPMD style):
+
+    init_multihost(coordinator, num_processes=P, process_id=p)
+    mesh = global_mesh()                       # (P, local_devices) by default
+    tr, nd = local_block(mesh, cfg.trials, cfg.n_nodes)
+    state, faults = ...build numpy slabs for [tr, nd]...
+    state = state_to_global(state, mesh, (cfg.trials, cfg.n_nodes))
+    faults = faults_to_global(faults, mesh, (cfg.trials, cfg.n_nodes))
+    rounds, final = run_consensus_multihost(cfg, state, faults, key, mesh)
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+from ..config import SimConfig
+from ..state import FaultSpec, NetState
+from . import mesh as meshlib
+from . import sharded
+
+
+def init_multihost(coordinator_address: str, num_processes: int,
+                   process_id: int, **kw) -> None:
+    """Join (or form) the cross-host JAX cluster.
+
+    Thin, explicit wrapper over ``jax.distributed.initialize`` — on cloud
+    TPU pods jax can autodetect all three arguments, but the explicit form
+    is what works everywhere (including the CPU Gloo backend the test
+    harness uses to run two real processes on one machine).  Must be called
+    before the backend is first used in this process.  Idempotent."""
+    if jax.distributed.is_initialized():
+        return
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id, **kw)
+
+
+def global_mesh(trial_shards: Optional[int] = None,
+                node_shards: Optional[int] = None) -> Mesh:
+    """('trials', 'nodes') mesh over every device of every process.
+
+    Defaults place the trials axis exactly across processes (DCN) and the
+    node axis across each process's local devices (ICI) — the layout under
+    which no per-round collective crosses a host boundary.  Devices are
+    ordered by (process, id) so each mesh row is one process's devices
+    whenever trial_shards == process_count."""
+    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    if trial_shards is None:
+        trial_shards = jax.process_count()
+    if node_shards is None:
+        node_shards = len(devs) // trial_shards
+    return meshlib.make_mesh(trial_shards, node_shards, devices=devs)
+
+
+def local_block(mesh: Mesh, trials: int,
+                n_nodes: int) -> Tuple[slice, slice]:
+    """This process's addressable (trial, node) slab of a [T, N] array.
+
+    The sharding grid is regular, so the union of this process's per-device
+    blocks is a contiguous rectangle; returns its (row, col) slices.  Each
+    process builds ONLY this slab of initial values / fault masks."""
+    sh = NamedSharding(mesh, meshlib.STATE_SPEC)
+    idx_map = sh.devices_indices_map((trials, n_nodes))
+    mine = [idx for d, idx in idx_map.items()
+            if d.process_index == jax.process_index()]
+    if not mine:
+        raise ValueError("mesh has no devices from this process")
+    rows = [s[0].indices(trials) for s in mine]
+    cols = [s[1].indices(n_nodes) for s in mine]
+    tr = slice(min(r[0] for r in rows), max(r[1] for r in rows))
+    nd = slice(min(c[0] for c in cols), max(c[1] for c in cols))
+    # A mesh whose rows straddle process boundaries (e.g. 2 procs x 4 devs
+    # arranged 2x3) gives this process a NON-rectangular union of blocks;
+    # the bounding box would then claim cells owned by other processes.
+    block = (rows[0][1] - rows[0][0]) * (cols[0][1] - cols[0][0])
+    rect = (tr.stop - tr.start) * (nd.stop - nd.start)
+    if len(mine) * block != rect:
+        raise ValueError(
+            f"this process's device blocks do not tile a rectangle under "
+            f"this mesh (bounding box {rect} cells vs {len(mine)} blocks of "
+            f"{block}); choose mesh axes that align with process boundaries "
+            f"(default global_mesh() does: trials == process_count)")
+    return tr, nd
+
+
+def make_global(local: np.ndarray, mesh: Mesh,
+                global_shape: Tuple[int, int]) -> jax.Array:
+    """Assemble one [T, N] global array from this process's local slab."""
+    sh = NamedSharding(mesh, meshlib.STATE_SPEC)
+    return jax.make_array_from_process_local_data(sh, np.asarray(local),
+                                                  global_shape)
+
+
+def to_global(tree, mesh: Mesh, global_shape: Tuple[int, int]):
+    """Any pytree of process-local [T_loc, N_loc] slabs -> global arrays.
+
+    NetState and FaultSpec are registered pytrees, so one tree.map covers
+    both (and any future leaf added to either)."""
+    return jax.tree.map(lambda a: make_global(a, mesh, global_shape), tree)
+
+
+def state_to_global(state: NetState, mesh: Mesh,
+                    global_shape: Tuple[int, int]) -> NetState:
+    """NetState of process-local slabs -> NetState of global arrays."""
+    return to_global(state, mesh, global_shape)
+
+
+def faults_to_global(faults: FaultSpec, mesh: Mesh,
+                     global_shape: Tuple[int, int]) -> FaultSpec:
+    """FaultSpec of process-local slabs -> FaultSpec of global arrays."""
+    return to_global(faults, mesh, global_shape)
+
+
+def _check_global(state: NetState, faults: FaultSpec,
+                  shape: Tuple[int, int]) -> None:
+    for name, leaf in (("state", state.x), ("faults", faults.faulty)):
+        if tuple(leaf.shape) != shape:
+            raise ValueError(
+                f"{name} leaves must be GLOBAL [T, N] arrays (got "
+                f"{leaf.shape}, want {shape}); build local slabs and call "
+                f"state_to_global / faults_to_global")
+
+
+def run_consensus_multihost(cfg: SimConfig, state: NetState,
+                            faults: FaultSpec, base_key: jax.Array,
+                            mesh: Mesh) -> Tuple[jax.Array, NetState]:
+    """Run /start -> termination over a process-spanning mesh.
+
+    Same contract and SAME compiled executable as
+    sharded.run_consensus_sharded — the mesh simply spans hosts; inputs must
+    already be global arrays (state_to_global / faults_to_global), because
+    a cross-host run has no single host that could hold the full [T, N]
+    data for a device_put.  ``base_key`` is host-local and identical on
+    every process (all processes derive it from cfg.seed), which jit treats
+    as replicated.  Must be called by every process (SPMD single-program).
+
+    Returns (rounds, final): ``rounds`` is fully replicated (fetchable on
+    any host); ``final`` leaves are global arrays — reduce them on-device
+    (sweep.summarize_final) or gather with
+    jax.experimental.multihost_utils.process_allgather(..., tiled=True).
+    """
+    meshlib.check_divisible(cfg.trials, cfg.n_nodes, mesh)
+    _check_global(state, faults, (cfg.trials, cfg.n_nodes))
+    return sharded._compiled(cfg, mesh)(state, faults, base_key,
+                                        jnp.int32(1))
+
+
+def resume_consensus_multihost(cfg: SimConfig, state: NetState,
+                               faults: FaultSpec, base_key: jax.Array,
+                               mesh: Mesh,
+                               from_round: int) -> Tuple[jax.Array, NetState]:
+    """Checkpoint re-entry on a process-spanning mesh (SURVEY §5.4).
+
+    Counterpart of sharded.resume_consensus_sharded with global inputs: a
+    checkpoint written by ANY run (single-device, single-process mesh, or
+    another multi-host shape) resumes bit-identically here, because
+    randomness keys on (base_key, round, phase, global ids) only."""
+    meshlib.check_divisible(cfg.trials, cfg.n_nodes, mesh)
+    _check_global(state, faults, (cfg.trials, cfg.n_nodes))
+    return sharded._compiled(cfg, mesh, fresh=False)(
+        state, faults, base_key, jnp.int32(from_round))

@@ -1,0 +1,90 @@
+"""One process of the multi-host test cluster (tests/test_multihost.py).
+
+Each worker is a REAL OS process with its own JAX runtime and 4 virtual CPU
+devices; jax.distributed + Gloo collectives tie the processes into one
+cluster, exactly as hosts of a TPU pod slice would be tied over DCN.  The
+worker runs the flagship consensus loop over the process-spanning
+('trials', 'nodes') mesh on both compute paths and asserts bit-identity
+with a single-process single-device run — the SURVEY §7 hard-part-5
+guarantee (results independent of mesh shape) extended across process
+boundaries.
+
+Not a pytest module (no test_ prefix): invoked as
+    python tests/multihost_worker.py <process_id> <num_processes> <port>
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+    # Platform forcing BEFORE jax import (same dance as tests/conftest.py:
+    # the axon TPU plugin overrides JAX_PLATFORMS, the config update wins).
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append("--xla_force_host_platform_device_count=4")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    from benor_tpu.config import SimConfig
+    from benor_tpu.parallel.multihost import (faults_to_global, global_mesh,
+                                              init_multihost, local_block,
+                                              run_consensus_multihost,
+                                              state_to_global)
+    from benor_tpu.sim import run_consensus
+    from benor_tpu.state import FaultSpec, init_state
+
+    init_multihost(f"localhost:{port}", num_processes=nproc, process_id=pid)
+    assert jax.process_count() == nproc, jax.process_count()
+    assert jax.device_count() == 4 * nproc, jax.device_count()
+
+    # Default layout: trials across processes (DCN), nodes across each
+    # process's local devices (ICI).
+    mesh = global_mesh()
+    T, N = 4, 32
+
+    for path in ("dense", "histogram"):
+        cfg = SimConfig(n_nodes=N, n_faulty=8, trials=T, delivery="quorum",
+                        scheduler="uniform", path=path, max_rounds=16, seed=3)
+        faulty = np.zeros(N, bool)
+        faulty[:cfg.n_faulty] = True
+        faults = FaultSpec.from_faulty_list(cfg, faulty)
+        full = init_state(cfg, np.tile((np.arange(N) % 2).astype(np.int8),
+                                       (T, 1)), faults)
+        base_key = jax.random.key(cfg.seed)
+
+        # single-process baseline on this process's device 0
+        r1, f1 = run_consensus(cfg, full, faults, base_key)
+
+        # multi-host run: build ONLY this process's slab, assemble globals
+        tr, nd = local_block(mesh, T, N)
+        sl = lambda a: np.asarray(a)[tr, nd]
+        gstate = state_to_global(jax.tree.map(sl, full), mesh, (T, N))
+        gfaults = faults_to_global(jax.tree.map(sl, faults), mesh, (T, N))
+        r, fin = run_consensus_multihost(cfg, gstate, gfaults, base_key, mesh)
+
+        for leaf in ("x", "decided", "k", "killed"):
+            got = np.asarray(multihost_utils.process_allgather(
+                getattr(fin, leaf), tiled=True))
+            np.testing.assert_array_equal(got, np.asarray(getattr(f1, leaf)),
+                                          err_msg=leaf)
+        assert int(r) == int(r1), (int(r), int(r1))
+        print(f"worker{pid}[{path}]: mesh="
+              f"({mesh.shape['trials']}x{mesh.shape['nodes']}) "
+              f"procs={nproc} rounds={int(r)} "
+              f"bit-identical vs single-process OK", flush=True)
+
+    jax.distributed.shutdown()
+
+
+if __name__ == "__main__":
+    main()
