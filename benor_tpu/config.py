@@ -101,6 +101,20 @@ class SimConfig:
     # --- fault model (N5) -----------------------------------------------
     # 'crash':          faulty nodes dead from birth (reference node.ts:21-26)
     # 'byzantine':      faulty nodes alive but broadcast bit-flipped values
+    #                   (every receiver sees the SAME flipped value)
+    # 'equivocate':     faulty nodes alive and two-faced: each (receiver,
+    #                   equivocator) edge carries an independent fair random
+    #                   bit per phase (the classic Byzantine equivocation
+    #                   the 'byzantine' broadcast model cannot express).
+    #                   Under scheduler='adversarial' (delivery='quorum' —
+    #                   like every scheduler, it has no power over the
+    #                   deterministic 'all' delivery) the count-controlling
+    #                   adversary also CHOOSES the equivocators' per-receiver
+    #                   values (full Byzantine power — reproduces the
+    #                   N > 3F resilience bound, tests/test_equivocate.py).
+    #                   Not supported with scheduler='biased' (the split
+    #                   adversary keys delays on the carried value, which is
+    #                   per-edge here).
     # 'crash_at_round': faulty node i dies at the start of round crash_round[i]
     fault_model: str = "crash"
 
@@ -145,8 +159,14 @@ class SimConfig:
             raise ValueError(f"unknown scheduler: {self.scheduler}")
         if self.path not in ("auto", "dense", "histogram"):
             raise ValueError(f"unknown path: {self.path}")
-        if self.fault_model not in ("crash", "byzantine", "crash_at_round"):
+        if self.fault_model not in ("crash", "byzantine", "equivocate",
+                                    "crash_at_round"):
             raise ValueError(f"unknown fault_model: {self.fault_model}")
+        if self.fault_model == "equivocate" and self.scheduler == "biased":
+            raise ValueError(
+                "fault_model='equivocate' is not supported with "
+                "scheduler='biased': the split adversary delays edges by "
+                "their carried value, which is per-edge under equivocation")
         if self.backend not in ("tpu", "express", "native"):
             raise ValueError(f"unknown backend: {self.backend}")
         if self.oracle_order not in ("fifo", "shuffle"):

@@ -79,12 +79,22 @@ def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
 
     # --- phase 1: "proposal phase" (node.ts:46-82) -----------------------
     # Dense sharded path: gather the (round-constant) alive mask once for
-    # both phases instead of once per tally.
-    alive_g = ctx.all_gather_nodes(alive) if tally.dense_gather_needed(cfg) \
-        else None
+    # both phases instead of once per tally.  Equivocators (alive,
+    # per-receiver random/adversarial values) ride the same prefetch.
+    dense_gather = tally.dense_gather_needed(cfg)
+    alive_g = ctx.all_gather_nodes(alive) if dense_gather else None
+    equiv = faults.faulty if cfg.fault_model == "equivocate" else None
+    equiv_g = ctx.all_gather_nodes(equiv) \
+        if (dense_gather and equiv is not None) else None
+    # global live-equivocator count: round-constant, hoisted so the
+    # histogram path keeps its one-psum-per-phase collective budget
+    n_equiv = ctx.psum_nodes(
+        jnp.sum(equiv & alive, axis=-1, dtype=jnp.int32)) \
+        if equiv is not None else None
     sent1 = _sent_values(cfg, state.x, faults)
     cnt1 = tally.receiver_counts(cfg, base_key, r, rng.PHASE_PROPOSAL,
-                                 sent1, alive, ctx, alive_g)  # [T, N, 3]
+                                 sent1, alive, ctx, alive_g,
+                                 equiv, equiv_g, n_equiv)     # [T, N, 3]
     p0, p1 = cnt1[..., 0], cnt1[..., 1]
     # majority -> value, tie -> "?" (node.ts:63-69)
     x1 = jnp.where(p0 > p1, jnp.int8(VAL0),
@@ -98,7 +108,8 @@ def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
     vote_val = jnp.where(frozen, state.x, x1)
     sent2 = _sent_values(cfg, vote_val, faults)
     cnt2 = tally.receiver_counts(cfg, base_key, r, rng.PHASE_VOTE,
-                                 sent2, alive, ctx, alive_g)
+                                 sent2, alive, ctx, alive_g,
+                                 equiv, equiv_g, n_equiv)
     v0, v1 = cnt2[..., 0], cnt2[..., 1]
 
     decide0 = v0 > F                                         # node.ts:99

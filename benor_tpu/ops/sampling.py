@@ -171,6 +171,75 @@ def uniform_race_favored_count(u: jax.Array, nf: jax.Array, ns: jax.Array,
     return jnp.clip(draw, lo, hi).astype(jnp.int32)
 
 
+def binomial_half(u: jax.Array, n: jax.Array) -> jax.Array:
+    """Binomial(n, 1/2) draws via the normal quantile, fully per-lane.
+
+    u: uniforms [...]; n: int32 broadcastable to u's shape.  The p = 1/2
+    binomial is symmetric (zero skewness), so the plain normal quantile is
+    the correct second-order approximation — no Cornish-Fisher term needed.
+    Used for the class split of delivered equivocator messages (each
+    carries an independent fair bit per receiver).
+    """
+    nf = n.astype(jnp.float32)
+    z = jax.scipy.special.ndtri(jnp.clip(u, 1e-7, 1 - 1e-7))
+    draw = jnp.round(nf * 0.5 + z * jnp.sqrt(nf) * 0.5)
+    return jnp.clip(draw, 0.0, nf).astype(jnp.int32)
+
+
+def equivocate_hypergeom_counts(u_b: jax.Array, u0: jax.Array, u1: jax.Array,
+                                u_s: jax.Array, honest_counts: jax.Array,
+                                n_equiv: jax.Array, m: int) -> jax.Array:
+    """Per-lane tallied counts when live equivocators hide among the senders.
+
+    The tallied quorum is m draws without replacement from a mixed
+    population: honest senders with fixed values (global histogram
+    ``honest_counts`` int32 [T, 3]) plus ``n_equiv`` int32 [T] equivocators
+    whose delivered value is an independent fair bit per (receiver, phase)
+    edge.  Sampled in two stages, mirroring the law exactly:
+
+      h_b ~ Hypergeom(total, n_equiv, m)        how many equivocators the
+                                                lane's quorum happened to
+                                                include (exact shared-CDF
+                                                table when m is in the
+                                                exact regime — parameters
+                                                are trial-global)
+      honest split of the remaining m - h_b     multivariate hypergeometric
+                                                over honest_counts (same
+                                                normal/CF machinery as
+                                                multivariate_hypergeom_counts)
+      b1 ~ Binomial(h_b, 1/2)                   fair-bit split of the
+                                                delivered equivocator
+                                                messages between 0 and 1
+
+    u_b/u0/u1/u_s: independent float32 [T, N] per-lane uniforms.
+    Returns int32 [T, N, 3] (clamped into the feasible region like the
+    uniform-path sampler).  Statistically matched against the dense
+    per-edge-bit path by tests/test_equivocate.py.
+    """
+    c0 = honest_counts[:, 0]
+    c1 = honest_counts[:, 1]
+    total_h = honest_counts.sum(axis=-1)                    # [T]
+    total = total_h + n_equiv
+    if m <= EXACT_TABLE_MAX:
+        h_b = hypergeom_exact_shared(u_b, total, n_equiv, m)
+    else:
+        h_b = hypergeom_normal_approx(
+            u_b, jnp.broadcast_to(total[:, None], u_b.shape),
+            jnp.broadcast_to(n_equiv[:, None], u_b.shape),
+            jnp.full(u_b.shape, m, jnp.int32), skew_correct=True)
+    rem = jnp.maximum(m - h_b, 0)                           # honest draws
+    skew = m > EXACT_TABLE_MAX
+    h0 = hypergeom_normal_approx(
+        u0, jnp.broadcast_to(total_h[:, None], u0.shape),
+        jnp.broadcast_to(c0[:, None], u0.shape), rem, skew_correct=skew)
+    h1 = hypergeom_normal_approx(
+        u1, jnp.maximum(total_h[:, None] - c0[:, None], 0), c1[:, None],
+        jnp.maximum(rem - h0, 0), skew_correct=skew)
+    hq = jnp.maximum(rem - h0 - h1, 0)
+    b1 = binomial_half(u_s, h_b)
+    return jnp.stack([h0 + (h_b - b1), h1 + b1, hq], axis=-1)
+
+
 def multivariate_hypergeom_counts(u0: jax.Array, u1: jax.Array,
                                   class_counts: jax.Array, m: int) -> jax.Array:
     """Sample per-lane tallied class counts (h0, h1, hq) without replacement.

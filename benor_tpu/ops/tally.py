@@ -31,6 +31,7 @@ def pallas_hist_active(cfg: SimConfig) -> bool:
     return (cfg.use_pallas_hist and cfg.scheduler == "uniform"
             and cfg.delivery == "quorum"
             and cfg.resolved_path == "histogram"
+            and cfg.fault_model != "equivocate"
             and cfg.quorum > sampling.EXACT_TABLE_MAX)
 
 
@@ -73,7 +74,10 @@ def dense_counts(mask: jax.Array, sent: jax.Array, alive: jax.Array) -> jax.Arra
 def receiver_counts(cfg: SimConfig, base_key: jax.Array, r: jax.Array,
                     phase: int, sent: jax.Array, alive: jax.Array,
                     ctx: ShardCtx = SINGLE,
-                    alive_g: jax.Array | None = None) -> jax.Array:
+                    alive_g: jax.Array | None = None,
+                    equiv: jax.Array | None = None,
+                    equiv_g: jax.Array | None = None,
+                    n_equiv: jax.Array | None = None) -> jax.Array:
     """Dispatch: per-receiver tallied class counts int32 [T, N, 3].
 
     This is the TPU-native replacement for the whole HTTP message plane
@@ -81,23 +85,47 @@ def receiver_counts(cfg: SimConfig, base_key: jax.Array, r: jax.Array,
     (trial, receiver), deterministically seeded.  ``sent``/``alive`` are this
     shard's local [T_loc, N_loc] blocks; returned counts are per local
     receiver but tallied over the GLOBAL sender population.
+
+    ``equiv`` (bool [T_loc, N_loc] or None) marks live equivocating senders
+    (fault_model='equivocate'): their slot in ``sent`` is ignored — each
+    (receiver, equivocator) edge carries an independent fair bit per phase
+    (uniform/'all' delivery), or a value the count-controlling adversary
+    chooses (scheduler='adversarial').  ``equiv_g`` (dense path) and
+    ``n_equiv`` (its global count, [T]) are round-constant — callers hoist
+    them like alive_g so the psum runs once per round, not per phase.
     """
     T, N = sent.shape
     trial_ids = ctx.trial_ids(T)
     node_ids = ctx.node_ids(N)
 
+    honest = alive if equiv is None else (alive & ~equiv)
+    if equiv is not None and n_equiv is None:
+        n_equiv = ctx.psum_nodes(
+            jnp.sum(equiv & alive, axis=-1, dtype=jnp.int32))    # [T]
+
     # 'all' delivery: every receiver's tally equals the global histogram —
-    # O(T*N), no mask, identical on both paths.
+    # O(T*N), no mask, identical on both paths.  With equivocators, every
+    # receiver additionally tallies every live equivocator's edge bit:
+    # a Binomial(n_equiv, 1/2) class split per receiver lane.
     if cfg.delivery == "all":
-        hist = class_histogram(sent, alive, ctx)            # [T, 3]
-        return jnp.broadcast_to(hist[:, None, :], (T, N, 3))
+        hist = class_histogram(sent, honest, ctx)           # [T, 3]
+        counts = jnp.broadcast_to(hist[:, None, :], (T, N, 3))
+        if equiv is not None:
+            u = rng.grid_uniforms(base_key, r, phase + 32,
+                                  trial_ids, node_ids)
+            b1 = sampling.binomial_half(u, n_equiv[:, None])
+            b0 = n_equiv[:, None] - b1
+            zeros = jnp.zeros_like(b1)
+            counts = counts + jnp.stack([b0, b1, zeros], axis=-1)
+        return counts
 
     # Worst-case count-controlling adversary: identical on both paths
     # (scheduler semantics must not flip when path='auto' crosses
-    # dense_path_max_n).
+    # dense_path_max_n).  Equivocators become the adversary's free pool —
+    # it chooses their per-receiver values outright (full Byzantine power).
     if cfg.scheduler == "adversarial":
-        hist = class_histogram(sent, alive, ctx)
-        counts = adversarial_counts(hist, cfg.quorum)       # [T, 3]
+        hist = class_histogram(sent, honest, ctx)
+        counts = adversarial_counts(hist, cfg.quorum, n_free=n_equiv)
         return jnp.broadcast_to(counts[:, None, :], (T, N, 3))
 
     if cfg.resolved_path == "dense":
@@ -107,6 +135,9 @@ def receiver_counts(cfg: SimConfig, base_key: jax.Array, r: jax.Array,
         sent_g = ctx.all_gather_nodes(sent)                 # [T, N_glob]
         if alive_g is None:
             alive_g = ctx.all_gather_nodes(alive)
+        if equiv is not None and equiv_g is None:
+            equiv_g = ctx.all_gather_nodes(equiv)
+        honest_g = alive_g if equiv_g is None else (alive_g & ~equiv_g)
         mask = scheduler.quorum_delivery_mask(cfg, base_key, r, phase,
                                               sent_g, alive_g,
                                               trial_ids, node_ids)
@@ -114,13 +145,36 @@ def receiver_counts(cfg: SimConfig, base_key: jax.Array, r: jax.Array,
             from .pallas_tally import dense_counts_pallas
             # compile for any accelerator (the axon TPU plugin reports
             # platform 'axon'); interpret only on plain CPU
-            return dense_counts_pallas(
-                mask, sent_g, alive_g,
+            counts = dense_counts_pallas(
+                mask, sent_g, honest_g,
                 interpret=jax.default_backend() == "cpu")
-        return dense_counts(mask, sent_g, alive_g)
+        else:
+            counts = dense_counts(mask, sent_g, honest_g)
+        if equiv_g is not None:
+            # per-edge fair bits for delivered equivocator messages (the
+            # arrival race is content-independent, so the mask needs no
+            # change — only the counted value does)
+            bits = rng.edge_uniforms(base_key, r, phase + 32, trial_ids,
+                                     node_ids,
+                                     rng.ids(sent_g.shape[-1])) < 0.5
+            deliv_b = mask & (equiv_g & alive_g)[:, None, :]
+            c1b = jnp.sum(deliv_b & bits, axis=-1, dtype=jnp.int32)
+            c0b = jnp.sum(deliv_b & ~bits, axis=-1, dtype=jnp.int32)
+            zeros = jnp.zeros_like(c0b)
+            counts = counts + jnp.stack([c0b, c1b, zeros], axis=-1)
+        return counts
 
     # histogram path
-    hist = class_histogram(sent, alive, ctx)
+    hist = class_histogram(sent, honest, ctx)
+    if equiv is not None:
+        # mixed-population sampler: hypergeometric # of delivered
+        # equivocators, honest split of the rest, fair-bit class split
+        u_b = rng.grid_uniforms(base_key, r, phase + 32, trial_ids, node_ids)
+        u0 = rng.grid_uniforms(base_key, r, phase, trial_ids, node_ids)
+        u1 = rng.grid_uniforms(base_key, r, phase + 16, trial_ids, node_ids)
+        u_s = rng.grid_uniforms(base_key, r, phase + 48, trial_ids, node_ids)
+        return sampling.equivocate_hypergeom_counts(
+            u_b, u0, u1, u_s, hist, n_equiv, cfg.quorum)
     if pallas_hist_active(cfg):
         # Fused pallas sampler (the flagship-path kernel): bits + quantile +
         # CF draws in one VMEM pass.  Own stream keyed on base_key (NOT
@@ -234,7 +288,8 @@ def biased_fractional_counts(s: float, u_race: jax.Array, u_split: jax.Array,
     return jnp.stack([h0, h1, hq], axis=-1)
 
 
-def adversarial_counts(hist: jax.Array, m: int) -> jax.Array:
+def adversarial_counts(hist: jax.Array, m: int,
+                       n_free: jax.Array | None = None) -> jax.Array:
     """Worst-case count-controlling scheduler: force per-receiver ties.
 
     The strongest asynchronous adversary doesn't merely *delay* messages —
@@ -245,17 +300,39 @@ def adversarial_counts(hist: jax.Array, m: int) -> jax.Array:
     rounds — the classic Ben-Or vs Rabin contrast, reproducible with
     ``coin_mode='common'``.)
 
-    hist: int32 [T, 3] global (c0, c1, cq); returns int32 [T, 3] delivered
-    counts summing to m, balance-first, identical for every receiver.
+    ``n_free`` (int32 [T] or None) is the adversary's FREE-VALUE pool:
+    live equivocators (fault_model='equivocate') whose delivered value —
+    0, 1 or "?" — the adversary chooses per receiver outright.  The
+    tie-optimal allocation tops both value classes up toward a common
+    level T* = min(m//2, (h0 + h1 + free) // 2); with it the framework
+    reproduces the classic N > 3F Byzantine resilience bound: for
+    F >= N/3 the adversary ties every tally forever (even against the
+    common coin — matching the impossibility), for F < N/3 a unified
+    honest class count m - F > F is forced through and decides
+    (tests/test_equivocate.py).
+
+    hist: int32 [T, 3] global HONEST (c0, c1, cq); returns int32 [T, 3]
+    delivered counts summing to m, balance-first, identical per receiver.
     """
     c0, c1, cq = hist[:, 0], hist[:, 1], hist[:, 2]
     tgt = m // 2
-    h0 = jnp.minimum(c0, tgt)
-    h1 = jnp.minimum(c1, tgt)
+    h0h = jnp.minimum(c0, tgt)            # honest contributions to the tie
+    h1h = jnp.minimum(c1, tgt)
+    if n_free is not None:
+        # water-fill the free pool: lift both classes toward the common
+        # level T* (capped by the tie target), leftovers masquerade as "?"
+        lvl = jnp.minimum(tgt, (h0h + h1h + n_free) // 2)
+        b0 = jnp.clip(lvl - h0h, 0, n_free)
+        b1 = jnp.clip(lvl - h1h, 0, n_free - b0)
+        cq = cq + (n_free - b0 - b1)
+    else:
+        b0 = b1 = 0
+    h0 = h0h + b0
+    h1 = h1h + b1
     hq = jnp.minimum(cq, m - h0 - h1)
     rem = m - h0 - h1 - hq                # forced imbalance, if any
-    extra0 = jnp.minimum(rem, c0 - h0)
+    extra0 = jnp.minimum(rem, c0 - h0h)
     h0, rem = h0 + extra0, rem - extra0
-    extra1 = jnp.minimum(rem, c1 - h1)
+    extra1 = jnp.minimum(rem, c1 - h1h)
     h1 = h1 + extra1
     return jnp.stack([h0, h1, hq], axis=-1)

@@ -34,8 +34,8 @@ Usage (same program runs on every process, SPMD style):
     mesh = global_mesh()                       # (P, local_devices) by default
     tr, nd = local_block(mesh, cfg.trials, cfg.n_nodes)
     state, faults = ...build numpy slabs for [tr, nd]...
-    state = state_to_global(state, mesh, (cfg.trials, cfg.n_nodes))
-    faults = faults_to_global(faults, mesh, (cfg.trials, cfg.n_nodes))
+    shape = (cfg.trials, cfg.n_nodes)
+    state, faults = to_global(state, mesh, shape), to_global(faults, mesh, shape)
     rounds, final = run_consensus_multihost(cfg, state, faults, key, mesh)
 """
 
@@ -129,21 +129,9 @@ def make_global(local: np.ndarray, mesh: Mesh,
 def to_global(tree, mesh: Mesh, global_shape: Tuple[int, int]):
     """Any pytree of process-local [T_loc, N_loc] slabs -> global arrays.
 
-    NetState and FaultSpec are registered pytrees, so one tree.map covers
+    NetState and FaultSpec are registered pytrees, so one function covers
     both (and any future leaf added to either)."""
     return jax.tree.map(lambda a: make_global(a, mesh, global_shape), tree)
-
-
-def state_to_global(state: NetState, mesh: Mesh,
-                    global_shape: Tuple[int, int]) -> NetState:
-    """NetState of process-local slabs -> NetState of global arrays."""
-    return to_global(state, mesh, global_shape)
-
-
-def faults_to_global(faults: FaultSpec, mesh: Mesh,
-                     global_shape: Tuple[int, int]) -> FaultSpec:
-    """FaultSpec of process-local slabs -> FaultSpec of global arrays."""
-    return to_global(faults, mesh, global_shape)
 
 
 def _check_global(state: NetState, faults: FaultSpec,
@@ -153,7 +141,7 @@ def _check_global(state: NetState, faults: FaultSpec,
             raise ValueError(
                 f"{name} leaves must be GLOBAL [T, N] arrays (got "
                 f"{leaf.shape}, want {shape}); build local slabs and call "
-                f"state_to_global / faults_to_global")
+                f"to_global")
 
 
 def run_consensus_multihost(cfg: SimConfig, state: NetState,
@@ -163,9 +151,8 @@ def run_consensus_multihost(cfg: SimConfig, state: NetState,
 
     Same contract and SAME compiled executable as
     sharded.run_consensus_sharded — the mesh simply spans hosts; inputs must
-    already be global arrays (state_to_global / faults_to_global), because
-    a cross-host run has no single host that could hold the full [T, N]
-    data for a device_put.  ``base_key`` is host-local and identical on
+    already be global arrays (to_global), because a cross-host run has no
+    single host that could hold the full [T, N] data for a device_put.  ``base_key`` is host-local and identical on
     every process (all processes derive it from cfg.seed), which jit treats
     as replicated.  Must be called by every process (SPMD single-program).
 

@@ -52,6 +52,10 @@ class FaultSpec:
     ``faulty`` reproduces the reference's ``faultyList`` (launchNodes.ts:8):
     under the 'crash' model those lanes are killed at birth with null state.
     Under 'byzantine' they stay alive but broadcast bit-flipped values.
+    Under 'equivocate' they stay alive and two-faced: each (receiver,
+    equivocator) edge carries an independent fair bit per phase — or a
+    value the count-controlling adversary chooses outright under
+    scheduler='adversarial' (ops/tally.py).
     Under 'crash_at_round' lane i dies at the start of round crash_round[i]
     (crash_round <= 0 means never).
     """

@@ -36,10 +36,10 @@ def main() -> None:
     from jax.experimental import multihost_utils
 
     from benor_tpu.config import SimConfig
-    from benor_tpu.parallel.multihost import (faults_to_global, global_mesh,
-                                              init_multihost, local_block,
+    from benor_tpu.parallel.multihost import (global_mesh, init_multihost,
+                                              local_block,
                                               run_consensus_multihost,
-                                              state_to_global)
+                                              to_global)
     from benor_tpu.sim import run_consensus
     from benor_tpu.state import FaultSpec, init_state
 
@@ -68,8 +68,8 @@ def main() -> None:
         # multi-host run: build ONLY this process's slab, assemble globals
         tr, nd = local_block(mesh, T, N)
         sl = lambda a: np.asarray(a)[tr, nd]
-        gstate = state_to_global(jax.tree.map(sl, full), mesh, (T, N))
-        gfaults = faults_to_global(jax.tree.map(sl, faults), mesh, (T, N))
+        gstate = to_global(jax.tree.map(sl, full), mesh, (T, N))
+        gfaults = to_global(jax.tree.map(sl, faults), mesh, (T, N))
         r, fin = run_consensus_multihost(cfg, gstate, gfaults, base_key, mesh)
 
         for leaf in ("x", "decided", "k", "killed"):

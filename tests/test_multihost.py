@@ -17,6 +17,7 @@ import os
 import socket
 import subprocess
 import sys
+import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(ROOT, "tests", "multihost_worker.py")
@@ -40,13 +41,18 @@ def test_two_process_cluster_bit_identity():
             text=True)
         for pid in range(NPROC)
     ]
-    outs = []
-    try:
-        for p in procs:
-            outs.append(p.communicate(timeout=420))
-    finally:
-        for p in procs:
+    # Poll BOTH workers: if one crashes at startup, its peer (blocked in
+    # the distributed barrier) would hang — kill the survivors and surface
+    # the crashed worker's stderr instead of an opaque timeout.
+    deadline = time.time() + 420
+    while time.time() < deadline and any(p.poll() is None for p in procs):
+        if any(p.poll() not in (None, 0) for p in procs):
+            break                      # someone failed; stop waiting
+        time.sleep(0.5)
+    for p in procs:
+        if p.poll() is None:
             p.kill()
+    outs = [p.communicate() for p in procs]
     for pid, (p, (out, err)) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, (
             f"worker {pid} rc={p.returncode}\nstdout:\n{out}\nstderr:\n"
