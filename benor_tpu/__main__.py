@@ -55,22 +55,31 @@ def _sweep(args) -> int:
     cfg = SimConfig(n_nodes=args.n, n_faulty=0, trials=args.trials,
                     max_rounds=args.max_rounds, delivery="quorum",
                     scheduler=args.scheduler, coin_mode=args.coin,
-                    seed=args.seed)
+                    fault_model=args.fault_model, seed=args.seed)
     mode = "balanced/no-crash" if args.balanced else "iid/crash"
     print(f"rounds-vs-f sweep: N={args.n}, trials={args.trials}, "
-          f"scheduler={args.scheduler}, coin={args.coin}, inputs={mode}")
+          f"scheduler={args.scheduler}, coin={args.coin}, "
+          f"faults={args.fault_model}, inputs={mode}")
     if args.balanced:
         # the science regime: balanced inputs, F purely a protocol
         # parameter (crash-pinned faults make every tally the deterministic
-        # full-population draw and the curve degenerates — see RESULTS.md)
+        # full-population draw and the curve degenerates — see RESULTS.md).
+        # Under 'byzantine'/'equivocate' the F lanes are LIVE adversaries,
+        # so they are marked (not crashed) rather than zeroed.
         from .state import FaultSpec
         from .sweep import balanced_inputs
         bal = balanced_inputs(args.trials, args.n)
+
+        def faults_for(c):
+            if c.fault_model in ("byzantine", "equivocate"):
+                return FaultSpec.first_f(c)
+            return FaultSpec.none(args.trials, args.n)
+
         points = []
         for f in f_values:
-            pt = run_point(cfg.replace(n_faulty=int(f)),
-                           initial_values=bal,
-                           faults=FaultSpec.none(args.trials, args.n))
+            cfg_f = cfg.replace(n_faulty=int(f))
+            pt = run_point(cfg_f, initial_values=bal,
+                           faults=faults_for(cfg_f))
             points.append(pt)
             print(f"  f={f}: mean_k={pt.mean_k:.2f} "
                   f"decided={pt.decided_frac:.3f} "
@@ -137,6 +146,9 @@ def main(argv=None) -> int:
                    choices=("uniform", "biased", "adversarial"),
                    default="uniform")
     s.add_argument("--coin", choices=("private", "common"), default="private")
+    s.add_argument("--fault-model",
+                   choices=("crash", "byzantine", "equivocate"),
+                   default="crash")
     s.add_argument("--seed", type=int, default=0)
     s.add_argument("--balanced", action="store_true",
                    help="balanced inputs + zero crashes (the multi-round "

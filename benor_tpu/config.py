@@ -84,15 +84,18 @@ class SimConfig:
     # materializing f32 [T, N, N] operands in HBM.  Runs in interpreter mode
     # off-TPU (tests); same results either way.
     use_pallas: bool = False
-    # Use the fused pallas kernel (ops/pallas_hist.py) for the histogram-path
-    # quorum sampler: threefry bits + normal quantile + CF hypergeometric
-    # draws in one VMEM pass (~100x less HBM traffic than the XLA pipeline,
-    # measured ~5x faster at N=1M on v5e).  Applies on the uniform-scheduler
-    # histogram path in the CF regime (quorum > EXACT_TABLE_MAX), single
-    # device or shard_map mesh (draws key on global ids, so results are
-    # bit-identical across mesh shapes); silently ignored elsewhere.  Uses
-    # its own documented random stream keyed on the run's base_key, so
-    # results are statistically (not bitwise) identical to the XLA path.
+    # Use the fused pallas kernels (ops/pallas_hist.py) for the
+    # histogram-path quorum sampler: threefry bits + normal quantile + CF
+    # hypergeometric draws in one VMEM pass (~100x less HBM traffic than
+    # the XLA pipeline; ~5x faster at N=1M on v5e, ~7x for the
+    # equivocate-regime kernel).  Applies on the uniform-scheduler
+    # histogram path in the CF regime (quorum > EXACT_TABLE_MAX) — every
+    # fault model, with fault_model='equivocate' served by its own fused
+    # mixed-population kernel — single device or shard_map mesh (draws key
+    # on global ids, so results are bit-identical across mesh shapes);
+    # silently ignored elsewhere.  Uses its own documented random stream
+    # keyed on the run's base_key, so results are statistically (not
+    # bitwise) identical to the XLA path.
     use_pallas_hist: bool = False
 
     # --- Monte-Carlo ----------------------------------------------------

@@ -12,10 +12,12 @@ f32 [T, N] tensors between kernels).  This kernel fuses the entire pipeline
 
 — into one VMEM-resident pass whose only HBM traffic is the three int32
 [T, N] outputs (~12 B/lane), a ~100x traffic reduction on the op it
-replaces (measured ~5x op speedup at [32 x 1M] on v5e).  Enabled with
-``SimConfig(use_pallas_hist=True)`` on the single-device histogram path in
-the CF regime (quorum m > EXACT_TABLE_MAX, i.e. exactly the N=1M operating
-point); ``bench.py`` measures the win on-chip.
+replaces (measured ~5x op speedup at [32 x 1M] on v5e; the
+equivocate-regime variant ``equiv_counts_pallas`` fuses FOUR uniforms +
+three CF draws + a binomial split and measures ~7x).  Enabled with
+``SimConfig(use_pallas_hist=True)`` on the histogram path in the CF regime
+(quorum m > EXACT_TABLE_MAX, i.e. exactly the N=1M operating point);
+``bench.py`` measures the win on-chip.
 
 Design notes:
   * RNG is a hand-rolled threefry2x32 on (node_id, trial_id) counters with
@@ -228,9 +230,11 @@ def _coin_kernel(scal_ref, out_ref):
 
 #: Key-derivation counter word (the second threefry counter, the first is
 #: the round index) for the coin stream.  Reserved words: cf_counts_pallas
-#: uses its raw ``phase`` tag here (rng.PHASE_PROPOSAL=0 / PHASE_VOTE=1);
-#: any new stream must pick a word outside {0, 1, 255}.
+#: uses its raw ``phase`` tag here (rng.PHASE_PROPOSAL=0 / PHASE_VOTE=1),
+#: equiv_counts_pallas additionally uses phase+64 (64/65) for its second
+#: uniform pair; any new stream must pick a word outside {0, 1, 64, 65, 255}.
 _COIN_SALT = 255
+_EQUIV_SALT_OFFSET = 64
 
 
 @functools.partial(jax.jit, static_argnames=("trials", "n_nodes",
@@ -262,6 +266,95 @@ def coin_flips_pallas(base_key: jax.Array, r: jax.Array, trials: int,
         interpret=interpret,
     )(scal)
     return out[:, :n_nodes].astype(jnp.int8)
+
+
+def _equiv_kernel(m, scal_ref, scal2_ref, c0_ref, c1_ref, cq_ref, ne_ref,
+                  h0_ref, h1_ref, hq_ref):
+    """Equivocate-regime lane-tile: the mixed-population sampler fused.
+
+    Mirrors ops/sampling.py:equivocate_hypergeom_counts — h_b (delivered
+    equivocators) ~ CF hypergeometric, honest split of the remainder, fair
+    Binomial(h_b, 1/2) class split — four uniforms per lane from TWO
+    threefry blocks (scal_ref carries the phase key, scal2_ref the
+    phase+64 key; both use the shared global-id counter scheme).
+    ne_ref: VMEM f32 [T, 1] live-equivocator count per trial.
+    """
+    node, trial = _lane_ids(scal_ref, h0_ref.shape)
+    b0, b1 = _threefry2x32(scal_ref[0], scal_ref[1], node, trial)
+    b2, b3 = _threefry2x32(scal2_ref[0], scal2_ref[1], node, trial)
+    u0 = _bits_to_uniform(b0)
+    u1 = _bits_to_uniform(b1)
+    u_b = _bits_to_uniform(b2)
+    u_s = _bits_to_uniform(b3)
+
+    c0 = c0_ref[...]                                        # f32 [T, 1]
+    c1 = c1_ref[...]
+    cq = cq_ref[...]
+    ne = ne_ref[...]
+    total_h = c0 + c1 + cq
+    total = total_h + ne
+    mf = jnp.float32(m)
+    h_b = _cf_draw(u_b, total, ne, mf)
+    rem = jnp.maximum(mf - h_b, 0.0)
+    h0 = _cf_draw(u0, total_h, c0, rem)
+    h1 = _cf_draw(u1, jnp.maximum(total_h - c0, 0.0), c1,
+                  jnp.maximum(rem - h0, 0.0))
+    hq = jnp.maximum(rem - h0 - h1, 0.0)
+    # Binomial(h_b, 1/2): symmetric, so the plain normal quantile is the
+    # correct second-order approximation (sampling.binomial_half)
+    z = _ndtri_as241(u_s)
+    bs = jnp.clip(jnp.round(h_b * 0.5 + z * jnp.sqrt(h_b) * 0.5), 0.0, h_b)
+    h0_ref[...] = (h0 + (h_b - bs)).astype(jnp.int32)
+    h1_ref[...] = (h1 + bs).astype(jnp.int32)
+    hq_ref[...] = hq.astype(jnp.int32)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("m", "n_nodes", "interpret"))
+def equiv_counts_pallas(base_key: jax.Array, r: jax.Array, phase: int,
+                        hist: jax.Array, n_equiv: jax.Array, m: int,
+                        n_nodes: int, interpret: bool = False,
+                        node_offset: jax.Array | int = 0,
+                        trial_offset: jax.Array | int = 0) -> jax.Array:
+    """Fused equivocate-regime quorum sampler -> int32 [T, N, 3].
+
+    Drop-in statistical replacement for
+    ops.sampling.equivocate_hypergeom_counts driven by four grid_uniforms
+    pipelines (fault_model='equivocate', uniform scheduler, CF regime) —
+    same law, the kernel-family random stream.  Same contract as
+    cf_counts_pallas (global-id counters, mesh-shape bit-identity, psum'd
+    global ``hist``/``n_equiv``); KS-gated by tests/test_pallas_hist.py.
+    """
+    T = hist.shape[0]
+    n_pad = (-n_nodes) % TILE_N
+    np_total = n_nodes + n_pad
+
+    scal = _stream_scal(base_key, r, phase, node_offset, trial_offset)
+    scal2 = _stream_scal(base_key, r, phase + _EQUIV_SALT_OFFSET,
+                         node_offset, trial_offset)
+
+    cls = hist.astype(jnp.float32)[..., None]               # [T, 3, 1]
+    c0, c1, cq = cls[:, 0], cls[:, 1], cls[:, 2]            # [T, 1] each
+    ne = n_equiv.astype(jnp.float32)[:, None]               # [T, 1]
+
+    out_shape = [jax.ShapeDtypeStruct((T, np_total), jnp.int32)] * 3
+    vec_spec = pl.BlockSpec((T, 1), lambda j: (0, 0),
+                            memory_space=pltpu.VMEM)
+    h0, h1, hq = pl.pallas_call(
+        functools.partial(_equiv_kernel, m),
+        out_shape=out_shape,
+        grid=(np_total // TILE_N,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            vec_spec, vec_spec, vec_spec, vec_spec,
+        ],
+        out_specs=[pl.BlockSpec((T, TILE_N), lambda j: (0, j),
+                                memory_space=pltpu.VMEM)] * 3,
+        interpret=interpret,
+    )(scal, scal2, c0, c1, cq, ne)
+    counts = jnp.stack([h0, h1, hq], axis=-1)               # [T, Np, 3]
+    return counts[:, :n_nodes, :]
 
 
 @functools.partial(jax.jit,

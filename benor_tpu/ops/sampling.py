@@ -176,14 +176,43 @@ def binomial_half(u: jax.Array, n: jax.Array) -> jax.Array:
 
     u: uniforms [...]; n: int32 broadcastable to u's shape.  The p = 1/2
     binomial is symmetric (zero skewness), so the plain normal quantile is
-    the correct second-order approximation — no Cornish-Fisher term needed.
-    Used for the class split of delivered equivocator messages (each
-    carries an independent fair bit per receiver).
+    the correct second-order approximation — no Cornish-Fisher term needed
+    (still ~4% relative error on the extreme counts at n ~ 2-10; use
+    binomial_half_exact_shared when the parameter is lane-shared).  Used
+    for the class split of delivered equivocator messages (each carries an
+    independent fair bit per receiver).
     """
     nf = n.astype(jnp.float32)
     z = jax.scipy.special.ndtri(jnp.clip(u, 1e-7, 1 - 1e-7))
     draw = jnp.round(nf * 0.5 + z * jnp.sqrt(nf) * 0.5)
     return jnp.clip(draw, 0.0, nf).astype(jnp.int32)
+
+
+def binomial_half_exact_shared(u: jax.Array, n: jax.Array,
+                               n_max: int) -> jax.Array:
+    """EXACT Binomial(n, 1/2) draws from a per-trial parameter shared by
+    all lanes — the binomial analogue of hypergeom_exact_shared.
+
+    u: float32 [T, N] per-lane uniforms; n: int32 [T] (n <= n_max, static).
+    One [T, n_max+1] CDF table serves every lane of a trial; each lane
+    binary-searches its own uniform.  Used by the 'all'-delivery
+    equivocator split, whose count parameter is the trial-global live
+    equivocator total (the normal approximation is visibly biased at
+    small counts: Binomial(2, 1/2) is 1/4, 1/2, 1/4 but the rounded
+    quantile gives ~0.24/0.52/0.24).
+    """
+    k = jnp.arange(n_max + 1, dtype=jnp.int32)
+    nf = n[:, None]
+    logpmf = _log_comb(jnp.broadcast_to(nf, (n.shape[0], n_max + 1)),
+                       jnp.broadcast_to(k[None, :], (n.shape[0], n_max + 1)))
+    logpmf = logpmf - nf.astype(jnp.float32) * jnp.log(2.0)
+    logpmf = jnp.where(jnp.isfinite(logpmf), logpmf, -jnp.inf)
+    mx = jnp.max(logpmf, axis=-1, keepdims=True)
+    pmf = jnp.exp(logpmf - jnp.where(jnp.isfinite(mx), mx, 0.0))
+    pmf = pmf / jnp.maximum(jnp.sum(pmf, axis=-1, keepdims=True), 1e-30)
+    cdf = jnp.cumsum(pmf, axis=-1)
+    idx = jax.vmap(lambda c, uu: jnp.searchsorted(c, uu))(cdf, u)
+    return jnp.minimum(jnp.clip(idx, 0, n_max), n[:, None]).astype(jnp.int32)
 
 
 def equivocate_hypergeom_counts(u_b: jax.Array, u0: jax.Array, u1: jax.Array,

@@ -23,16 +23,28 @@ from . import rng, sampling, scheduler
 from .collectives import SINGLE, ShardCtx
 
 
-def pallas_hist_active(cfg: SimConfig) -> bool:
-    """True iff the fused pallas sampler serves this config's histogram
-    tallies (and, for private coins, the coin kernel) — the uniform-
-    scheduler CF regime.  One predicate so the sampler and coin streams
-    switch together."""
+def _pallas_cf_regime(cfg: SimConfig) -> bool:
+    """The shared gating for every fused histogram-path kernel: the
+    uniform-scheduler quorum-delivery CF regime.  Kept in ONE place so the
+    two sampler kernels can never diverge in when they engage."""
     return (cfg.use_pallas_hist and cfg.scheduler == "uniform"
             and cfg.delivery == "quorum"
             and cfg.resolved_path == "histogram"
-            and cfg.fault_model != "equivocate"
             and cfg.quorum > sampling.EXACT_TABLE_MAX)
+
+
+def pallas_hist_active(cfg: SimConfig) -> bool:
+    """True iff the fused pallas sampler serves this config's histogram
+    tallies (and, for private coins, the coin kernel — the coin switches
+    together with EITHER sampler predicate)."""
+    return _pallas_cf_regime(cfg) and cfg.fault_model != "equivocate"
+
+
+def pallas_equiv_active(cfg: SimConfig) -> bool:
+    """True iff the fused equivocate-regime kernel serves this config's
+    histogram tallies (the equivocate counterpart of pallas_hist_active —
+    same CF-regime gating, different sampler kernel)."""
+    return _pallas_cf_regime(cfg) and cfg.fault_model == "equivocate"
 
 
 def dense_gather_needed(cfg: SimConfig) -> bool:
@@ -113,7 +125,16 @@ def receiver_counts(cfg: SimConfig, base_key: jax.Array, r: jax.Array,
         if equiv is not None:
             u = rng.grid_uniforms(base_key, r, phase + 32,
                                   trial_ids, node_ids)
-            b1 = sampling.binomial_half(u, n_equiv[:, None])
+            # n_equiv is trial-global, so the split is EXACT via a shared
+            # CDF table whenever the static bound n_faulty is tabulable
+            # (the normal approx is ~4% biased on extreme counts at small
+            # F); above the bound the symmetric normal quantile is exact
+            # to far below one count.
+            if cfg.n_faulty <= sampling.EXACT_TABLE_MAX:
+                b1 = sampling.binomial_half_exact_shared(
+                    u, n_equiv, cfg.n_faulty)
+            else:
+                b1 = sampling.binomial_half(u, n_equiv[:, None])
             b0 = n_equiv[:, None] - b1
             zeros = jnp.zeros_like(b1)
             counts = counts + jnp.stack([b0, b1, zeros], axis=-1)
@@ -167,6 +188,15 @@ def receiver_counts(cfg: SimConfig, base_key: jax.Array, r: jax.Array,
     # histogram path
     hist = class_histogram(sent, honest, ctx)
     if equiv is not None:
+        if pallas_equiv_active(cfg):
+            # fused mixed-population kernel (two threefry blocks -> four
+            # uniforms -> CF draws + binomial split in one VMEM pass);
+            # same global-id keying contract as cf_counts_pallas
+            from .pallas_hist import equiv_counts_pallas
+            return equiv_counts_pallas(
+                base_key, r, phase, hist, n_equiv, cfg.quorum, N,
+                interpret=jax.default_backend() == "cpu",
+                node_offset=node_ids[0], trial_offset=trial_ids[0])
         # mixed-population sampler: hypergeometric # of delivered
         # equivocators, honest split of the rest, fair-bit class split
         u_b = rng.grid_uniforms(base_key, r, phase + 32, trial_ids, node_ids)

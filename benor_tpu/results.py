@@ -52,8 +52,9 @@ from typing import Dict, List
 import numpy as np
 
 from .config import SimConfig
-from .state import FaultSpec
-from .sweep import SweepPoint, baseline_configs, coin_comparison, run_point
+from .state import FaultSpec, init_state
+from .sweep import (SweepPoint, baseline_configs, coin_comparison,
+                    record_trajectory, run_point)
 
 #: Default fault fractions for the balanced rounds-vs-f curve.
 CURVE_FRACS = (0.10, 0.25, 0.35, 0.40, 0.45)
@@ -139,6 +140,35 @@ def disagreement_sweep(n: int, trials: int, seed: int = 0,
     return rows
 
 
+def trajectory_study(n: int, trials: int, seed: int = 0,
+                     f_frac: float = 0.45, n_rounds: int = 8,
+                     verbose=True) -> List[Dict]:
+    """Round-resolved convergence dynamics at the hardest uniform point
+    (balanced inputs, f = 0.45): the decided fraction jumps 0 -> 1 in one
+    round once the sampling-noise random walk amplifies a network-wide
+    majority — the trajectory shows WHEN, which the endpoint cannot."""
+    import jax
+
+    cfg = SimConfig(n_nodes=n, n_faulty=int(f_frac * n), trials=trials,
+                    max_rounds=64, delivery="quorum", scheduler="uniform",
+                    path="histogram", seed=seed)
+    faults = FaultSpec.none(trials, n)
+    state = init_state(cfg, _balanced(trials, n), faults)
+    _, traj = record_trajectory(cfg, state, faults, jax.random.key(seed),
+                                n_rounds)
+    traj = {k: np.asarray(v) for k, v in traj.items()}
+    rows = []
+    for i in range(n_rounds):
+        rows.append({"round": i + 1,
+                     **{k: round(float(v[i]), 4) for k, v in traj.items()}})
+        if verbose:
+            r = rows[-1]
+            print(f"  round {r['round']}: decided={r['decided']:.3f} "
+                  f"zeros={r['zeros']:.3f} ones={r['ones']:.3f} "
+                  f"qs={r['qs']:.3f}", flush=True)
+    return rows
+
+
 def equivocation_threshold(n: int, trials: int, seed: int = 0,
                            verbose=True) -> List[Dict]:
     """Locate the N > 3F bound at scale: equivocators under the
@@ -205,6 +235,9 @@ def generate(out_dir: str = "RESULTS", n_large: int = 1_000_000,
 
     print("equivocation: the N > 3F bound at scale:", flush=True)
     out["equivocation"] = equivocation_threshold(n_large, trials_large, seed)
+
+    print("convergence trajectory (f=0.45, balanced):", flush=True)
+    out["trajectory"] = trajectory_study(n_large, trials_large, seed)
 
     if presets:
         for name, cfg in baseline_configs().items():
@@ -325,6 +358,25 @@ def _write_markdown(out_dir: str, out: Dict) -> None:
                 f"| {row['label']} = {row['f']:,} | {row['three_f_lt_n']} "
                 f"| {row['decided_frac']:.3f} | {row['mean_k']:.2f} "
                 f"| {row['rounds_executed']} |")
+    if "trajectory" in out:
+        lines += [
+            "",
+            "## Convergence trajectory (f = 0.45, balanced inputs)",
+            "",
+            "Round-resolved dynamics from `sweep.record_trajectory` (one "
+            "compiled scan, on-device reductions): the decided fraction "
+            "jumps 0 → 1 in a single round once sampling noise amplifies a "
+            "network-wide majority; `zeros`/`ones`/`qs` are the live "
+            "healthy lanes' value shares after each round.",
+            "",
+            "| round | decided | zeros | ones | qs | disagree |",
+            "|---|---|---|---|---|---|",
+        ]
+        for row in out["trajectory"]:
+            lines.append(
+                f"| {row['round']} | {row['decided']:.3f} "
+                f"| {row['zeros']:.3f} | {row['ones']:.3f} "
+                f"| {row['qs']:.3f} | {row['disagree']:.3f} |")
     lines += [
         "",
         "## BASELINE.json presets",

@@ -91,6 +91,15 @@ class FaultSpec:
         return cls(faulty=faulty, crash_round=crash_round)
 
     @classmethod
+    def first_f(cls, cfg: SimConfig, crash_rounds=None) -> "FaultSpec":
+        """Mark the first ``cfg.n_faulty`` lanes faulty — the canonical
+        mask every harness uses (WHICH lanes are faulty is statistically
+        irrelevant under the uniform scheduler: lanes are exchangeable)."""
+        mask = np.zeros(cfg.n_nodes, bool)
+        mask[:cfg.n_faulty] = True
+        return cls.from_faulty_list(cfg, mask, crash_rounds)
+
+    @classmethod
     def none(cls, trials: int, n_nodes: int) -> "FaultSpec":
         """Zero-crash spec: every node alive, F purely a protocol parameter.
 

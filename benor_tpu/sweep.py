@@ -24,8 +24,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .config import SimConfig, VAL0, VAL1
-from .sim import run_consensus
+from .config import SimConfig, VAL0, VAL1, VALQ
+from .models.benor import benor_round
+from .sim import run_consensus, start_state
 from .state import FaultSpec, NetState, init_state
 
 
@@ -75,6 +76,53 @@ def summarize_final(final: NetState, faulty: jax.Array, max_rounds: int):
     got1 = jnp.any(hd & (final.x == VAL1), axis=-1)
     disagree_frac = jnp.mean((got0 & got1).astype(jnp.float32))
     return decided_frac, mean_k, ones_frac, k_hist, disagree_frac
+
+
+@functools.partial(jax.jit, static_argnums=(0, 4))
+def record_trajectory(cfg: SimConfig, state: NetState, faults: FaultSpec,
+                      base_key: jax.Array, n_rounds: int):
+    """Round-by-round aggregate time series — convergence DYNAMICS, not just
+    the endpoint.  The reference offers only /getState polling snapshots at
+    the test harness's 200 ms cadence (tests/utils.ts:14-20); here the full
+    per-round trajectory is captured inside one compiled ``lax.scan`` with
+    on-device reductions (five scalars per round — no [T, N] transfer).
+
+    Runs exactly ``n_rounds`` rounds from /start (no early exit — scan has
+    a static trip count).  Because decided lanes freeze and settled rounds
+    are state no-ops, the final state equals ``run_consensus``'s whenever
+    n_rounds >= its round count (tested in tests/test_sweep.py).
+
+    Returns (final_state, traj) with traj a dict of float32 [n_rounds]
+    series over healthy lanes: ``decided`` (decided fraction), ``ones`` /
+    ``zeros`` / ``qs`` (value shares among live healthy lanes — the "?"
+    share is the visible signature of tie-forcing adversaries), and
+    ``disagree`` (fraction of trials whose decided healthy lanes hold both
+    values — the safety-violation onset, round-resolved).
+    """
+    healthy = ~faults.faulty
+    n_healthy = jnp.maximum(jnp.sum(healthy), 1)
+
+    def aggregates(st: NetState):
+        live = healthy & ~st.killed
+        n_live = jnp.maximum(jnp.sum(live), 1)
+        hd = st.decided & healthy
+        got0 = jnp.any(hd & (st.x == VAL0), axis=-1)
+        got1 = jnp.any(hd & (st.x == VAL1), axis=-1)
+        return {
+            "decided": jnp.sum(hd) / n_healthy,
+            "zeros": jnp.sum(live & (st.x == VAL0)) / n_live,
+            "ones": jnp.sum(live & (st.x == VAL1)) / n_live,
+            "qs": jnp.sum(live & (st.x == VALQ)) / n_live,
+            "disagree": jnp.mean((got0 & got1).astype(jnp.float32)),
+        }
+
+    def step(st, r):
+        st = benor_round(cfg, st, faults, base_key, r)
+        return st, aggregates(st)
+
+    final, traj = jax.lax.scan(step, start_state(cfg, state),
+                               jnp.arange(1, n_rounds + 1, dtype=jnp.int32))
+    return final, traj
 
 
 def random_inputs(seed: int, trials: int, n: int) -> np.ndarray:

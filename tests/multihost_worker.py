@@ -38,6 +38,7 @@ def main() -> None:
     from benor_tpu.config import SimConfig
     from benor_tpu.parallel.multihost import (global_mesh, init_multihost,
                                               local_block,
+                                              resume_consensus_multihost,
                                               run_consensus_multihost,
                                               to_global)
     from benor_tpu.sim import run_consensus
@@ -82,6 +83,26 @@ def main() -> None:
               f"({mesh.shape['trials']}x{mesh.shape['nodes']}) "
               f"procs={nproc} rounds={int(r)} "
               f"bit-identical vs single-process OK", flush=True)
+
+        if path == "histogram":
+            # checkpoint re-entry across hosts: cut the run at round 2,
+            # resume from round 3 — cut + resume must equal the
+            # uninterrupted run bitwise (randomness keys on (key, round,
+            # phase, global ids), never loop history)
+            r_cut, fin_cut = run_consensus_multihost(
+                cfg.replace(max_rounds=2), gstate, gfaults, base_key, mesh)
+            assert int(r_cut) == 2, int(r_cut)
+            r_res, fin_res = resume_consensus_multihost(
+                cfg, fin_cut, gfaults, base_key, mesh,
+                from_round=int(r_cut) + 1)
+            for leaf in ("x", "decided", "k", "killed"):
+                got = np.asarray(multihost_utils.process_allgather(
+                    getattr(fin_res, leaf), tiled=True))
+                np.testing.assert_array_equal(
+                    got, np.asarray(getattr(f1, leaf)), err_msg=leaf)
+            assert int(r_res) == int(r1), (int(r_res), int(r1))
+            print(f"worker{pid}[resume]: cut@2 + resume == uninterrupted "
+                  f"(rounds={int(r_res)}) OK", flush=True)
 
     jax.distributed.shutdown()
 

@@ -30,15 +30,21 @@ from benor_tpu.state import FaultSpec, init_state
 
 def trial_mean_k(n: int, f: int, trials: int, seed: int, *,
                  table_max: int | None = None,
-                 use_pallas_hist: bool = False) -> np.ndarray:
+                 use_pallas_hist: bool = False,
+                 fault_model: str = "crash") -> np.ndarray:
     """Per-trial mean rounds-to-decide under a forced sampler regime.
 
     ``table_max`` (if given) overrides ``sampling.EXACT_TABLE_MAX`` for the
     duration of the run, steering the histogram path between the exact
     shared-CDF sampler and the Cornish-Fisher sampler (and gating the
-    pallas kernel, which serves only the CF regime).  Distinct seeds give
+    pallas kernels, which serve only the CF regime).  Distinct seeds give
     distinct static configs, so the jit cache cannot serve a trace from
     another regime.
+
+    ``fault_model='crash'`` (default) runs the zero-crash spec (F purely a
+    protocol parameter — see module docstring); ``'equivocate'`` marks the
+    first F lanes as live equivocators instead, exercising the
+    mixed-population sampler with the same multi-round dynamics.
     """
     from benor_tpu.sim import run_consensus
 
@@ -49,16 +55,19 @@ def trial_mean_k(n: int, f: int, trials: int, seed: int, *,
         cfg = SimConfig(n_nodes=n, n_faulty=f, trials=trials, max_rounds=64,
                         delivery="quorum", scheduler="uniform",
                         path="histogram", use_pallas_hist=use_pallas_hist,
-                        seed=seed)
-        no_crash = FaultSpec.none(trials, n)
+                        fault_model=fault_model, seed=seed)
+        faults = (FaultSpec.first_f(cfg) if fault_model == "equivocate"
+                  else FaultSpec.none(trials, n))
         from benor_tpu.sweep import balanced_inputs
         balanced = balanced_inputs(trials, n)
-        state = init_state(cfg, balanced, no_crash)
-        _, final = run_consensus(cfg, state, no_crash, jax.random.key(seed))
+        state = init_state(cfg, balanced, faults)
+        _, final = run_consensus(cfg, state, faults, jax.random.key(seed))
     finally:
         sampling.EXACT_TABLE_MAX = old
-    dec = np.asarray(final.decided)
+    healthy = ~np.asarray(faults.faulty)
+    dec = np.asarray(final.decided) & healthy
     k = np.asarray(final.k)
     assert dec.any(axis=1).all(), "some trial failed to converge entirely"
-    assert dec.mean() > 0.99, "failed to converge"
+    assert (dec.sum(axis=1) > 0.99 * healthy.sum(axis=1)).all(), \
+        "failed to converge"
     return (k * dec).sum(axis=1) / dec.sum(axis=1)

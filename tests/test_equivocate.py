@@ -143,6 +143,29 @@ def test_all_delivery_tallies_every_sender():
     assert abs(frac - 0.5) < 4 * np.sqrt(0.25 / (f * trials * n))
 
 
+def test_all_delivery_small_f_split_is_exact():
+    """With trial-global n_equiv the 'all'-delivery class split uses the
+    exact shared-CDF binomial table: at F=2 the per-receiver byz-ones
+    distribution must be exactly (1/4, 1/2, 1/4), which the rounded normal
+    quantile gets measurably wrong (~0.24/0.52/0.24)."""
+    n, f, trials = 1024, 2, 64
+    cfg = SimConfig(n_nodes=n, n_faulty=f, delivery="all", trials=trials,
+                    fault_model="equivocate", seed=3)
+    faults = FaultSpec.first_f(cfg)
+    x = jnp.asarray(balanced_inputs(trials, n))
+    alive = jnp.ones((trials, n), bool)
+    counts = tally.receiver_counts(cfg, jax.random.key(0), jnp.int32(1),
+                                   rng.PHASE_PROPOSAL, x, alive,
+                                   equiv=faults.faulty)
+    honest_ones = np.asarray(
+        ((x == 1) & ~np.asarray(faults.faulty)).sum(-1))[:, None]
+    b1 = np.asarray(counts)[..., 1] - honest_ones          # in {0, 1, 2}
+    freq = np.bincount(b1.ravel(), minlength=3) / b1.size
+    # ~65k iid samples: sigma(p=1/4) ~ 0.0017 — 0.008 is ~4.5 sigma, and
+    # the normal-approx bias (~0.015 on the extremes) fails it
+    np.testing.assert_allclose(freq, [0.25, 0.5, 0.25], atol=0.008)
+
+
 # ---------------------------------------------------------------------------
 # Mesh-shape bit-identity: the equivocate plane (gathered equiv mask on the
 # dense path, psum'd n_equiv + global-id keyed draws on the histogram path)

@@ -13,6 +13,7 @@ cross a process boundary over TCP, not just a virtual-device boundary inside
 one runtime.
 """
 
+import concurrent.futures
 import os
 import socket
 import subprocess
@@ -41,22 +42,35 @@ def test_two_process_cluster_bit_identity():
             text=True)
         for pid in range(NPROC)
     ]
-    # Poll BOTH workers: if one crashes at startup, its peer (blocked in
-    # the distributed barrier) would hang — kill the survivors and surface
-    # the crashed worker's stderr instead of an opaque timeout.
-    deadline = time.time() + 420
-    while time.time() < deadline and any(p.poll() is None for p in procs):
-        if any(p.poll() not in (None, 0) for p in procs):
-            break                      # someone failed; stop waiting
-        time.sleep(0.5)
-    for p in procs:
-        if p.poll() is None:
-            p.kill()
-    outs = [p.communicate() for p in procs]
-    for pid, (p, (out, err)) in enumerate(zip(procs, outs)):
+    # Drain both workers' pipes from the start (a blocked pipe write would
+    # deadlock the run) while polling exit states: if one worker crashes,
+    # its peer blocks forever in the distributed barrier — kill survivors
+    # and report the CRASHED worker first, not the victim we killed.
+    with concurrent.futures.ThreadPoolExecutor(NPROC) as ex:
+        futs = [ex.submit(p.communicate) for p in procs]
+        deadline = time.time() + 420
+        while time.time() < deadline and any(p.poll() is None for p in procs):
+            if any(p.poll() not in (None, 0) for p in procs):
+                time.sleep(2)          # let the crash finish writing stderr
+                break
+            time.sleep(0.5)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        outs = [f.result(timeout=60) for f in futs]
+
+    # a worker we killed exits -9; a genuine crash carries the real rc and
+    # traceback — surface the genuine one first
+    order = sorted(range(NPROC),
+                   key=lambda i: 0 if procs[i].returncode not in (0, -9)
+                   else 1)
+    for pid in order:
+        p, (out, err) = procs[pid], outs[pid]
         assert p.returncode == 0, (
             f"worker {pid} rc={p.returncode}\nstdout:\n{out}\nstderr:\n"
             f"{err[-3000:]}")
+    for pid, (out, _) in enumerate(outs):
         for path in ("dense", "histogram"):
             assert f"worker{pid}[{path}]" in out and \
                 "bit-identical vs single-process OK" in out, out
+        assert f"worker{pid}[resume]" in out, out

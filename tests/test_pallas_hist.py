@@ -109,6 +109,94 @@ class TestKernel:
         np.testing.assert_array_equal(c.sum(-1), 1500)
 
 
+def _equiv_counts(seed, r, phase, hist, ne, m, n, trials=4):
+    from benor_tpu.ops.pallas_hist import equiv_counts_pallas
+    h = jnp.tile(jnp.asarray(hist, jnp.int32)[None, :], (trials, 1))
+    nev = jnp.full((trials,), ne, jnp.int32)
+    return np.asarray(equiv_counts_pallas(
+        jax.random.key(seed), jnp.int32(r), phase, h, nev, m, n,
+        interpret=True))
+
+
+class TestEquivKernel:
+    """Fused equivocate-regime sampler (ops/pallas_hist.py:_equiv_kernel)."""
+
+    def test_moments_all_honest_zero(self):
+        # honest all-0: the honest split is deterministic (h0 = rem), so
+        # class-1 counts come ONLY from the equivocators' fair bits:
+        # h1 ~ Binomial(h_b, 1/2), h_b ~ Hypergeom(total, ne, m)
+        total_h, ne, m, n = 6000, 2000, 5000, 2048
+        c = _equiv_counts(21, 2, 0, [total_h, 0, 0], ne, m, n, trials=8)
+        np.testing.assert_array_equal(c.sum(-1), m)
+        np.testing.assert_array_equal(c[..., 2], 0)
+        h1 = c[..., 1].ravel().astype(np.float64)
+        hb = st.hypergeom(total_h + ne, ne, m)
+        exp_mean = hb.mean() / 2
+        exp_var = hb.mean() / 4 + hb.var() / 4   # law of total variance
+        assert abs(h1.mean() - exp_mean) < 0.05 * np.sqrt(exp_var)
+        assert abs(h1.std() - np.sqrt(exp_var)) < 0.05 * np.sqrt(exp_var)
+
+    def test_deterministic_and_stream_separated(self):
+        args = ([4000, 3000, 1000], 1500, 5000, 1024)
+        a = _equiv_counts(42, 3, 0, *args)
+        assert np.array_equal(a, _equiv_counts(42, 3, 0, *args))
+        assert not np.array_equal(a, _equiv_counts(42, 4, 0, *args))
+        assert not np.array_equal(a, _equiv_counts(42, 3, 1, *args))
+        assert not np.array_equal(a, _equiv_counts(43, 3, 0, *args))
+
+    def test_protocol_ks_vs_xla_equiv_sampler(self):
+        """Full consensus with fault_model='equivocate': the fused kernel's
+        stream vs the four-grid_uniforms XLA pipeline must be
+        distributionally indistinguishable (per-trial aggregates)."""
+        from stat_harness import trial_mean_k
+        xla = trial_mean_k(750, 255, 128, 311, table_max=64,
+                           use_pallas_hist=False, fault_model="equivocate")
+        pallas = trial_mean_k(750, 255, 128, 312, table_max=64,
+                              use_pallas_hist=True, fault_model="equivocate")
+        res = st.ks_2samp(xla, pallas)
+        assert res.pvalue > 1e-3, (
+            f"equiv kernel shifts protocol outcomes: KS={res.statistic:.4f} "
+            f"p={res.pvalue:.2e} (xla {xla.mean():.3f}, "
+            f"pallas {pallas.mean():.3f})")
+        sem = np.hypot(xla.std() / len(xla) ** 0.5,
+                       pallas.std() / len(pallas) ** 0.5)
+        assert abs(xla.mean() - pallas.mean()) < 4 * sem + 1e-9
+
+    def test_sharded_bit_identical(self):
+        """Global-id counters + psum'd (hist, n_equiv): sharded equivocate
+        runs with the kernel are bit-identical to single-device."""
+        from benor_tpu.parallel import make_mesh, run_consensus_sharded
+        from benor_tpu.sim import run_consensus
+        from benor_tpu.state import FaultSpec, init_state
+
+        old = sampling.EXACT_TABLE_MAX
+        sampling.EXACT_TABLE_MAX = 8     # CF regime at m=12
+        try:
+            n, f, trials = 16, 4, 8
+            cfg = SimConfig(n_nodes=n, n_faulty=f, trials=trials,
+                            delivery="quorum", scheduler="uniform",
+                            path="histogram", use_pallas_hist=True,
+                            fault_model="equivocate", seed=17)
+            mask = np.zeros(n, bool)
+            mask[:f] = True
+            faults = FaultSpec.from_faulty_list(cfg, mask)
+            state = init_state(cfg, [i % 2 for i in range(n)], faults)
+            key = jax.random.key(17)
+            r1, s1 = run_consensus(cfg, state, faults, key)
+            for mesh_shape in ((2, 4), (4, 1)):
+                r2, s2 = run_consensus_sharded(cfg, state, faults, key,
+                                               make_mesh(*mesh_shape))
+                assert int(r1) == int(r2), mesh_shape
+                np.testing.assert_array_equal(
+                    np.asarray(s1.x), np.asarray(s2.x),
+                    err_msg=str(mesh_shape))
+                np.testing.assert_array_equal(
+                    np.asarray(s1.k), np.asarray(s2.k),
+                    err_msg=str(mesh_shape))
+        finally:
+            sampling.EXACT_TABLE_MAX = old
+
+
 class TestProtocolParity:
     """use_pallas_hist=True vs False through the full consensus loop.
     Shared harness (balanced inputs, zero crashes, F > N/3, per-trial

@@ -6,7 +6,8 @@ import numpy as np
 import pytest
 
 from benor_tpu.config import SimConfig
-from benor_tpu.sweep import (baseline_configs, coin_comparison, rounds_vs_f,
+from benor_tpu.sweep import (balanced_inputs, baseline_configs,
+                             coin_comparison, record_trajectory, rounds_vs_f,
                              run_point, save_points)
 
 
@@ -54,6 +55,57 @@ def test_coin_comparison_rejects_odd_quorum():
     cfg = SimConfig(n_nodes=21, n_faulty=6, trials=4)
     with pytest.raises(ValueError, match="even quorum"):
         coin_comparison(cfg, verbose=False)
+
+
+def test_trajectory_endpoint_matches_run_consensus():
+    """Fixed-round scan == early-exit while_loop once everything settled
+    (decided lanes freeze; settled rounds are state no-ops)."""
+    import jax
+
+    from benor_tpu.sim import run_consensus
+    from benor_tpu.state import FaultSpec, init_state
+
+    cfg = SimConfig(n_nodes=48, n_faulty=18, trials=16, delivery="quorum",
+                    scheduler="uniform", path="histogram", max_rounds=64,
+                    seed=3)
+    faults = FaultSpec.none(cfg.trials, cfg.n_nodes)
+    state = init_state(cfg, balanced_inputs(cfg.trials, cfg.n_nodes), faults)
+    key = jax.random.key(cfg.seed)
+    rounds, final = run_consensus(cfg, state, faults, key)
+    n_rounds = int(rounds) + 3                # strictly past termination
+    final_t, traj = record_trajectory(cfg, state, faults, key, n_rounds)
+    np.testing.assert_array_equal(np.asarray(final_t.x), np.asarray(final.x))
+    np.testing.assert_array_equal(np.asarray(final_t.decided),
+                                  np.asarray(final.decided))
+    np.testing.assert_array_equal(np.asarray(final_t.k), np.asarray(final.k))
+    dec = np.asarray(traj["decided"])
+    assert dec.shape == (n_rounds,)
+    assert (np.diff(dec) >= -1e-6).all()      # decided fraction is monotone
+    assert dec[-1] == 1.0
+    shares = (np.asarray(traj["zeros"]) + np.asarray(traj["ones"])
+              + np.asarray(traj["qs"]))
+    np.testing.assert_allclose(shares, 1.0, atol=1e-5)
+
+
+def test_trajectory_shows_adversarial_q_flood():
+    """Under the tie-forcing adversary the round-resolved signature is a
+    standing '?' majority and decided == 0 — visible ONLY in a trajectory
+    (the endpoint alone cannot distinguish livelock shapes)."""
+    import jax
+
+    from benor_tpu.state import FaultSpec, init_state
+
+    cfg = SimConfig(n_nodes=100, n_faulty=40, trials=8, delivery="quorum",
+                    scheduler="adversarial", coin_mode="private",
+                    path="histogram", max_rounds=8, seed=5)
+    faults = FaultSpec.none(cfg.trials, cfg.n_nodes)
+    state = init_state(cfg, balanced_inputs(cfg.trials, cfg.n_nodes), faults)
+    _, traj = record_trajectory(cfg, state, faults, jax.random.key(5), 6)
+    assert (np.asarray(traj["decided"]) == 0.0).all()
+    # after round 1's tied proposal tally every live lane votes "?" and
+    # then coins; the standing x-share of "?" stays 0 (x is post-coin) but
+    # the adversary keeps decided flat — contrast with the uniform run
+    assert (np.asarray(traj["disagree"]) == 0.0).all()
 
 
 def test_save_points_roundtrip(tmp_path):
