@@ -373,6 +373,67 @@ def _pallas_hist_check(n: int, trials: int, seed: int) -> dict:
     }
 
 
+def _pallas_equiv_check(n: int, trials: int, seed: int) -> dict:
+    """On-chip proof + timing for the equivocate-regime kernel
+    (ops/pallas_hist.py:equiv_counts_pallas) vs its four-grid_uniforms XLA
+    pipeline at the bench's own (N, T) operating point — the source of the
+    README's equivocate-kernel speedup figure, regenerated by every bench
+    run (a Mosaic lowering failure of this kernel surfaces here, not in
+    some unshipped side script)."""
+    import jax
+    import jax.numpy as jnp
+
+    from benor_tpu.ops import rng, sampling
+    from benor_tpu.ops.pallas_hist import equiv_counts_pallas
+
+    interpret = jax.default_backend() == "cpu"
+    m = int(0.55 * n)
+    hist = jnp.tile(jnp.array(
+        [[int(0.3 * n), int(0.28 * n), int(0.12 * n)]], jnp.int32),
+        (trials, 1))
+    n_equiv = jnp.full((trials,), int(0.3 * n), jnp.int32)
+    loops = 2 if interpret else 10
+
+    @jax.jit
+    def xla_loop(key):
+        def body(i, acc):
+            tid, nid = rng.ids(trials), rng.ids(n)
+            u_b = rng.grid_uniforms(key, i, 32, tid, nid)
+            u0 = rng.grid_uniforms(key, i, 0, tid, nid)
+            u1 = rng.grid_uniforms(key, i, 16, tid, nid)
+            u_s = rng.grid_uniforms(key, i, 48, tid, nid)
+            c = sampling.equivocate_hypergeom_counts(
+                u_b, u0, u1, u_s, hist, n_equiv, m)
+            return acc + jnp.sum(c[0, 0])
+        return jax.lax.fori_loop(0, loops, body, jnp.int32(0))
+
+    @jax.jit
+    def pallas_loop(key):
+        def body(i, acc):
+            c = equiv_counts_pallas(key, i, 0, hist, n_equiv, m, n,
+                                    interpret=interpret)
+            return acc + jnp.sum(c[0, 0])
+        return jax.lax.fori_loop(0, loops, body, jnp.int32(0))
+
+    key = jax.random.key(seed)
+    int(xla_loop(key)); int(pallas_loop(key))    # warm-up barriers
+    t0 = time.perf_counter(); int(xla_loop(key))
+    t_xla = (time.perf_counter() - t0) / loops
+    t0 = time.perf_counter(); int(pallas_loop(key))
+    t_pallas = (time.perf_counter() - t0) / loops
+
+    c = np.asarray(equiv_counts_pallas(key, jnp.int32(1), 0, hist, n_equiv,
+                                       m, n, interpret=interpret))
+    assert (c.sum(-1) == m).all()
+
+    return {
+        "interpret": interpret, "n": n, "trials": trials, "m": m,
+        "xla_ms": round(t_xla * 1e3, 3),
+        "pallas_ms": round(t_pallas * 1e3, 3),
+        "speedup": round(t_xla / t_pallas, 3) if t_pallas > 0 else None,
+    }
+
+
 def bench_sweep(platform: str, fallback: bool) -> dict:
     """The north-star workload: multi-regime rounds-vs-f science sweep at
     N=1M (TPU) / 50k (CPU smoke), with hardware-capability accounting."""
@@ -520,6 +581,11 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
     except Exception as e:  # noqa: BLE001
         pallas_hist = {"error": f"{type(e).__name__}: {e}"}
     log(f"bench: pallas hist check {pallas_hist}")
+    try:
+        pallas_equiv = _pallas_equiv_check(n, trials, seed)
+    except Exception as e:  # noqa: BLE001
+        pallas_equiv = {"error": f"{type(e).__name__}: {e}"}
+    log(f"bench: pallas equiv check {pallas_equiv}")
 
     total_trials = trials * len(regimes)
     log(f"bench: sweep elapsed {elapsed:.2f}s for {total_trials} trials; "
@@ -544,6 +610,7 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
         "equiv_threshold": equiv_threshold,
         "pallas_check": pallas,
         "pallas_hist_check": pallas_hist,
+        "pallas_equiv_check": pallas_equiv,
         "pallas_demoted": demoted,
     }
 

@@ -33,6 +33,16 @@ def launch_network(n: int, f: int, initial_values: Sequence,
     else:
         cfg = cfg.replace(n_nodes=n, n_faulty=f,
                           backend=backend or cfg.backend, **cfg_overrides)
+    if cfg.backend in ("express", "native"):
+        if cfg.fault_model != "crash":
+            # The oracles replicate the REFERENCE's semantics, whose only
+            # fault model is crash-from-birth (node.ts:21-26, SURVEY §2.1
+            # quirk 7); silently reinterpreting byzantine/equivocate lanes
+            # as crashed would fake a parity the oracle cannot provide.
+            raise ValueError(
+                f"backend={cfg.backend!r} supports only "
+                f"fault_model='crash' (the reference's fault model); "
+                f"got {cfg.fault_model!r} — use backend='tpu'")
     if cfg.backend == "express":
         return ExpressNetwork(cfg, list(initial_values), list(faulty_list))
     if cfg.backend == "native":

@@ -23,10 +23,12 @@ from . import rng, sampling, scheduler
 from .collectives import SINGLE, ShardCtx
 
 
-def _pallas_cf_regime(cfg: SimConfig) -> bool:
+def pallas_stream_active(cfg: SimConfig) -> bool:
     """The shared gating for every fused histogram-path kernel: the
     uniform-scheduler quorum-delivery CF regime.  Kept in ONE place so the
-    two sampler kernels can never diverge in when they engage."""
+    sampler kernels — and the private-coin kernel, which must switch
+    streams together with WHICHEVER sampler serves the config — can never
+    diverge in when they engage."""
     return (cfg.use_pallas_hist and cfg.scheduler == "uniform"
             and cfg.delivery == "quorum"
             and cfg.resolved_path == "histogram"
@@ -35,16 +37,15 @@ def _pallas_cf_regime(cfg: SimConfig) -> bool:
 
 def pallas_hist_active(cfg: SimConfig) -> bool:
     """True iff the fused pallas sampler serves this config's histogram
-    tallies (and, for private coins, the coin kernel — the coin switches
-    together with EITHER sampler predicate)."""
-    return _pallas_cf_regime(cfg) and cfg.fault_model != "equivocate"
+    tallies."""
+    return pallas_stream_active(cfg) and cfg.fault_model != "equivocate"
 
 
 def pallas_equiv_active(cfg: SimConfig) -> bool:
     """True iff the fused equivocate-regime kernel serves this config's
     histogram tallies (the equivocate counterpart of pallas_hist_active —
     same CF-regime gating, different sampler kernel)."""
-    return _pallas_cf_regime(cfg) and cfg.fault_model == "equivocate"
+    return pallas_stream_active(cfg) and cfg.fault_model == "equivocate"
 
 
 def dense_gather_needed(cfg: SimConfig) -> bool:

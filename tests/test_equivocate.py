@@ -194,3 +194,15 @@ def test_biased_scheduler_rejected():
     with pytest.raises(ValueError, match="equivocate"):
         SimConfig(n_nodes=10, n_faulty=2, scheduler="biased",
                   fault_model="equivocate")
+
+
+@pytest.mark.parametrize("backend", ["express", "native"])
+@pytest.mark.parametrize("model", ["byzantine", "equivocate"])
+def test_oracle_backends_reject_non_crash_models(backend, model):
+    """The event-loop oracles replicate the reference, whose only fault
+    model is crash-from-birth — asking them for live-faulty semantics must
+    fail loudly, not silently crash the lanes (api.py guard)."""
+    from benor_tpu.api import launch_network
+    with pytest.raises(ValueError, match="fault_model='crash'"):
+        launch_network(6, 2, [1] * 6, [True] * 2 + [False] * 4,
+                       backend=backend, fault_model=model)

@@ -177,9 +177,7 @@ class TestEquivKernel:
                             delivery="quorum", scheduler="uniform",
                             path="histogram", use_pallas_hist=True,
                             fault_model="equivocate", seed=17)
-            mask = np.zeros(n, bool)
-            mask[:f] = True
-            faults = FaultSpec.from_faulty_list(cfg, mask)
+            faults = FaultSpec.first_f(cfg)
             state = init_state(cfg, [i % 2 for i in range(n)], faults)
             key = jax.random.key(17)
             r1, s1 = run_consensus(cfg, state, faults, key)
