@@ -425,6 +425,12 @@ def _pallas_equiv_check(n: int, trials: int, seed: int) -> dict:
     c = np.asarray(equiv_counts_pallas(key, jnp.int32(1), 0, hist, n_equiv,
                                        m, n, interpret=interpret))
     assert (c.sum(-1) == m).all()
+    # class-mean sanity on the real lowering (sum-to-m alone is trivially
+    # true by construction — hq is derived): class-0 draws come from the
+    # honest c0 pool plus half the delivered equivocators in expectation
+    h0 = c[..., 0].astype(np.float64)
+    exp_mean = m * (int(0.3 * n) + int(0.3 * n) / 2) / float(n)
+    assert abs(h0.mean() - exp_mean) < 0.01 * exp_mean, (h0.mean(), exp_mean)
 
     return {
         "interpret": interpret, "n": n, "trials": trials, "m": m,

@@ -108,6 +108,34 @@ def test_trajectory_shows_adversarial_q_flood():
     assert (np.asarray(traj["disagree"]) == 0.0).all()
 
 
+def test_results_generator_end_to_end(tmp_path):
+    """The science-deliverable generator (benor_tpu.results.generate) runs
+    every study end-to-end at toy scale and writes both artifacts; the
+    committed RESULTS/ is this exact pipeline at N=1M on the real chip."""
+    from benor_tpu.results import generate
+
+    out = generate(out_dir=str(tmp_path), n_large=400, trials_large=4,
+                   presets=False)
+    for key in ("balanced_curve", "margin_sweep", "coin_contrast",
+                "disagreement", "equivocation", "trajectory", "scaling",
+                "rule_comparison"):
+        assert key in out, key
+    # the N//3 threshold rows must disagree about decidability (N=400:
+    # F=133 has 3F<N, F=134 has 3F>N)
+    eq = {r["label"]: r for r in out["equivocation"]}
+    assert eq["N//3"]["decided_frac"] == 1.0
+    assert eq["N//3+1"]["decided_frac"] == 0.0
+    # plurality adoption must converge faster than textbook
+    rules = {r["rule"]: r for r in out["rule_comparison"]}
+    assert rules["reference"]["mean_k"] < rules["textbook"]["mean_k"]
+    # the scaling study must include the requested top point even when it
+    # is below the usual 10^3..10^6 ladder
+    assert [r["n"] for r in out["scaling"]] == [400]
+    md = (tmp_path / "RESULTS.md").read_text()
+    assert "N > 3F" in md and "trajectory" in md.lower()
+    assert (tmp_path / "results.json").exists()
+
+
 def test_save_points_roundtrip(tmp_path):
     cfg = SimConfig(n_nodes=10, n_faulty=2, trials=8, delivery="quorum",
                     scheduler="uniform", seed=8)
