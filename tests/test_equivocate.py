@@ -143,6 +143,26 @@ def test_all_delivery_tallies_every_sender():
     assert abs(frac - 0.5) < 4 * np.sqrt(0.25 / (f * trials * n))
 
 
+@pytest.mark.parametrize("path", ["dense", "histogram"])
+def test_validity_holds_under_equivocation(path):
+    """VALIDITY survives equivocation at ANY F under the uniform scheduler:
+    with unanimous honest inputs v, the ¬v count comes only from delivered
+    equivocator bits, which never exceed h_b <= F — so count(¬v) > F is
+    unsatisfiable and no honest lane can decide the wrong value.  (The
+    plurality-adopt branch can still be noise-steered, so the guarantee is
+    about DECIDED values, which is exactly validity.)"""
+    n, f, trials = 60, 25, 32                     # F > N/3, still valid
+    cfg = _cfg(n, f, path, trials=trials, max_rounds=64, seed=8)
+    rounds, final, faults = simulate(
+        cfg, np.ones((trials, n), np.int8), _faulty(n, f))
+    dec = np.asarray(final.decided)[:, f:]
+    x = np.asarray(final.x)[:, f:]
+    assert ((x == 1) | ~dec).all(), "an honest lane decided the wrong value"
+    # termination too: equivocator noise can delay lanes near the F > N/3
+    # threshold a few rounds, but never livelocks the uniform scheduler
+    assert dec.all() and int(rounds) < cfg.max_rounds
+
+
 def test_all_delivery_small_f_split_is_exact():
     """With trial-global n_equiv the 'all'-delivery class split uses the
     exact shared-CDF binomial table: at F=2 the per-receiver byz-ones

@@ -188,6 +188,40 @@ class TestFullRun:
         assert np.asarray(final.decided).all()
         assert (np.asarray(final.x) == 1).all()
 
+    def test_freeze_decided_off_keeps_lanes_looping(self):
+        """freeze_decided=False models the reference's literal quirk 5
+        (decided nodes keep executing rounds, node.ts:147-157): decided
+        lanes keep advancing k until the TRIAL settles, so every lane of a
+        trial ends at the same k = rounds+1; with the default freeze, each
+        lane's k stays pinned at its own decide round."""
+        import benor_tpu.sweep as sweep
+
+        base = SimConfig(n_nodes=48, n_faulty=18, trials=16, max_rounds=64,
+                         delivery="quorum", scheduler="uniform",
+                         path="histogram", seed=11)
+        vals = sweep.balanced_inputs(16, 48)
+        no_crash = FaultSpec.none(16, 48)
+        from benor_tpu.sim import run_consensus
+        out = {}
+        for freeze in (True, False):
+            cfg = base.replace(freeze_decided=freeze)
+            state = init_state(cfg, vals, no_crash)
+            r, final = run_consensus(cfg, state, no_crash,
+                                     jax.random.key(11))
+            assert np.asarray(final.decided).all()      # still terminates
+            out[freeze] = (int(r), np.asarray(final.k))
+        r_frozen, k_frozen = out[True]
+        r_loose, k_loose = out[False]
+        # unfrozen: every lane advanced through the WHOLE run (settled
+        # trials' lanes keep looping until the global loop exits), so all
+        # end at exactly k = rounds_executed + 1
+        assert (k_loose == r_loose + 1).all()
+        # frozen: in multi-round trials, early deciders' k stays behind
+        multi = k_frozen.max(axis=1) > 2
+        assert multi.any(), "need at least one multi-round trial"
+        assert (k_frozen[multi].min(axis=1) <
+                k_frozen[multi].max(axis=1)).any()
+
     def test_agreement_and_validity_invariants_random(self):
         # Property: agreement (all deciders agree) + validity (decided value
         # was some node's input) over randomized inputs — reference :399-450
