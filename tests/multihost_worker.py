@@ -67,17 +67,23 @@ def main() -> None:
         r1, f1 = run_consensus(cfg, full, faults, base_key)
 
         # multi-host run: build ONLY this process's slab, assemble globals
-        tr, nd = local_block(mesh, T, N)
-        sl = lambda a: np.asarray(a)[tr, nd]
-        gstate = to_global(jax.tree.map(sl, full), mesh, (T, N))
-        gfaults = to_global(jax.tree.map(sl, faults), mesh, (T, N))
-        r, fin = run_consensus_multihost(cfg, gstate, gfaults, base_key, mesh)
+        def assemble(m):
+            tr, nd = local_block(m, T, N)
+            sl = lambda a: np.asarray(a)[tr, nd]
+            return (to_global(jax.tree.map(sl, full), m, (T, N)),
+                    to_global(jax.tree.map(sl, faults), m, (T, N)))
 
-        for leaf in ("x", "decided", "k", "killed"):
-            got = np.asarray(multihost_utils.process_allgather(
-                getattr(fin, leaf), tiled=True))
-            np.testing.assert_array_equal(got, np.asarray(getattr(f1, leaf)),
-                                          err_msg=leaf)
+        def assert_leaves_equal(fin, label):
+            for leaf in ("x", "decided", "k", "killed"):
+                got = np.asarray(multihost_utils.process_allgather(
+                    getattr(fin, leaf), tiled=True))
+                np.testing.assert_array_equal(
+                    got, np.asarray(getattr(f1, leaf)),
+                    err_msg=f"{label}:{leaf}")
+
+        gstate, gfaults = assemble(mesh)
+        r, fin = run_consensus_multihost(cfg, gstate, gfaults, base_key, mesh)
+        assert_leaves_equal(fin, "default-mesh")
         assert int(r) == int(r1), (int(r), int(r1))
         print(f"worker{pid}[{path}]: mesh="
               f"({mesh.shape['trials']}x{mesh.shape['nodes']}) "
@@ -85,6 +91,20 @@ def main() -> None:
               f"bit-identical vs single-process OK", flush=True)
 
         if path == "histogram":
+            # the PATHOLOGICAL layout: the node axis spanning both
+            # processes, so the per-round histogram psum rides the
+            # cross-host (DCN) link.  Wrong for performance, but the
+            # result must still be bit-identical — layout never affects
+            # semantics (global-id RNG keys).
+            mesh_x = global_mesh(trial_shards=1)
+            gx_state, gx_faults = assemble(mesh_x)
+            rx, finx = run_consensus_multihost(cfg, gx_state, gx_faults,
+                                               base_key, mesh_x)
+            assert_leaves_equal(finx, "xhost-nodes")
+            assert int(rx) == int(r1)
+            print(f"worker{pid}[xhost-nodes]: mesh=(1x{4 * nproc}) "
+                  f"node-psum across processes bit-identical OK", flush=True)
+
             # checkpoint re-entry across hosts: cut the run at round 2,
             # resume from round 3 — cut + resume must equal the
             # uninterrupted run bitwise (randomness keys on (key, round,

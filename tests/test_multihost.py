@@ -74,3 +74,4 @@ def test_two_process_cluster_bit_identity():
             assert f"worker{pid}[{path}]" in out and \
                 "bit-identical vs single-process OK" in out, out
         assert f"worker{pid}[resume]" in out, out
+        assert f"worker{pid}[xhost-nodes]" in out, out
