@@ -95,13 +95,22 @@ def _sweep(args) -> int:
 
 def _coins(args) -> int:
     from .config import SimConfig
-    from .sweep import coin_comparison
+    from .state import FaultSpec
+    from .sweep import balanced_inputs, coin_comparison, run_point
     cfg = SimConfig(n_nodes=args.n, n_faulty=args.f, trials=args.trials,
                     max_rounds=args.max_rounds, seed=args.seed)
     res = coin_comparison(cfg)
     for mode, pts in res.items():
         p = pts[0]
         print(f"{mode}: decided={p.decided_frac:.3f} mean_k={p.mean_k:.2f}")
+    for eps in (args.eps or []):
+        wcfg = cfg.replace(coin_mode="weak_common", coin_eps=eps,
+                           scheduler="adversarial", delivery="quorum")
+        p = run_point(wcfg, initial_values=balanced_inputs(args.trials,
+                                                           args.n),
+                      faults=FaultSpec.none(args.trials, args.n))
+        print(f"weak_common(eps={eps}): decided={p.decided_frac:.3f} "
+              f"mean_k={p.mean_k:.2f}")
     return 0
 
 
@@ -162,6 +171,10 @@ def main(argv=None) -> int:
     c.add_argument("--trials", type=int, default=128)
     c.add_argument("--max-rounds", type=int, default=48)
     c.add_argument("--seed", type=int, default=0)
+    c.add_argument("--eps", type=float, nargs="*",
+                   help="also run weak_common coins at these deviation "
+                        "probabilities (0 ~ common, 1 ~ private; the "
+                        "termination transition sits at 1 - F/N)")
 
     p = sub.add_parser("preset", help="run a BASELINE.json preset config")
     p.add_argument("name")

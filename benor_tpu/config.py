@@ -47,10 +47,19 @@ class SimConfig:
 
     # --- randomness -----------------------------------------------------
     seed: int = 0
-    # 'private': independent fair coin per (trial, node, round) — reference
-    #   Math.random() at node.ts:111.  'common': one shared coin per
-    #   (trial, round) — the shared-common-coin variant (expected O(1) rounds).
+    # 'private':     independent fair coin per (trial, node, round) —
+    #                reference Math.random() at node.ts:111.
+    # 'common':      one shared coin per (trial, round) — the shared-
+    #                common-coin variant (expected O(1) rounds).
+    # 'weak_common': each lane sees the shared coin with probability
+    #                1 - coin_eps, an independent private flip otherwise —
+    #                the classical weak-coin abstraction interpolating the
+    #                two (eps=0 ~ common, eps=1 ~ private); termination
+    #                under the count-controlling adversary has a sharp
+    #                phase transition in eps (results.weak_coin_study).
     coin_mode: str = "private"
+    # Per-lane deviation probability for coin_mode='weak_common'.
+    coin_eps: float = 0.0
 
     # --- delivery / scheduler (the N9 asynchrony model) -----------------
     # 'all':    every receiver tallies every live sender's message (the
@@ -154,8 +163,13 @@ class SimConfig:
             raise ValueError("n_faulty must be in [0, n_nodes]")
         if self.rule not in ("reference", "textbook"):
             raise ValueError(f"unknown rule: {self.rule}")
-        if self.coin_mode not in ("private", "common"):
+        if self.coin_mode not in ("private", "common", "weak_common"):
             raise ValueError(f"unknown coin_mode: {self.coin_mode}")
+        if not (0.0 <= self.coin_eps <= 1.0):
+            raise ValueError("coin_eps must be in [0, 1]")
+        if self.coin_eps and self.coin_mode != "weak_common":
+            raise ValueError(
+                "coin_eps only applies to coin_mode='weak_common'")
         if self.delivery not in ("all", "quorum"):
             raise ValueError(f"unknown delivery: {self.delivery}")
         if self.scheduler not in ("uniform", "biased", "adversarial"):

@@ -114,7 +114,10 @@ def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
 
     decide0 = v0 > F                                         # node.ts:99
     decide1 = v1 > F                                         # node.ts:102
-    if tally.pallas_stream_active(cfg) and cfg.coin_mode == "private":
+    if cfg.coin_mode == "weak_common":
+        coin = rng.weak_common_coin_flips(base_key, r, ctx.trial_ids(T),
+                                          ctx.node_ids(N), cfg.coin_eps)
+    elif tally.pallas_stream_active(cfg) and cfg.coin_mode == "private":
         # One threefry block per lane in VMEM instead of the chained
         # fold_in pipeline — switches together with the sampler kernel so
         # use_pallas_hist selects ONE coherent alternative stream

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 PHASE_PROPOSAL = 0
 PHASE_VOTE = 1
 PHASE_COIN = 2
+PHASE_COIN_DEV = 3   # weak-common-coin per-lane deviation stream
 
 
 def round_key(base_key: jax.Array, r: jax.Array, phase: int) -> jax.Array:
@@ -97,6 +98,36 @@ def coin_flips(base_key: jax.Array, r: jax.Array, trial_ids: jax.Array,
     flat = keys.reshape(-1)
     bits = jax.vmap(lambda k: jax.random.bernoulli(k))(flat)
     return bits.reshape(trial_ids.shape[0], node_ids.shape[0]).astype(jnp.int8)
+
+
+def weak_common_coin_flips(base_key: jax.Array, r: jax.Array,
+                           trial_ids: jax.Array, node_ids: jax.Array,
+                           eps: float) -> jax.Array:
+    """epsilon-weak common coin -> int8 {0, 1}, shape [T, N].
+
+    Each lane sees the round's shared coin with probability 1 - eps and an
+    independent private flip otherwise — the classical weak/common-coin
+    abstraction (Rabin-style shared coins are eps = 0; Ben-Or's private
+    coins are the eps = 1 limit).  Against the count-controlling adversary
+    the deviating minority is what the adversary ties WITH, so termination
+    has a sharp phase transition in eps (see results.weak_coin_study).
+
+    Three independent streams: the shared bit (PHASE_COIN, per trial), the
+    per-lane deviation mask (PHASE_COIN_DEV), and the per-lane private
+    fallback (PHASE_COIN per (trial, node) — the same stream private mode
+    uses).  All keyed on global ids: mesh-shape bit-identical.
+    """
+    # eps is trace-time static: the endpoints ARE the existing modes, so
+    # short-circuit instead of generating two [T, N] streams only to mask
+    # them out entirely (2 full grid-RNG passes per round at N=1M).
+    if eps <= 0.0:
+        return coin_flips(base_key, r, trial_ids, node_ids, common=True)
+    if eps >= 1.0:
+        return coin_flips(base_key, r, trial_ids, node_ids, common=False)
+    shared = coin_flips(base_key, r, trial_ids, node_ids, common=True)
+    private = coin_flips(base_key, r, trial_ids, node_ids, common=False)
+    dev_u = grid_uniforms(base_key, r, PHASE_COIN_DEV, trial_ids, node_ids)
+    return jnp.where(dev_u < eps, private, shared)
 
 
 def ids(n: int, offset: int = 0) -> jax.Array:

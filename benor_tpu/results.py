@@ -232,6 +232,40 @@ def trajectory_study(n: int, trials: int, seed: int = 0,
     return rows
 
 
+#: Weak-coin deviation probabilities: coarse approach + a fine straddle of
+#: the predicted critical point eps* = 1 - f (the adversary can tie a coin
+#: round iff the deviating minority reaches the tie target m/2, i.e.
+#: eps/2 >= (1-f)/2; at N=1M the Binomial(N, eps/2) fluctuation is only
+#: ~5e-4 of N, so the transition is knife-edge sharp).
+WEAK_COIN_EPS = (0.0, 0.3, 0.5, 0.58, 0.597, 0.603, 0.62, 0.8, 1.0)
+
+
+def weak_coin_study(n: int, trials: int, seed: int = 0,
+                    f_frac: float = 0.40, eps_grid=WEAK_COIN_EPS,
+                    verbose=True) -> List[Dict]:
+    """Termination vs coin quality under the count-controlling adversary.
+
+    coin_mode='weak_common' interpolates Rabin-style shared coins
+    (eps = 0) and Ben-Or private coins (eps = 1): each lane deviates to a
+    private flip with probability eps.  The adversary lives off the
+    deviators — it can tie a post-coin round iff the minority class
+    reaches m/2 — so termination has a phase transition at eps* = 1 - f,
+    located here to ~1e-3 at N=1M."""
+    rows = []
+    for eps in eps_grid:
+        cfg = SimConfig(n_nodes=n, n_faulty=int(f_frac * n), trials=trials,
+                        max_rounds=16, delivery="quorum",
+                        scheduler="adversarial", coin_mode="weak_common",
+                        coin_eps=eps, path="histogram", seed=seed)
+        pt = run_point(cfg, initial_values=_balanced(trials, n),
+                       faults=FaultSpec.none(trials, n))
+        rows.append({"eps": eps, **pt.to_dict()})
+        if verbose:
+            print(f"  eps={eps}: decided={pt.decided_frac:.3f} "
+                  f"mean_k={pt.mean_k:.2f}", flush=True)
+    return rows
+
+
 def equivocation_threshold(n: int, trials: int, seed: int = 0,
                            verbose=True) -> List[Dict]:
     """Locate the N > 3F bound at scale: equivocators under the
@@ -309,6 +343,10 @@ def generate(out_dir: str = "RESULTS", n_large: int = 1_000_000,
     print("decision rule: reference vs textbook (f=0.45, balanced):",
           flush=True)
     out["rule_comparison"] = rule_comparison(n_large, trials_large, seed)
+
+    print("weak common coin: termination vs eps (f=0.40, adversary):",
+          flush=True)
+    out["weak_coin"] = weak_coin_study(n_large, trials_large, seed)
 
     if presets:
         for name, cfg in baseline_configs().items():
@@ -449,6 +487,27 @@ def _write_markdown(out_dir: str, out: Dict) -> None:
                 f"| {row['n']:,} | {row['mean_k']:.3f} "
                 f"| {row['decided_frac']:.3f} "
                 f"| {row['trials_per_sec']:.1f} |")
+    if "weak_coin" in out:
+        lines += [
+            "",
+            "## Weak common coin: termination vs deviation probability ε "
+            "(f = 0.40)",
+            "",
+            "`coin_mode='weak_common'` interpolates shared (ε = 0) and "
+            "private (ε = 1) coins: each lane deviates to a private flip "
+            "with probability ε. The count-controlling adversary can tie a "
+            "post-coin round iff the deviating minority reaches m/2, so "
+            "termination flips at ε\\* = 1 − f — located below to ~10⁻³ at "
+            "N = 10⁶ (weak coins *almost* as bad as ε\\* still terminate; "
+            "slightly past it, livelock):",
+            "",
+            "| ε | decided | mean k | rounds executed |",
+            "|---|---|---|---|",
+        ]
+        for row in out["weak_coin"]:
+            lines.append(
+                f"| {row['eps']} | {row['decided_frac']:.3f} "
+                f"| {row['mean_k']:.2f} | {row['rounds_executed']} |")
     if "rule_comparison" in out:
         lines += [
             "",

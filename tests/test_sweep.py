@@ -108,6 +108,69 @@ def test_trajectory_shows_adversarial_q_flood():
     assert (np.asarray(traj["disagree"]) == 0.0).all()
 
 
+class TestWeakCommonCoin:
+    """coin_mode='weak_common': the eps-interpolation between shared and
+    private coins, against the count-controlling adversary (N=100, F=40 —
+    F >> sqrt(N) so the private limit's livelock persists)."""
+
+    def _run(self, eps, max_rounds=24, trials=64, seed=3):
+        import jax
+
+        from benor_tpu.sim import run_consensus
+        from benor_tpu.state import FaultSpec, init_state
+        from benor_tpu.sweep import balanced_inputs
+
+        cfg = SimConfig(n_nodes=100, n_faulty=40, trials=trials,
+                        delivery="quorum", scheduler="adversarial",
+                        coin_mode="weak_common", coin_eps=eps,
+                        max_rounds=max_rounds, seed=seed)
+        faults = FaultSpec.none(trials, 100)
+        state = init_state(cfg, balanced_inputs(trials, 100), faults)
+        r, final = run_consensus(cfg, state, faults, jax.random.key(seed))
+        return cfg, int(r), np.asarray(final.decided)
+
+    def test_limits_and_transition(self):
+        # eps=0 ~ common: O(1) rounds; eps=1 ~ private: livelock;
+        # decided fraction is monotone non-increasing across the grid
+        _, r0, d0 = self._run(0.0)
+        assert d0.all() and r0 <= 4
+        _, r1, d1 = self._run(1.0)
+        assert not d1.any() and r1 == 24
+        fracs = [self._run(e)[2].mean() for e in (0.2, 0.5, 0.7, 0.9)]
+        assert all(a >= b - 1e-9 for a, b in zip(fracs, fracs[1:])), fracs
+        # the transition brackets the predicted eps* = 1 - f = 0.6
+        assert fracs[1] > 0.9 and fracs[-1] < 0.5, fracs
+
+    def test_mesh_bit_identity(self):
+        import jax
+
+        from benor_tpu.parallel import make_mesh, run_consensus_sharded
+        from benor_tpu.sim import run_consensus
+        from benor_tpu.state import FaultSpec, init_state
+        from benor_tpu.sweep import balanced_inputs
+
+        cfg = SimConfig(n_nodes=32, n_faulty=12, trials=4,
+                        delivery="quorum", scheduler="adversarial",
+                        coin_mode="weak_common", coin_eps=0.75,
+                        max_rounds=12, seed=5, path="histogram")
+        faults = FaultSpec.none(4, 32)
+        state = init_state(cfg, balanced_inputs(4, 32), faults)
+        key = jax.random.key(5)
+        r1, f1 = run_consensus(cfg, state, faults, key)
+        r2, f2 = run_consensus_sharded(cfg, state, faults, key,
+                                       make_mesh(2, 4))
+        assert int(r1) == int(r2)
+        np.testing.assert_array_equal(np.asarray(f1.x), np.asarray(f2.x))
+        np.testing.assert_array_equal(np.asarray(f1.k), np.asarray(f2.k))
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="coin_eps"):
+            SimConfig(n_nodes=4, n_faulty=0, coin_eps=1.5,
+                      coin_mode="weak_common")
+        with pytest.raises(ValueError, match="weak_common"):
+            SimConfig(n_nodes=4, n_faulty=0, coin_eps=0.5)
+
+
 def test_results_generator_end_to_end(tmp_path):
     """The science-deliverable generator (benor_tpu.results.generate) runs
     every study end-to-end at toy scale and writes both artifacts; the
@@ -118,7 +181,7 @@ def test_results_generator_end_to_end(tmp_path):
                    presets=False)
     for key in ("balanced_curve", "margin_sweep", "coin_contrast",
                 "disagreement", "equivocation", "trajectory", "scaling",
-                "rule_comparison"):
+                "rule_comparison", "weak_coin"):
         assert key in out, key
     # the N//3 threshold rows must disagree about decidability (N=400:
     # F=133 has 3F<N, F=134 has 3F>N)
