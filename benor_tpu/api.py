@@ -34,15 +34,23 @@ def launch_network(n: int, f: int, initial_values: Sequence,
         cfg = cfg.replace(n_nodes=n, n_faulty=f,
                           backend=backend or cfg.backend, **cfg_overrides)
     if cfg.backend in ("express", "native"):
-        if cfg.fault_model != "crash":
-            # The oracles replicate the REFERENCE's semantics, whose only
-            # fault model is crash-from-birth (node.ts:21-26, SURVEY §2.1
-            # quirk 7); silently reinterpreting byzantine/equivocate lanes
-            # as crashed would fake a parity the oracle cannot provide.
-            raise ValueError(
-                f"backend={cfg.backend!r} supports only "
-                f"fault_model='crash' (the reference's fault model); "
-                f"got {cfg.fault_model!r} — use backend='tpu'")
+        # The oracles replicate the REFERENCE's semantics exactly: crash-
+        # from-birth faults (node.ts:21-26, SURVEY §2.1 quirk 7), private
+        # Math.random() coins (node.ts:111), and the plurality-adopt rule
+        # (node.ts:106-112).  Silently substituting those for a requested
+        # extension would fake a parity the oracle cannot provide.
+        # (scheduler too: the oracles' asynchrony is their OWN event-loop
+        # delivery order, cfg.oracle_order — they never read cfg.scheduler,
+        # so a biased/adversarial request would silently run uniform.)
+        for knob, val, want in (("fault_model", cfg.fault_model, "crash"),
+                                ("coin_mode", cfg.coin_mode, "private"),
+                                ("rule", cfg.rule, "reference"),
+                                ("scheduler", cfg.scheduler, "uniform")):
+            if val != want:
+                raise ValueError(
+                    f"backend={cfg.backend!r} supports only {knob}="
+                    f"{want!r} (the reference's semantics); got {val!r} — "
+                    f"use backend='tpu'")
     if cfg.backend == "express":
         return ExpressNetwork(cfg, list(initial_values), list(faulty_list))
     if cfg.backend == "native":

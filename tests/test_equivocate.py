@@ -217,12 +217,21 @@ def test_biased_scheduler_rejected():
 
 
 @pytest.mark.parametrize("backend", ["express", "native"])
-@pytest.mark.parametrize("model", ["byzantine", "equivocate"])
-def test_oracle_backends_reject_non_crash_models(backend, model):
-    """The event-loop oracles replicate the reference, whose only fault
-    model is crash-from-birth — asking them for live-faulty semantics must
-    fail loudly, not silently crash the lanes (api.py guard)."""
+@pytest.mark.parametrize("overrides,msg", [
+    ({"fault_model": "byzantine"}, "fault_model='crash'"),
+    ({"fault_model": "equivocate"}, "fault_model='crash'"),
+    ({"coin_mode": "common"}, "coin_mode='private'"),
+    ({"coin_mode": "weak_common", "coin_eps": 0.5}, "coin_mode='private'"),
+    ({"rule": "textbook"}, "rule='reference'"),
+    ({"scheduler": "adversarial"}, "scheduler='uniform'"),
+    ({"scheduler": "biased", "adversary_strength": 1.0},
+     "scheduler='uniform'"),
+])
+def test_oracle_backends_reject_extension_knobs(backend, overrides, msg):
+    """The event-loop oracles replicate the reference exactly (crash
+    faults, private coins, plurality-adopt) — asking them for a framework
+    extension must fail loudly, not silently fall back (api.py guard)."""
     from benor_tpu.api import launch_network
-    with pytest.raises(ValueError, match="fault_model='crash'"):
+    with pytest.raises(ValueError, match=msg):
         launch_network(6, 2, [1] * 6, [True] * 2 + [False] * 4,
-                       backend=backend, fault_model=model)
+                       backend=backend, **overrides)
