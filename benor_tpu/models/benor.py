@@ -115,8 +115,25 @@ def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
     decide0 = v0 > F                                         # node.ts:99
     decide1 = v1 > F                                         # node.ts:102
     if cfg.coin_mode == "weak_common":
-        coin = rng.weak_common_coin_flips(base_key, r, ctx.trial_ids(T),
-                                          ctx.node_ids(N), cfg.coin_eps)
+        if tally.pallas_stream_active(cfg) and 0.0 < cfg.coin_eps < 1.0:
+            # fused weak-coin kernel (private bits + deviation mask in
+            # VMEM); the per-trial shared bit stays XLA-side.  Endpoints
+            # fall through to the XLA helper, which short-circuits them
+            # to the plain common/private streams.
+            from ..ops.pallas_hist import weak_coin_flips_pallas
+            # node axis passed as a 1-wide placeholder (rng.ids(1), NOT a
+            # shard-dependent id): the common branch keys on trial ids
+            # only, and the bit must be identical on every node shard
+            shared = rng.coin_flips(base_key, r, ctx.trial_ids(T),
+                                    rng.ids(1), common=True)[:, 0]
+            coin = weak_coin_flips_pallas(
+                base_key, r, T, N, cfg.coin_eps, shared,
+                interpret=jax.default_backend() == "cpu",
+                node_offset=ctx.node_ids(N)[0],
+                trial_offset=ctx.trial_ids(T)[0])
+        else:
+            coin = rng.weak_common_coin_flips(base_key, r, ctx.trial_ids(T),
+                                              ctx.node_ids(N), cfg.coin_eps)
     elif tally.pallas_stream_active(cfg) and cfg.coin_mode == "private":
         # One threefry block per lane in VMEM instead of the chained
         # fold_in pipeline — switches together with the sampler kernel so

@@ -232,8 +232,10 @@ def _coin_kernel(scal_ref, out_ref):
 #: the round index) for the coin stream.  Reserved words: cf_counts_pallas
 #: uses its raw ``phase`` tag here (rng.PHASE_PROPOSAL=0 / PHASE_VOTE=1),
 #: equiv_counts_pallas additionally uses phase+64 (64/65) for its second
-#: uniform pair; any new stream must pick a word outside {0, 1, 64, 65, 255}.
+#: uniform pair, and the weak-coin kernel uses 254 for its deviation
+#: stream; any new stream must pick a word outside {0, 1, 64, 65, 254, 255}.
 _COIN_SALT = 255
+_COIN_DEV_SALT = 254
 _EQUIV_SALT_OFFSET = 64
 
 
@@ -307,6 +309,59 @@ def _equiv_kernel(m, scal_ref, scal2_ref, c0_ref, c1_ref, cq_ref, ne_ref,
     h0_ref[...] = (h0 + (h_b - bs)).astype(jnp.int32)
     h1_ref[...] = (h1 + bs).astype(jnp.int32)
     hq_ref[...] = hq.astype(jnp.int32)
+
+
+def _weak_coin_kernel(eps, scal_ref, scal2_ref, shared_ref, out_ref):
+    """Weak-common coin lane-tile: private bit + deviation mask fused.
+
+    scal_ref: the _COIN_SALT key (SAME stream as _coin_kernel — the
+    private component is bit-identical to the private-coin kernel);
+    scal2_ref: the _COIN_DEV_SALT key for the deviation uniforms;
+    shared_ref: VMEM int32 [T, 1] — the round's shared coin per trial,
+    drawn on the XLA side (one bit per trial is not kernel work).
+    eps is a trace-time constant."""
+    node, trial = _lane_ids(scal_ref, out_ref.shape)
+    pbits, _ = _threefry2x32(scal_ref[0], scal_ref[1], node, trial)
+    dbits, _ = _threefry2x32(scal2_ref[0], scal2_ref[1], node, trial)
+    private = (pbits & jnp.uint32(1)).astype(jnp.int32)
+    dev = _bits_to_uniform(dbits) < jnp.float32(eps)
+    out_ref[...] = jnp.where(dev, private, shared_ref[...])
+
+
+@functools.partial(jax.jit, static_argnames=("trials", "n_nodes", "eps",
+                                             "interpret"))
+def weak_coin_flips_pallas(base_key: jax.Array, r: jax.Array, trials: int,
+                           n_nodes: int, eps: float,
+                           shared: jax.Array, interpret: bool = False,
+                           node_offset: jax.Array | int = 0,
+                           trial_offset: jax.Array | int = 0) -> jax.Array:
+    """epsilon-weak common coins -> int8 [T, N] (pallas-stream family).
+
+    Drop-in statistical replacement for ops.rng.weak_common_coin_flips on
+    the kernel-accelerated path: the private component shares the
+    private-coin kernel's exact stream, the deviation mask gets its own
+    salt, and ``shared`` is the XLA-side per-trial common bit (int32 [T]).
+    Global-id counters as everywhere: mesh-shape bit-identical."""
+    n_pad = (-n_nodes) % TILE_N
+    np_total = n_nodes + n_pad
+    scal = _stream_scal(base_key, r, _COIN_SALT, node_offset, trial_offset)
+    scal2 = _stream_scal(base_key, r, _COIN_DEV_SALT, node_offset,
+                         trial_offset)
+    out = pl.pallas_call(
+        functools.partial(_weak_coin_kernel, eps),
+        out_shape=jax.ShapeDtypeStruct((trials, np_total), jnp.int32),
+        grid=(np_total // TILE_N,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((trials, 1), lambda j: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((trials, TILE_N), lambda j: (0, j),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(scal, scal2, shared.astype(jnp.int32)[:, None])
+    return out[:, :n_nodes].astype(jnp.int8)
 
 
 @functools.partial(jax.jit,

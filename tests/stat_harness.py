@@ -31,7 +31,9 @@ from benor_tpu.state import FaultSpec, init_state
 def trial_mean_k(n: int, f: int, trials: int, seed: int, *,
                  table_max: int | None = None,
                  use_pallas_hist: bool = False,
-                 fault_model: str = "crash") -> np.ndarray:
+                 fault_model: str = "crash",
+                 coin_mode: str = "private",
+                 coin_eps: float = 0.0) -> np.ndarray:
     """Per-trial mean rounds-to-decide under a forced sampler regime.
 
     ``table_max`` (if given) overrides ``sampling.EXACT_TABLE_MAX`` for the
@@ -55,7 +57,8 @@ def trial_mean_k(n: int, f: int, trials: int, seed: int, *,
         cfg = SimConfig(n_nodes=n, n_faulty=f, trials=trials, max_rounds=64,
                         delivery="quorum", scheduler="uniform",
                         path="histogram", use_pallas_hist=use_pallas_hist,
-                        fault_model=fault_model, seed=seed)
+                        fault_model=fault_model, coin_mode=coin_mode,
+                        coin_eps=coin_eps, seed=seed)
         faults = (FaultSpec.first_f(cfg) if fault_model == "equivocate"
                   else FaultSpec.none(trials, n))
         from benor_tpu.sweep import balanced_inputs

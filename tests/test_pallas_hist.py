@@ -118,6 +118,83 @@ def _equiv_counts(seed, r, phase, hist, ne, m, n, trials=4):
         interpret=True))
 
 
+class TestWeakCoinKernel:
+    """Fused weak-common coin (ops/pallas_hist.py:_weak_coin_kernel)."""
+
+    def _flip(self, eps, seed=3, r=2, trials=16, n=1024, shared=None):
+        import jax.numpy as jnp
+
+        from benor_tpu.ops.pallas_hist import weak_coin_flips_pallas
+        if shared is None:
+            shared = jnp.arange(trials, dtype=jnp.int32) % 2
+        return np.asarray(weak_coin_flips_pallas(
+            jax.random.key(seed), jnp.int32(r), trials, n, eps, shared,
+            interpret=True))
+
+    def test_limits_match_component_streams(self):
+        import jax.numpy as jnp
+
+        from benor_tpu.ops.pallas_hist import coin_flips_pallas
+        shared = jnp.arange(16, dtype=jnp.int32) % 2
+        # eps=1: every lane deviates -> exactly the private-coin kernel
+        a = self._flip(1.0, shared=shared)
+        b = np.asarray(coin_flips_pallas(jax.random.key(3), jnp.int32(2),
+                                         16, 1024, interpret=True))
+        np.testing.assert_array_equal(a, b)
+        # eps=0: no lane deviates -> the shared bit broadcast
+        c = self._flip(0.0, shared=shared)
+        np.testing.assert_array_equal(c, np.asarray(shared)[:, None] *
+                                      np.ones((16, 1024), np.int8))
+
+    def test_deviation_rate_and_streams(self):
+        a = self._flip(0.3)
+        assert np.array_equal(a, self._flip(0.3))            # deterministic
+        assert not np.array_equal(a, self._flip(0.3, r=3))   # round stream
+        # measured deviation rate ~ eps (lanes whose bit != shared bit are
+        # deviators holding the private value != shared: rate eps/2)
+        shared = (np.arange(16) % 2)[:, None]
+        mismatch = (a != shared).mean()
+        assert abs(mismatch - 0.15) < 0.01                   # eps/2 = 0.15
+
+    def test_protocol_ks_vs_xla_weak_coin(self):
+        from stat_harness import trial_mean_k
+        kw = dict(table_max=64, coin_mode="weak_common", coin_eps=0.5)
+        xla = trial_mean_k(750, 255, 128, 321, use_pallas_hist=False, **kw)
+        pallas = trial_mean_k(750, 255, 128, 322, use_pallas_hist=True, **kw)
+        res = st.ks_2samp(xla, pallas)
+        assert res.pvalue > 1e-3, (res.statistic, res.pvalue)
+        sem = np.hypot(xla.std() / len(xla) ** 0.5,
+                       pallas.std() / len(pallas) ** 0.5)
+        assert abs(xla.mean() - pallas.mean()) < 4 * sem + 1e-9
+
+    def test_sharded_bit_identical(self):
+        from benor_tpu.parallel import make_mesh, run_consensus_sharded
+        from benor_tpu.sim import run_consensus
+        from benor_tpu.state import FaultSpec, init_state
+
+        old = sampling.EXACT_TABLE_MAX
+        sampling.EXACT_TABLE_MAX = 8     # CF regime at m=12
+        try:
+            n, f, trials = 16, 4, 8
+            cfg = SimConfig(n_nodes=n, n_faulty=f, trials=trials,
+                            delivery="quorum", scheduler="uniform",
+                            path="histogram", use_pallas_hist=True,
+                            coin_mode="weak_common", coin_eps=0.5, seed=23)
+            no_crash = FaultSpec.none(trials, n)
+            state = init_state(cfg, [i % 2 for i in range(n)], no_crash)
+            key = jax.random.key(23)
+            r1, s1 = run_consensus(cfg, state, no_crash, key)
+            for mesh_shape in ((2, 4), (4, 1)):
+                r2, s2 = run_consensus_sharded(cfg, state, no_crash, key,
+                                               make_mesh(*mesh_shape))
+                assert int(r1) == int(r2), mesh_shape
+                np.testing.assert_array_equal(
+                    np.asarray(s1.x), np.asarray(s2.x),
+                    err_msg=str(mesh_shape))
+        finally:
+            sampling.EXACT_TABLE_MAX = old
+
+
 class TestEquivKernel:
     """Fused equivocate-regime sampler (ops/pallas_hist.py:_equiv_kernel)."""
 
