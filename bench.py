@@ -440,6 +440,67 @@ def _pallas_equiv_check(n: int, trials: int, seed: int) -> dict:
     }
 
 
+def _pallas_weak_coin_check(n: int, trials: int, seed: int) -> dict:
+    """On-chip proof + timing for the fused weak-coin kernel
+    (ops/pallas_hist.py:weak_coin_flips_pallas) vs the XLA three-stream
+    helper, plus the eps-limit identities (private kernel / shared bit)."""
+    import jax
+    import jax.numpy as jnp
+
+    from benor_tpu.ops import rng
+    from benor_tpu.ops.pallas_hist import (coin_flips_pallas,
+                                           weak_coin_flips_pallas)
+
+    interpret = jax.default_backend() == "cpu"
+    eps = 0.5
+    key = jax.random.key(seed)
+    shared = rng.coin_flips(key, jnp.int32(2), rng.ids(trials), rng.ids(1),
+                            common=True)[:, 0]
+    loops = 2 if interpret else 10
+
+    @jax.jit
+    def xla_loop(key):
+        def body(i, acc):
+            c = rng.weak_common_coin_flips(key, i, rng.ids(trials),
+                                           rng.ids(n), eps)
+            return acc + jnp.sum(c[0].astype(jnp.int32))
+        return jax.lax.fori_loop(0, loops, body, jnp.int32(0))
+
+    @jax.jit
+    def pallas_loop(key):
+        def body(i, acc):
+            sh = rng.coin_flips(key, i, rng.ids(trials), rng.ids(1),
+                                common=True)[:, 0]
+            c = weak_coin_flips_pallas(key, i, trials, n, eps, sh,
+                                       interpret=interpret)
+            return acc + jnp.sum(c[0].astype(jnp.int32))
+        return jax.lax.fori_loop(0, loops, body, jnp.int32(0))
+
+    int(xla_loop(key)); int(pallas_loop(key))    # warm-up barriers
+    t0 = time.perf_counter(); int(xla_loop(key))
+    t_xla = (time.perf_counter() - t0) / loops
+    t0 = time.perf_counter(); int(pallas_loop(key))
+    t_pallas = (time.perf_counter() - t0) / loops
+
+    # eps-limit identities on the real lowering
+    a = np.asarray(weak_coin_flips_pallas(key, jnp.int32(2), trials, n, 1.0,
+                                          shared, interpret=interpret))
+    b = np.asarray(coin_flips_pallas(key, jnp.int32(2), trials, n,
+                                     interpret=interpret))
+    np.testing.assert_array_equal(a, b)
+    c0 = np.asarray(weak_coin_flips_pallas(key, jnp.int32(2), trials, n, 0.0,
+                                           shared, interpret=interpret))
+    assert (c0 == np.asarray(shared)[:, None]).all()
+
+    return {
+        "interpret": interpret, "n": n, "trials": trials, "eps": eps,
+        "limits_bit_equal": True,
+        "xla_ms": round(t_xla * 1e3, 3),
+        "pallas_ms": round(t_pallas * 1e3, 3),
+        "speedup": round(t_xla / t_pallas, 3) if t_pallas > 0 else None,
+    }
+
+
 def bench_sweep(platform: str, fallback: bool) -> dict:
     """The north-star workload: multi-regime rounds-vs-f science sweep at
     N=1M (TPU) / 50k (CPU smoke), with hardware-capability accounting."""
@@ -592,6 +653,11 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
     except Exception as e:  # noqa: BLE001
         pallas_equiv = {"error": f"{type(e).__name__}: {e}"}
     log(f"bench: pallas equiv check {pallas_equiv}")
+    try:
+        pallas_wcoin = _pallas_weak_coin_check(n, trials, seed)
+    except Exception as e:  # noqa: BLE001
+        pallas_wcoin = {"error": f"{type(e).__name__}: {e}"}
+    log(f"bench: pallas weak-coin check {pallas_wcoin}")
 
     total_trials = trials * len(regimes)
     log(f"bench: sweep elapsed {elapsed:.2f}s for {total_trials} trials; "
@@ -617,6 +683,7 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
         "pallas_check": pallas,
         "pallas_hist_check": pallas_hist,
         "pallas_equiv_check": pallas_equiv,
+        "pallas_weak_coin_check": pallas_wcoin,
         "pallas_demoted": demoted,
     }
 

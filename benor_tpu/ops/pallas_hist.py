@@ -232,10 +232,10 @@ def _coin_kernel(scal_ref, out_ref):
 #: the round index) for the coin stream.  Reserved words: cf_counts_pallas
 #: uses its raw ``phase`` tag here (rng.PHASE_PROPOSAL=0 / PHASE_VOTE=1),
 #: equiv_counts_pallas additionally uses phase+64 (64/65) for its second
-#: uniform pair, and the weak-coin kernel uses 254 for its deviation
-#: stream; any new stream must pick a word outside {0, 1, 64, 65, 254, 255}.
+#: uniform pair; the weak-coin kernel reuses _COIN_SALT (word 0 = the
+#: private bit, word 1 = its deviation uniform); any new stream must pick
+#: a word outside {0, 1, 64, 65, 255}.
 _COIN_SALT = 255
-_COIN_DEV_SALT = 254
 _EQUIV_SALT_OFFSET = 64
 
 
@@ -311,18 +311,18 @@ def _equiv_kernel(m, scal_ref, scal2_ref, c0_ref, c1_ref, cq_ref, ne_ref,
     hq_ref[...] = hq.astype(jnp.int32)
 
 
-def _weak_coin_kernel(eps, scal_ref, scal2_ref, shared_ref, out_ref):
+def _weak_coin_kernel(eps, scal_ref, shared_ref, out_ref):
     """Weak-common coin lane-tile: private bit + deviation mask fused.
 
-    scal_ref: the _COIN_SALT key (SAME stream as _coin_kernel — the
-    private component is bit-identical to the private-coin kernel);
-    scal2_ref: the _COIN_DEV_SALT key for the deviation uniforms;
+    ONE threefry block per lane serves both streams: word 0 is the private
+    bit (the _COIN_SALT stream — bit-identical to _coin_kernel, which uses
+    word 0 and discards word 1), word 1 the deviation uniform (the block's
+    two output words are independent, cf. _cf_kernel).
     shared_ref: VMEM int32 [T, 1] — the round's shared coin per trial,
     drawn on the XLA side (one bit per trial is not kernel work).
     eps is a trace-time constant."""
     node, trial = _lane_ids(scal_ref, out_ref.shape)
-    pbits, _ = _threefry2x32(scal_ref[0], scal_ref[1], node, trial)
-    dbits, _ = _threefry2x32(scal2_ref[0], scal2_ref[1], node, trial)
+    pbits, dbits = _threefry2x32(scal_ref[0], scal_ref[1], node, trial)
     private = (pbits & jnp.uint32(1)).astype(jnp.int32)
     dev = _bits_to_uniform(dbits) < jnp.float32(eps)
     out_ref[...] = jnp.where(dev, private, shared_ref[...])
@@ -345,14 +345,11 @@ def weak_coin_flips_pallas(base_key: jax.Array, r: jax.Array, trials: int,
     n_pad = (-n_nodes) % TILE_N
     np_total = n_nodes + n_pad
     scal = _stream_scal(base_key, r, _COIN_SALT, node_offset, trial_offset)
-    scal2 = _stream_scal(base_key, r, _COIN_DEV_SALT, node_offset,
-                         trial_offset)
     out = pl.pallas_call(
         functools.partial(_weak_coin_kernel, eps),
         out_shape=jax.ShapeDtypeStruct((trials, np_total), jnp.int32),
         grid=(np_total // TILE_N,),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((trials, 1), lambda j: (0, 0),
                          memory_space=pltpu.VMEM),
@@ -360,7 +357,7 @@ def weak_coin_flips_pallas(base_key: jax.Array, r: jax.Array, trials: int,
         out_specs=pl.BlockSpec((trials, TILE_N), lambda j: (0, j),
                                memory_space=pltpu.VMEM),
         interpret=interpret,
-    )(scal, scal2, shared.astype(jnp.int32)[:, None])
+    )(scal, shared.astype(jnp.int32)[:, None])
     return out[:, :n_nodes].astype(jnp.int8)
 
 
