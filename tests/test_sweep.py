@@ -170,6 +170,34 @@ class TestWeakCommonCoin:
         with pytest.raises(ValueError, match="weak_common"):
             SimConfig(n_nodes=4, n_faulty=0, coin_eps=0.5)
 
+    def test_critical_line_shifts_under_equivocation(self):
+        """Weak coins vs EQUIVOCATING adversaries compose predictably: the
+        adversary ties iff deviating-minority + free pool reach the tie
+        target, so the critical deviation moves to
+        eps*(f) = 1 - 2F/(N-F) — below the crash-free eps* = 1 - f.
+        At N=99, F=21: eps* ~ 0.46; straddle it."""
+        import jax
+
+        from benor_tpu.sim import run_consensus
+        from benor_tpu.state import FaultSpec, init_state
+        from benor_tpu.sweep import balanced_inputs
+
+        n, f, trials = 99, 21, 48
+        for eps, decides in ((0.2, True), (0.8, False)):
+            cfg = SimConfig(n_nodes=n, n_faulty=f, trials=trials,
+                            delivery="quorum", scheduler="adversarial",
+                            fault_model="equivocate",
+                            coin_mode="weak_common", coin_eps=eps,
+                            max_rounds=20, seed=7)
+            faults = FaultSpec.first_f(cfg)
+            state = init_state(cfg, balanced_inputs(trials, n), faults)
+            r, final = run_consensus(cfg, state, faults, jax.random.key(7))
+            dec = np.asarray(final.decided)[:, f:]
+            if decides:
+                assert dec.mean() > 0.95, (eps, dec.mean())
+            else:
+                assert dec.mean() < 0.2, (eps, dec.mean())
+
 
 def test_results_generator_end_to_end(tmp_path):
     """The science-deliverable generator (benor_tpu.results.generate) runs
