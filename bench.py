@@ -233,6 +233,19 @@ def _regimes(n, trials, fracs, max_rounds, seed, use_pallas_hist=False):
         fl = no_crash(cfg)
         regs.append((f"adv_{coin}", cfg, init_state(cfg, bal, fl), fl))
 
+    # weak-coin termination transition: the count adversary ties off the
+    # deviating minority, so eps* = 1 - f (= 0.6 at f = 0.4); one eps
+    # either side, offsets wide enough to stay decisive at the CPU-smoke N
+    f_wk = int(0.4 * n)
+    f_wk += (n - f_wk) % 2    # even quorum: ties need it (cf. adv_* above)
+    for eps in (0.55, 0.65):
+        cfg = SimConfig(scheduler="adversarial", coin_mode="weak_common",
+                        adversary_strength=0.0, coin_eps=eps,
+                        **{**base, "max_rounds": min(12, max_rounds),
+                           "n_faulty": f_wk})
+        fl = no_crash(cfg)
+        regs.append((f"weak_eps{eps}", cfg, init_state(cfg, bal, fl), fl))
+
     # the N > 3F Byzantine bound, one F either side: adversary-controlled
     # equivocators vs the common coin.  sub (3F < N) must decide; super
     # (3F > N) must livelock even with the common coin (the impossibility).
@@ -632,6 +645,11 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
         "sub_3f_decided": eq.get("equiv_3f_sub", {}).get("decided"),
         "super_3f_decided": eq.get("equiv_3f_super", {}).get("decided"),
     }
+    wk = {r["regime"]: r for r in curve if r["regime"].startswith("weak_")}
+    weak_coin_transition = {
+        "below_eps_star_decided": wk.get("weak_eps0.55", {}).get("decided"),
+        "above_eps_star_decided": wk.get("weak_eps0.65", {}).get("decided"),
+    }
 
     hbm_gbps = total_bytes / elapsed / 1e9 if total_bytes else None
     peak = _hbm_peak_for(dev.device_kind)
@@ -680,6 +698,7 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
         "curve_mean_k_spread": curve_spread,
         "coin_contrast": coin_contrast,
         "equiv_threshold": equiv_threshold,
+        "weak_coin_transition": weak_coin_transition,
         "pallas_check": pallas,
         "pallas_hist_check": pallas_hist,
         "pallas_equiv_check": pallas_equiv,
