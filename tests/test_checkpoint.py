@@ -56,6 +56,39 @@ def test_resume_bit_identical(tmp_path):
                                   np.asarray(final_full.killed))
 
 
+def test_resume_bit_identical_new_streams(tmp_path):
+    """The resume guarantee must hold for EVERY random stream: the
+    equivocate fault plane (per-edge bits / mixed-population sampler) and
+    the weak-common coin (shared + deviation + private) are all keyed on
+    (key, round, phase, global ids) — never loop history — so cut+resume
+    stays bit-identical with both engaged."""
+    from benor_tpu.sweep import balanced_inputs
+
+    n, f = 96, 36
+    cfg = SimConfig(n_nodes=n, n_faulty=f, trials=16, max_rounds=48,
+                    delivery="quorum", scheduler="uniform",
+                    path="histogram", fault_model="equivocate",
+                    coin_mode="weak_common", coin_eps=0.5, seed=9)
+    faults = FaultSpec.first_f(cfg)
+    state = init_state(cfg, balanced_inputs(16, n), faults)
+    base_key = jax.random.key(cfg.seed)
+
+    rounds_full, final_full = run_consensus(cfg, state, faults, base_key)
+    assert int(rounds_full) >= 3, "config must take several rounds"
+
+    cfg_cap = cfg.replace(max_rounds=2)
+    rounds_cap, mid = run_consensus(cfg_cap, state, faults, base_key)
+    path = str(tmp_path / "ckpt.npz")
+    save_checkpoint(path, cfg, mid, faults, next_round=int(rounds_cap) + 1)
+
+    rounds_res, final_res, _ = resume_from(path)
+    assert int(rounds_res) == int(rounds_full)
+    for leaf in ("x", "decided", "k", "killed"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(final_res, leaf)),
+            np.asarray(getattr(final_full, leaf)), err_msg=leaf)
+
+
 def test_resume_on_mesh_bit_identical(tmp_path):
     """A single-device checkpoint resumes on a device mesh (and the result
     is bit-identical to the uninterrupted single-device run): checkpoints
