@@ -6,7 +6,11 @@ the multi-chip sharding paths (parallel/) are exercised without hardware.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# HARD set (not setdefault): the ambient environment ships
+# JAX_PLATFORMS=axon, and the CLI's _honor_platform_env re-asserts the env
+# value — a setdefault would let an isolated CLI test re-select the axon
+# backend and hang on an unreachable chip (test runs must never need TPU).
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -272,6 +272,27 @@ class TestCli:
                      "--trials", "16", "--out", out]) == 0
         assert len(json.load(open(out))) == 2
 
+    def test_results_cli_arg_plumbing(self, monkeypatch):
+        """`results` flags reach the generator verbatim (the real generator
+        runs in test_results_generator_end_to_end; here only the argparse
+        plumbing is under test)."""
+        import benor_tpu.results as results_mod
+        from benor_tpu.__main__ import main
+        called = {}
+        monkeypatch.setattr(results_mod, "generate",
+                            lambda **kw: called.update(kw))
+        assert main(["results", "--out", "X", "--n", "123",
+                     "--trials", "4", "--no-presets"]) == 0
+        assert called == {"out_dir": "X", "n_large": 123,
+                          "trials_large": 4, "seed": 0, "presets": False}
+
+    def test_coins_cli_weak_rows(self, capsys):
+        from benor_tpu.__main__ import main
+        assert main(["coins", "--n", "20", "--f", "6", "--trials", "8",
+                     "--max-rounds", "8", "--eps", "0.1"]) == 0
+        out = capsys.readouterr().out
+        assert "weak_common(eps=0.1):" in out
+
     def test_sweep_cli_balanced(self, tmp_path, capsys):
         """--balanced: zero crashes + balanced inputs (the science regime);
         points carry the disagree_frac field."""
