@@ -47,19 +47,12 @@ from __future__ import annotations
 
 import json
 import os
-import subprocess
 import sys
 import time
 
 import numpy as np
 
 HERE = os.path.dirname(os.path.abspath(__file__))
-
-#: The backend probe runs in a THROWAWAY subprocess because the axon TPU
-#: plugin's failure modes include both a fast UNAVAILABLE raise (BENCH_r01)
-#: and an indefinite hang at backend init (observed round 2) — a hang in the
-#: main process would make the whole bench rc-timeout with no JSON line.
-_PROBE_CODE = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
 
 
 def log(*a):
@@ -70,50 +63,31 @@ def emit(obj) -> None:
     print(json.dumps(obj), flush=True)
 
 
-def probe_backend(timeout_s: float) -> str | None:
-    """Initialize the ambient JAX backend in a subprocess; return its
-    platform name ('tpu'/'axon'/'cpu'/...), or None on failure/timeout."""
-    try:
-        r = subprocess.run([sys.executable, "-c", _PROBE_CODE],
-                           capture_output=True, text=True,
-                           timeout=timeout_s, cwd=HERE)
-    except subprocess.TimeoutExpired:
-        log(f"bench: backend probe timed out after {timeout_s:.0f}s")
-        return None
-    if r.returncode != 0:
-        tail = (r.stderr or "").strip().splitlines()[-1:]
-        log(f"bench: backend probe failed rc={r.returncode} {tail}")
-        return None
-    for line in r.stdout.splitlines():
-        if line.startswith("PLATFORM="):
-            return line.split("=", 1)[1].strip()
-    return None
-
-
 def acquire_platform() -> tuple[str, bool]:
     """Pick the platform to measure on -> (platform, is_fallback).
 
     BENCH_ALLOW_CPU=1 forces a CPU smoke run.  Otherwise: probe the ambient
-    (TPU) backend with retries + backoff; if it never comes up, fall back to
-    CPU rather than producing no number at all (the fallback is labeled in
-    the output JSON so the artifact stays honest).
+    (TPU) backend in a THROWAWAY subprocess (the axon plugin's failure
+    modes include both a fast UNAVAILABLE raise — BENCH_r01 — and an
+    indefinite hang at backend init — round 2; a hang in the main process
+    would make the whole bench rc-timeout with no JSON line) with retries +
+    backoff via the shared helper (benor_tpu/utils/backend.py); if it never
+    comes up, fall back to CPU rather than producing no number at all (the
+    fallback is labeled in the output JSON so the artifact stays honest).
     """
+    from benor_tpu.utils.backend import probe_with_retries
+
     if os.environ.get("BENCH_ALLOW_CPU") == "1":
         return "cpu", False
     retries = int(os.environ.get("BENCH_INIT_RETRIES", 3))
     timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT", 150))
-    for attempt in range(retries):
-        plat = probe_backend(timeout_s)
-        if plat and plat != "cpu":
-            return plat, False
-        if plat == "cpu":  # no accelerator plugged in at all
-            log("bench: ambient backend is CPU (no TPU present)")
-            return "cpu", True
-        if attempt < retries - 1:   # no point sleeping after the last probe
-            backoff = 15.0 * (attempt + 1)
-            log(f"bench: TPU backend unavailable "
-                f"(attempt {attempt + 1}/{retries}); retry in {backoff:.0f}s")
-            time.sleep(backoff)
+    plat = probe_with_retries(retries, timeout_s, backoff_s=15.0,
+                              log=lambda s: log(f"bench: {s}"), cwd=HERE)
+    if plat and plat != "cpu":
+        return plat, False
+    if plat == "cpu":  # no accelerator plugged in at all
+        log("bench: ambient backend is CPU (no TPU present)")
+        return "cpu", True
     log("bench: TPU never came up; falling back to CPU smoke run")
     return "cpu", True
 

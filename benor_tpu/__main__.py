@@ -31,6 +31,39 @@ def _honor_platform_env() -> None:
         jax.config.update("jax_platforms", plat)
 
 
+#: Set when the accelerator was unreachable and the run fell back to CPU;
+#: subcommands weave it into their own output (a bare stdout line here
+#: would corrupt machine-readable outputs like _preset's pure JSON).
+FELL_BACK = False
+
+
+def _ensure_live_backend(retries: int = 2, timeout_s: float = 120.0) -> None:
+    """Never hang a CLI run on an unreachable chip.
+
+    The AXON plugin's specific failure mode is an INDEFINITE hang at
+    backend init — so the guard engages only when that plugin is selected
+    (any other platform, including a plain TPU machine or an explicit cpu
+    pin, skips the probe and pays zero overhead).  Probes via the shared
+    helper (the same machinery bench.py's acquire_platform uses, with a
+    shorter interactive budget — 2 x 120 s covers the known slow-init
+    window) and falls back to CPU if the chip never comes up."""
+    global FELL_BACK
+    from .utils.backend import probe_with_retries
+
+    if "axon" not in os.environ.get("JAX_PLATFORMS", "").strip().lower():
+        return
+    plat = probe_with_retries(
+        retries, timeout_s, backoff_s=10.0,
+        log=lambda s: print(f"probe: {s}", file=sys.stderr, flush=True))
+    if plat:
+        return                          # backend is live
+    print("warning: accelerator backend unreachable; falling back to CPU",
+          file=sys.stderr, flush=True)
+    FELL_BACK = True
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
 def _demo(args) -> int:
     from .api import get_nodes_state, launch_network, start_consensus
     n, f = args.n, args.f
@@ -57,9 +90,10 @@ def _sweep(args) -> int:
                     scheduler=args.scheduler, coin_mode=args.coin,
                     fault_model=args.fault_model, seed=args.seed)
     mode = "balanced/no-crash" if args.balanced else "iid/crash"
+    fb = " [cpu fallback]" if FELL_BACK else ""
     print(f"rounds-vs-f sweep: N={args.n}, trials={args.trials}, "
           f"scheduler={args.scheduler}, coin={args.coin}, "
-          f"faults={args.fault_model}, inputs={mode}")
+          f"faults={args.fault_model}, inputs={mode}{fb}")
     if args.balanced:
         # the science regime: balanced inputs, F purely a protocol
         # parameter (crash-pinned faults make every tally the deterministic
@@ -129,7 +163,10 @@ def _preset(args) -> int:
               f"{sorted(cfgs)}", file=sys.stderr)
         return 1
     pt = run_point(cfgs[args.name])
-    print(json.dumps(pt.to_dict(), indent=1))
+    d = pt.to_dict()
+    if FELL_BACK:
+        d["platform_fallback"] = "cpu"   # keep the JSON honest AND valid
+    print(json.dumps(d, indent=1))
     return 0
 
 
@@ -195,6 +232,10 @@ def main(argv=None) -> int:
         argv = ["demo"] + argv
     args = ap.parse_args(argv)
     _honor_platform_env()
+    # the event-loop oracle backends never touch a JAX backend — don't
+    # spend a probe (or a fallback) on them
+    if not (args.cmd == "demo" and args.backend in ("express", "native")):
+        _ensure_live_backend()
     return {"demo": _demo, "sweep": _sweep, "coins": _coins,
             "preset": _preset, "results": _results}[args.cmd](args)
 

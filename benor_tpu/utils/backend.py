@@ -1,0 +1,65 @@
+"""Ambient-accelerator probing shared by bench.py and the CLI.
+
+The axon TPU plugin has two known failure modes (observed across rounds —
+see bench.py's round-1/round-2 postmortems): a fast ``UNAVAILABLE`` raise
+at client creation, and an INDEFINITE hang at backend init when the chip
+is unreachable.  Both make "just import jax and try" unusable for anything
+that must not wedge the caller, so the probe runs in a THROWAWAY
+subprocess with a timeout.  One implementation, used by bench.py's
+acquire_platform (3 x 150 s, backoff — the artifact path can afford
+patience) and the CLI's _ensure_live_backend (2 x 120 s — interactive).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+from typing import Callable, Optional
+
+#: Probe payload: initializes the ambient backend and reports its platform.
+PROBE_CODE = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
+
+
+def probe_backend(timeout_s: float,
+                  log: Optional[Callable[[str], None]] = None,
+                  cwd: Optional[str] = None) -> Optional[str]:
+    """Initialize the ambient JAX backend in a subprocess; return its
+    platform name ('tpu'/'axon'/'cpu'/...), or None on failure/timeout.
+    ``log`` receives one diagnostic line on failure (rc + stderr tail, or
+    the timeout)."""
+    say = log or (lambda s: None)
+    try:
+        r = subprocess.run([sys.executable, "-c", PROBE_CODE],
+                           capture_output=True, text=True,
+                           timeout=timeout_s, cwd=cwd)
+    except subprocess.TimeoutExpired:
+        say(f"backend probe timed out after {timeout_s:.0f}s")
+        return None
+    if r.returncode != 0:
+        tail = (r.stderr or "").strip().splitlines()[-1:]
+        say(f"backend probe failed rc={r.returncode} {tail}")
+        return None
+    for line in r.stdout.splitlines():
+        if line.startswith("PLATFORM="):
+            return line.split("=", 1)[1].strip()
+    return None
+
+
+def probe_with_retries(retries: int, timeout_s: float, backoff_s: float,
+                       log: Optional[Callable[[str], None]] = None,
+                       cwd: Optional[str] = None) -> Optional[str]:
+    """probe_backend with retry + linear backoff; returns the first
+    non-cpu platform seen, 'cpu' immediately if that IS the ambient
+    backend, or None if the accelerator never comes up."""
+    for attempt in range(retries):
+        plat = probe_backend(timeout_s, log=log, cwd=cwd)
+        if plat:
+            return plat
+        if attempt < retries - 1:
+            wait = backoff_s * (attempt + 1)
+            if log:
+                log(f"backend unavailable (attempt {attempt + 1}/"
+                    f"{retries}); retry in {wait:.0f}s")
+            time.sleep(wait)
+    return None

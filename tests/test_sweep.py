@@ -286,6 +286,45 @@ class TestCli:
         assert called == {"out_dir": "X", "n_large": 123,
                           "trials_large": 4, "seed": 0, "presets": False}
 
+    def test_ensure_live_backend_falls_back_on_hang(self, monkeypatch,
+                                                    capsys):
+        """The axon plugin hangs indefinitely when the chip is
+        unreachable; the CLI probes via the shared helper and pins CPU on
+        failure instead of hanging the user's terminal — announcing the
+        fallback on stdout so captured output stays honest."""
+        import benor_tpu.utils.backend as backend_mod
+
+        import benor_tpu.__main__ as cli
+
+        monkeypatch.setenv("JAX_PLATFORMS", "axon")
+        monkeypatch.setattr(backend_mod, "probe_with_retries",
+                            lambda *a, **kw: None)
+        monkeypatch.setattr(cli, "FELL_BACK", False)
+        calls = []
+        import jax
+        monkeypatch.setattr(jax.config, "update",
+                            lambda *a: calls.append(a))
+        cli._ensure_live_backend(retries=1, timeout_s=1)
+        assert calls == [("jax_platforms", "cpu")]
+        assert cli.FELL_BACK
+        out = capsys.readouterr()
+        assert "falling back to CPU" in out.err
+        assert out.out == ""       # stdout stays clean (JSON subcommands)
+        # live backend: probe succeeds, nothing overridden
+        monkeypatch.setattr(backend_mod, "probe_with_retries",
+                            lambda *a, **kw: "axon")
+        calls.clear()
+        cli._ensure_live_backend(retries=1, timeout_s=1)
+        assert calls == []
+        # non-axon platforms skip the probe entirely (the hang-at-init
+        # failure mode is axon-specific; a healthy TPU pays no overhead)
+        monkeypatch.setattr(backend_mod, "probe_with_retries",
+                            lambda *a, **kw: pytest.fail("probed"))
+        for plat in ("cpu", "tpu", ""):
+            monkeypatch.setenv("JAX_PLATFORMS", plat)
+            cli._ensure_live_backend(retries=1, timeout_s=1)
+        assert calls == []
+
     def test_coins_cli_weak_rows(self, capsys):
         from benor_tpu.__main__ import main
         assert main(["coins", "--n", "20", "--f", "6", "--trials", "8",
