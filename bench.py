@@ -497,8 +497,10 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
     from benor_tpu.sweep import summarize_final
 
     on_cpu = platform == "cpu"
-    n = int(os.environ.get("BENCH_N", 50_000 if on_cpu else 1_000_000))
-    trials = int(os.environ.get("BENCH_TRIALS", 8 if on_cpu else 32))
+    from benor_tpu.utils.backend import default_scale
+    dn, dt = default_scale(on_cpu)
+    n = int(os.environ.get("BENCH_N", dn))
+    trials = int(os.environ.get("BENCH_TRIALS", dt))
     reps = int(os.environ.get("BENCH_REPS", 2 if on_cpu else 8))
     fracs = [float(x) for x in os.environ.get(
         "BENCH_F_FRACS", "0.10,0.25,0.35,0.40,0.45").split(",")]
@@ -749,7 +751,8 @@ def _labels(mode: str, platform: str) -> tuple[str, str]:
     if mode == "pallas":
         return "pallas_dense_tally_speedup", "x_vs_xla_einsum"
     on_cpu = platform == "cpu"
-    n = int(os.environ.get("BENCH_N", 50_000 if on_cpu else 1_000_000))
+    from benor_tpu.utils.backend import default_scale
+    n = int(os.environ.get("BENCH_N", default_scale(on_cpu)[0]))
     metric = ("mc_trials_per_sec_n1e6" if n == 1_000_000
               else f"mc_trials_per_sec_n{n}")
     return metric, "trials/s"

@@ -150,7 +150,23 @@ def _coins(args) -> int:
 
 def _results(args) -> int:
     from .results import generate
-    generate(out_dir=args.out, n_large=args.n, trials_large=args.trials,
+    from .utils.backend import default_scale
+    n, trials = args.n, args.trials
+    if n is None or trials is None:
+        # mirror bench.py's platform-aware defaults (shared constants in
+        # utils/backend.py): the full N=1M x 32-trial study set is a TPU
+        # workload; a CPU run (explicit pin or unreachable-accelerator
+        # fallback) gets the same studies at smoke scale
+        import jax
+        on_cpu = FELL_BACK or jax.default_backend() == "cpu"
+        dn, dt = default_scale(on_cpu)
+        n = dn if n is None else n
+        trials = dt if trials is None else trials
+        if on_cpu:
+            print(f"results: CPU backend — defaulting to N={dn:,}, "
+                  f"trials={dt} (pass --n/--trials to override)",
+                  flush=True)
+    generate(out_dir=args.out, n_large=n, trials_large=trials,
              seed=args.seed, presets=not args.no_presets)
     return 0
 
@@ -219,8 +235,11 @@ def main(argv=None) -> int:
     r = sub.add_parser("results",
                        help="generate RESULTS/ (curves + presets artifact)")
     r.add_argument("--out", default="RESULTS")
-    r.add_argument("--n", type=int, default=1_000_000)
-    r.add_argument("--trials", type=int, default=32)
+    r.add_argument("--n", type=int, default=None,
+                   help="study size (default: 1M on accelerator, 50k on "
+                        "CPU so a fallback run stays tractable)")
+    r.add_argument("--trials", type=int, default=None,
+                   help="MC trials (default: 32 on accelerator, 8 on CPU)")
     r.add_argument("--seed", type=int, default=0)
     r.add_argument("--no-presets", action="store_true",
                    help="skip the BASELINE presets (quick smoke)")

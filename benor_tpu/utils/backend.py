@@ -20,6 +20,17 @@ from typing import Callable, Optional
 #: Probe payload: initializes the ambient backend and reports its platform.
 PROBE_CODE = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
 
+#: Default (N, trials) for the flagship workloads at full accelerator
+#: scale vs the CPU smoke scale — ONE definition shared by bench.py and
+#: the results CLI so their platform-aware defaults cannot drift.
+FULL_SCALE = (1_000_000, 32)
+SMOKE_SCALE = (50_000, 8)
+
+
+def default_scale(on_cpu: bool) -> tuple[int, int]:
+    """(n_nodes, trials) defaults for the platform class."""
+    return SMOKE_SCALE if on_cpu else FULL_SCALE
+
 
 def probe_backend(timeout_s: float,
                   log: Optional[Callable[[str], None]] = None,

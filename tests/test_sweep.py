@@ -285,6 +285,11 @@ class TestCli:
                      "--trials", "4", "--no-presets"]) == 0
         assert called == {"out_dir": "X", "n_large": 123,
                           "trials_large": 4, "seed": 0, "presets": False}
+        # no --n/--trials on a CPU backend: the platform-aware smoke
+        # defaults (shared constants with bench.py)
+        called.clear()
+        assert main(["results", "--no-presets"]) == 0
+        assert called["n_large"] == 50_000 and called["trials_large"] == 8
 
     def test_ensure_live_backend_falls_back_on_hang(self, monkeypatch,
                                                     capsys):
