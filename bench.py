@@ -13,6 +13,12 @@ On unrecoverable failure the line carries value 0.0 and an "error" field —
 never a bare traceback / non-zero exit (round-1 BENCH_r01.json was rc=1 with
 parsed: null; this file's whole job is to make that impossible).
 
+The stdout line is the COMPACT headline only (~1 KB: metric, gates, one
+speedup number per pallas kernel) because the driver keeps just the last
+2,000 chars of stdout — round 3's ~4 KB line truncated the head fields and
+parsed: null happened anyway.  The full record (per-regime curve + complete
+kernel-check blobs) goes to the `BENCH_DETAIL.json` sidecar and stderr.
+
 vs_baseline > 1.0 means the full rounds-vs-f sweep finished inside the
 60-second north-star budget (the reference itself publishes no numbers and
 tops out at N=10 nodes on localhost HTTP — see BASELINE.md).
@@ -61,6 +67,44 @@ def log(*a):
 
 def emit(obj) -> None:
     print(json.dumps(obj), flush=True)
+
+
+#: Fields moved OFF the stdout headline into the sidecar + stderr.  The
+#: driver records only the last 2,000 chars of stdout; round 3's line grew
+#: to ~4 KB (curve + four embedded pallas-check blobs) and the tail lost the
+#: head fields, leaving `parsed: null` — no headline number in the artifact.
+_DETAIL_KEYS = ("curve", "pallas_check", "pallas_hist_check",
+                "pallas_equiv_check", "pallas_weak_coin_check",
+                "pallas_demoted")
+
+
+def _split_headline(out: dict) -> tuple[dict, dict]:
+    """(headline, detail): headline is the ONE compact stdout line (science
+    gates + a one-number-per-kernel pallas summary, ~1 KB); detail carries
+    the full curve and check blobs for the sidecar file."""
+    detail = {k: out[k] for k in _DETAIL_KEYS if k in out}
+    head = {k: v for k, v in out.items() if k not in _DETAIL_KEYS}
+    kernels = {}
+    interpret = None
+    for short, key in (("dense", "pallas_check"), ("hist", "pallas_hist_check"),
+                       ("equiv", "pallas_equiv_check"),
+                       ("wcoin", "pallas_weak_coin_check")):
+        c = out.get(key)
+        if not isinstance(c, dict):
+            continue
+        if "error" in c:
+            kernels[short] = "ERR"
+        else:
+            kernels[short] = c.get("speedup")
+            if c.get("interpret") is not None:
+                interpret = bool(c["interpret"]) if interpret is None \
+                    else (interpret or bool(c["interpret"]))
+    head["pallas_speedups"] = kernels
+    head["pallas_interpret"] = interpret
+    head["n_regimes"] = len(out.get("curve", []))
+    head["pallas_demoted_n"] = len(out.get("pallas_demoted", []))
+    head["detail_file"] = "BENCH_DETAIL.json"
+    return head, detail
 
 
 def acquire_platform() -> tuple[str, bool]:
@@ -219,6 +263,19 @@ def _regimes(n, trials, fracs, max_rounds, seed, use_pallas_hist=False):
                            "n_faulty": f_wk})
         fl = no_crash(cfg)
         regs.append((f"weak_eps{eps}", cfg, init_state(cfg, bal, fl), fl))
+
+    # the targeted (partitioned) adversary's 0/1 safety curve, one point
+    # each side of the f = 1/2 boundary: below it agreement is violated
+    # outright (disagree = 1), above it the decide bar is unreachable
+    f_tg = int(0.25 * n)
+    f_tg += (n - f_tg) % 2    # even quorum: the "?"-manufacturing needs it
+    for name, f, cap in (("targeted_f0.25", f_tg, 16),
+                         ("targeted_f0.50", n // 2 + 1, 12)):
+        cfg = SimConfig(scheduler="targeted",
+                        **{**base, "max_rounds": min(cap, max_rounds),
+                           "n_faulty": f, "use_pallas_hist": False})
+        fl = no_crash(cfg)
+        regs.append((name, cfg, init_state(cfg, bal, fl), fl))
 
     # the N > 3F Byzantine bound, one F either side: adversary-controlled
     # equivocators vs the common coin.  sub (3F < N) must decide; super
@@ -626,6 +683,12 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
         "below_eps_star_decided": wk.get("weak_eps0.55", {}).get("decided"),
         "above_eps_star_decided": wk.get("weak_eps0.65", {}).get("decided"),
     }
+    tg = {r["regime"]: r for r in curve if r["regime"].startswith("targeted_")}
+    safety_violation = {
+        "below_half_disagree": tg.get("targeted_f0.25",
+                                      {}).get("disagree_frac"),
+        "past_half_decided": tg.get("targeted_f0.50", {}).get("decided"),
+    }
 
     hbm_gbps = total_bytes / elapsed / 1e9 if total_bytes else None
     peak = _hbm_peak_for(dev.device_kind)
@@ -675,6 +738,7 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
         "coin_contrast": coin_contrast,
         "equiv_threshold": equiv_threshold,
         "weak_coin_transition": weak_coin_transition,
+        "safety_violation": safety_violation,
         "pallas_check": pallas,
         "pallas_hist_check": pallas_hist,
         "pallas_equiv_check": pallas_equiv,
@@ -779,6 +843,17 @@ def main() -> None:
             "fallback_cpu": fallback,
             "error": f"{type(e).__name__}: {e}",
         }
+    if any(k in out for k in _DETAIL_KEYS):
+        headline, detail = _split_headline(out)
+        detail_path = os.path.join(HERE, "BENCH_DETAIL.json")
+        try:
+            with open(detail_path, "w") as fh:
+                json.dump({**headline, **detail}, fh, indent=1)
+            log(f"bench: full detail (curve + kernel checks) -> {detail_path}")
+        except OSError as e:  # noqa: BLE001 — sidecar is best-effort
+            log(f"bench: could not write sidecar {detail_path}: {e}")
+        log("bench: detail json: " + json.dumps(detail))
+        out = headline
     emit(out)
 
 

@@ -50,8 +50,17 @@ def _ensure_live_backend(retries: int = 2, timeout_s: float = 120.0) -> None:
     global FELL_BACK
     from .utils.backend import probe_with_retries
 
-    if "axon" not in os.environ.get("JAX_PLATFORMS", "").strip().lower():
-        return
+    plat_env = os.environ.get("JAX_PLATFORMS", "").strip().lower()
+    if "axon" not in plat_env:
+        if plat_env:
+            return              # an explicit non-axon pin (cpu, tpu, ...)
+        # Env unset: the axon plugin self-registers as the ambient default
+        # backend when installed, so the hang-at-init risk is identical to
+        # an explicit JAX_PLATFORMS=axon.  find_spec does not import the
+        # plugin (importing is what can hang).
+        import importlib.util
+        if importlib.util.find_spec("axon") is None:
+            return
     plat = probe_with_retries(
         retries, timeout_s, backoff_s=10.0,
         log=lambda s: print(f"probe: {s}", file=sys.stderr, flush=True))

@@ -11,9 +11,13 @@ node, backed by EITHER network backend's device/oracle state:
 
 Semantics notes:
   * The reference runs consensus *concurrently* with polling; here the
-    first /start on any node runs the whole network to termination (the
-    compiled while-loop), so pollers observe the final snapshot — the same
-    fixed point the reference's pollers converge to.
+    first /start on any node runs the network to termination (the compiled
+    while-loop), so by default pollers observe the final snapshot — the
+    same fixed point the reference's pollers converge to.  With
+    ``SimConfig(poll_rounds=c)`` the loop runs in c-round slices and the
+    snapshot is republished between slices: /getState (served on its own
+    thread) then observes a live undecided network with growing k, the
+    reference's poll-during-run contract (benorconsensus.test.ts:149-160).
   * /stop kills only the receiving node (consensus.ts fans /stop out to all
     ports to stop the network, and so does ``stop_all``).
   * POST /message (node.ts:43-163) answers 405 with an explanation: peer

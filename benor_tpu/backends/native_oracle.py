@@ -61,8 +61,64 @@ def load_library() -> ctypes.CDLL:
             np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
             np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),  # in/out
         ]
+        lib.benor_express_run_batch.restype = ctypes.c_int64
+        lib.benor_express_run_batch.argtypes = [
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,   # n, f, max_r
+            np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS"),  # seeds
+            ctypes.c_int64, ctypes.c_int64,                   # n_seeds, cap
+            ctypes.c_uint8,                                   # order
+            np.ctypeslib.ndpointer(np.int8, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int8, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        ]
         _lib = lib
         return lib
+
+
+def run_batch(cfg, initial_values, faulty_list, seeds,
+              step_cap: Optional[int] = None) -> dict:
+    """Run the native oracle over an [S] seed vector in ONE ctypes call.
+
+    Same scenario for every seed (values/faulty as in launch_network);
+    ``cfg.oracle_order`` picks fifo/shuffle delivery.  Returns a dict of
+    numpy arrays: x int8 [S, N] (faulty lanes hold -1), decided bool
+    [S, N], k int32 [S, N] (faulty lanes -1), killed bool [S, N], steps
+    int64 [S] (-1 where the per-seed step cap tripped).
+
+    This is the engine of the oracle<->scheduler DISTRIBUTION-parity
+    study (r3 VERDICT items 4+7): ~10^3 rounds-to-decide samples cost one
+    library call at ~1e8 delivered messages/s instead of 10^3 Python
+    round-trips.
+    """
+    n, f = cfg.n_nodes, cfg.n_faulty
+    if len(initial_values) != len(faulty_list) or n != len(initial_values):
+        raise ValueError("Arrays don't match")
+    if sum(bool(b) for b in faulty_list) != f:
+        raise ValueError("faultyList doesnt have F faulties")
+    seeds = np.ascontiguousarray(seeds, np.uint32)
+    s = len(seeds)
+    cap = step_cap if step_cap is not None else \
+        max(500_000, 20 * n * n * cfg.max_rounds)
+    vals = np.asarray([2 if v == "?" else int(v) for v in initial_values],
+                      np.int8)
+    faulty = np.asarray(faulty_list, bool).astype(np.uint8)
+    out_x = np.empty((s, n), np.int8)
+    out_dec = np.empty((s, n), np.uint8)
+    out_k = np.empty((s, n), np.int32)
+    out_killed = np.empty((s, n), np.uint8)
+    out_steps = np.empty(s, np.int64)
+    lib = load_library()
+    lib.benor_express_run_batch(
+        n, f, cfg.max_rounds, seeds, s, cap,
+        1 if cfg.oracle_order == "shuffle" else 0,
+        vals, faulty, out_x.reshape(-1), out_dec.reshape(-1),
+        out_k.reshape(-1), out_killed.reshape(-1), out_steps)
+    return {"x": out_x, "decided": out_dec.astype(bool), "k": out_k,
+            "killed": out_killed.astype(bool), "steps": out_steps}
 
 
 def native_available() -> bool:

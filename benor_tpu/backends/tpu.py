@@ -9,8 +9,12 @@ HTTP routes of src/nodes/node.ts served from [trials, N] tensors:
   /getState -> get_state(i)     node.ts:197-199
 
 ``start()`` runs the whole consensus to termination (or the round cap) as
-one compiled while-loop — the poll-until-finality loop of the reference's
-tests (benorconsensus.test.ts:149-160) observes an already-final snapshot.
+one compiled while-loop by default — the poll-until-finality loop of the
+reference's tests (benorconsensus.test.ts:149-160) then observes an
+already-final snapshot.  ``SimConfig(poll_rounds=c)`` instead steps the
+loop in compiled c-round slices, republishing the snapshot between slices,
+so pollers observe a live undecided network with growing k (the
+reference's mid-run observability), with bit-identical final state.
 """
 
 from __future__ import annotations
@@ -49,7 +53,20 @@ class TpuNetwork:
         return ("faulty", 500) if killed else ("live", 200)
 
     # -- /start (consensus.ts:3-8 -> node.ts:167-188) --------------------
-    def start(self) -> None:
+    def start(self, on_slice=None) -> None:
+        """Run consensus to termination (or the round cap).
+
+        With ``cfg.poll_rounds > 0`` the compiled loop is stepped in slices
+        of that many rounds and ``self.state`` is republished after every
+        slice, so concurrent readers (the HTTP /getState route runs on its
+        own thread) observe a live, still-undecided network with growing k —
+        the reference's poll-during-run contract
+        (benorconsensus.test.ts:149-160).  ``on_slice`` (optional callable,
+        no args) fires after each snapshot publish; tests use it for
+        deterministic mid-run observation without thread races.  Final
+        state and rounds_executed are bit-identical to the one-shot path
+        (sim.run_consensus_slice docstring; pinned in tests).
+        """
         if self._started:
             return
         base_key = jax.random.key(self.cfg.seed)
@@ -58,11 +75,33 @@ class TpuNetwork:
             mesh = make_mesh(*self.cfg.mesh_shape)
             rounds, final = run_consensus_sharded(
                 self.cfg, self.state, self.faults, base_key, mesh)
+            self.rounds_executed = int(rounds)
+            self.state = final
+        elif self.cfg.poll_rounds > 0:
+            from ..models.benor import all_settled
+            from ..sim import run_consensus_slice, start_state
+            import jax.numpy as jnp
+            state = start_state(self.cfg, self.state)
+            self.state = state               # k=1 visible (node.ts:172)
+            r = 1
+            while True:
+                r_next, state = run_consensus_slice(
+                    self.cfg, state, self.faults, base_key,
+                    jnp.int32(r), jnp.int32(r + self.cfg.poll_rounds))
+                self.state = state           # publish the live snapshot
+                if on_slice is not None:
+                    on_slice()
+                rn = int(r_next)             # host sync: slice completed
+                if (rn == r or rn > self.cfg.max_rounds
+                        or bool(np.asarray(all_settled(state)))):
+                    break
+                r = rn
+            self.rounds_executed = rn - 1
         else:
             rounds, final = run_consensus(self.cfg, self.state, self.faults,
                                           base_key)
-        self.rounds_executed = int(rounds)
-        self.state = final
+            self.rounds_executed = int(rounds)
+            self.state = final
         self._started = True
 
     # -- /stop (consensus.ts:10-15 -> node.ts:191-194) -------------------

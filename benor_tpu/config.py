@@ -76,7 +76,28 @@ class SimConfig:
     #                exact strict priority at strength >= 1 and the
     #                uniform-race model (ops/sampling.py) at 0 < s < 1.
     # 'adversarial': worst-case count-controlling adversary — forces tied
-    #                0/1 tallies at every receiver (both paths)
+    #                0/1 tallies at every receiver (both paths); attacks
+    #                TERMINATION (livelock under private coins)
+    # 'targeted':    partitioned count-controlling adversary — attacks
+    #                AGREEMENT directly (the true worst case of the
+    #                node.ts:52,88 "first N-F arrivals" nondeterminism:
+    #                nothing forces two receivers to tally the same
+    #                multiset).  Three receiver camps (ops/tally.py:
+    #                targeted_counts): F+1 ids seeded to decide 0, F+1 to
+    #                decide 1, the rest fed perfect ties so they vote "?"
+    #                and (via quirk 4, quorum-counts-"?") starve the
+    #                1-camp's zero-count below the decide bar.  With an
+    #                even quorum N-F this violates agreement for EVERY
+    #                1 <= F < N/2 and livelocks at F >= N/2 — the sharpest
+    #                possible threshold, at the fault-tolerance boundary.
+    #                Under fault_model='equivocate' equivocators
+    #                substitute for camp members and repair quorum parity:
+    #                ONE equivocator splits the network at any N (the
+    #                count > F decide rule has no Byzantine safety
+    #                margin).  Closed form on BOTH compute paths;
+    #                realizable as an explicit delivery schedule
+    #                (ops/scheduler.py:realize_counts_mask, pinned in
+    #                tests/test_targeted.py).
     scheduler: str = "uniform"
     # Delay added by the 'biased' scheduler to starved-class edges.
     adversary_strength: float = 0.0
@@ -140,6 +161,18 @@ class SimConfig:
     # Mesh axis sizes (trials_axis, nodes_axis); None => single device.
     mesh_shape: Optional[Tuple[int, int]] = None
 
+    # --- mid-run observability ------------------------------------------
+    # poll_rounds > 0: TpuNetwork.start() steps the compiled loop in slices
+    # of this many rounds, publishing the state snapshot after each slice so
+    # concurrent /getState pollers observe a LIVE undecided network with
+    # growing k — the reference's poll-during-run contract
+    # (benorconsensus.test.ts:149-160: getState is sampled every 200 ms
+    # while consensus runs).  0 (default) = one uninterrupted compiled
+    # while-loop.  Final snapshots are bit-identical either way (the round
+    # body is keyed on (seed, round), never on loop entry; pinned by
+    # tests).  Single-device path only.
+    poll_rounds: int = 0
+
     # --- misc -----------------------------------------------------------
     # The N1 backend switch: 'tpu' = device-array simulator; 'express' =
     # pure-Python event-loop oracle; 'native' = the C++ oracle (bit-exact
@@ -172,7 +205,8 @@ class SimConfig:
                 "coin_eps only applies to coin_mode='weak_common'")
         if self.delivery not in ("all", "quorum"):
             raise ValueError(f"unknown delivery: {self.delivery}")
-        if self.scheduler not in ("uniform", "biased", "adversarial"):
+        if self.scheduler not in ("uniform", "biased", "adversarial",
+                                  "targeted"):
             raise ValueError(f"unknown scheduler: {self.scheduler}")
         if self.path not in ("auto", "dense", "histogram"):
             raise ValueError(f"unknown path: {self.path}")
@@ -184,6 +218,31 @@ class SimConfig:
                 "fault_model='equivocate' is not supported with "
                 "scheduler='biased': the split adversary delays edges by "
                 "their carried value, which is per-edge under equivocation")
+        if self.delivery == "all" and self.scheduler != "uniform":
+            # No scheduler has any power over deterministic full delivery —
+            # every receiver tallies every live sender, and under
+            # fault_model='equivocate' equivocator values stay iid fair
+            # bits instead of adversary-chosen.  Running would be silently
+            # weaker than the adversary advertises, so fail loudly (checked
+            # after the fault-model combinations so their more specific
+            # messages win).
+            raise ValueError(
+                f"scheduler={self.scheduler!r} has no effect under "
+                "delivery='all'; use delivery='quorum' or "
+                "scheduler='uniform'")
+        if self.poll_rounds < 0:
+            raise ValueError("poll_rounds must be >= 0")
+        if self.poll_rounds and self.mesh_shape is not None:
+            raise ValueError(
+                "poll_rounds (sliced mid-run observability) is a "
+                "single-device feature; the sharded runner executes one "
+                "uninterrupted while-loop — unset mesh_shape or poll_rounds")
+        if self.poll_rounds and self.backend != "tpu":
+            raise ValueError(
+                "poll_rounds slices the tpu backend's compiled loop; the "
+                "event-loop oracles run to termination in one drain — a "
+                "silent no-op would fake mid-run observability, so use "
+                "backend='tpu'")
         if self.backend not in ("tpu", "express", "native"):
             raise ValueError(f"unknown backend: {self.backend}")
         if self.oracle_order not in ("fifo", "shuffle"):

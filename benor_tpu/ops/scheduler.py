@@ -19,6 +19,12 @@ schedulers, selected by ``SimConfig.scheduler``:
                 ops/tally.py (both paths): every receiver tallies a multiset
                 whose 0/1 counts tie, so phase-1 yields "?" and private-coin
                 runs livelock; the common coin defeats it in O(1) rounds.
+  targeted:     the *partitioned* count-controlling adversary (agreement
+                attack): closed form on both paths in
+                ops/tally.py:targeted_counts; realize_counts_mask below
+                builds the equivalent explicit per-edge mask, proving the
+                closed form corresponds to a realizable schedule
+                (test witness, not the runtime path).
 
 Dense path only in this module (N x N mask, N <= dense_path_max_n).
 """
@@ -28,7 +34,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..config import SimConfig, VAL0, VAL1
+from ..config import SimConfig, VAL0, VAL1, VALQ
 from . import rng
 
 
@@ -79,11 +85,50 @@ def quorum_delivery_mask(cfg: SimConfig, base_key: jax.Array, r: jax.Array,
         delays = delays + cfg.adversary_strength * starved.astype(jnp.float32)
 
     delays = jnp.where(alive[:, None, :], delays, jnp.inf)
-    # top-(m) smallest delays per receiver row
+    return _top_m_mask(delays, m) & alive[:, None, :]
+
+
+def _top_m_mask(delays: jax.Array, m: int) -> jax.Array:
+    """bool mask of the m smallest entries per receiver row.
+
+    If fewer than m senders are alive (inf-delay slots selected), callers
+    intersect with alive so those rows tally only live senders — and the
+    quorum gate in the round kernel stalls them, as the reference would.
+    """
+    T, n_recv, N = delays.shape
     _, idx = jax.lax.top_k(-delays, m)                       # [T, n_recv, m]
     mask = jnp.zeros((T, n_recv, N), bool)
-    mask = jax.vmap(jax.vmap(lambda row, i: row.at[i].set(True)))(mask, idx)
-    # If fewer than m senders are alive, top_k picked dead (inf-delay) slots;
-    # intersect with alive so those rows tally only live senders (and the
-    # quorum gate in the round kernel stalls them, as the reference would).
-    return mask & alive[:, None, :]
+    return jax.vmap(jax.vmap(lambda row, i: row.at[i].set(True)))(mask, idx)
+
+
+def realize_counts_mask(counts: jax.Array, sent: jax.Array,
+                        alive: jax.Array) -> jax.Array:
+    """Realize per-receiver class-count quotas as an explicit delivery mask.
+
+    The count-controlling adversaries (tally.adversarial_counts /
+    targeted_counts) specify WHAT each receiver tallies as closed-form
+    class counts.  This builds a concrete schedule achieving them: sender
+    s reaches receiver r iff s's rank among live senders of its own class
+    is below r's quota for that class.  dense_counts(mask, ...) then
+    reproduces ``counts`` bit-for-bit (per-receiver class counts depend
+    only on how many of each class arrive, not which members) — proving
+    the closed forms are schedules an asynchronous network could actually
+    exhibit, not just abstract count assignments.  Test witness
+    (tests/test_targeted.py); not on the runtime path.
+
+    counts: int32 [T, n_recv, 3] desired per-receiver (c0, c1, cq) over
+    honest live senders; sent: int8 [T, N_send]; alive: bool [T, N_send].
+    Quotas must not exceed the live class populations (the closed forms
+    guarantee this).  Returns bool [T, n_recv, N_send].
+    """
+    # rank of each sender within its own (value-class, liveness) cohort
+    rank = jnp.zeros(sent.shape, jnp.int32)
+    for v in (VAL0, VAL1, VALQ):
+        in_class = (sent == v) & alive
+        r_v = jnp.cumsum(in_class.astype(jnp.int32), axis=-1) - 1
+        rank = jnp.where(in_class, r_v, rank)
+    quota = jnp.take_along_axis(
+        counts, jnp.broadcast_to(
+            sent.astype(jnp.int32)[:, None, :],
+            counts.shape[:2] + (sent.shape[-1],)), axis=-1)
+    return (rank[:, None, :] < quota) & alive[:, None, :]

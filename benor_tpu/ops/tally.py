@@ -150,6 +150,16 @@ def receiver_counts(cfg: SimConfig, base_key: jax.Array, r: jax.Array,
         counts = adversarial_counts(hist, cfg.quorum, n_free=n_equiv)
         return jnp.broadcast_to(counts[:, None, :], (T, N, 3))
 
+    # Partitioned count-controlling adversary (agreement attack): closed
+    # form on BOTH paths, like 'adversarial' above (scheduler semantics
+    # must not flip when path='auto' crosses dense_path_max_n).  The
+    # counts are realizable as an actual delivery schedule —
+    # scheduler.realize_counts_mask builds the explicit per-edge mask and
+    # tests/test_targeted.py pins dense_counts(mask) == this closed form.
+    if cfg.scheduler == "targeted":
+        hist = class_histogram(sent, honest, ctx)
+        return targeted_counts(cfg, hist, node_ids, n_free=n_equiv)
+
     if cfg.resolved_path == "dense":
         # Dense path on a node-sharded mesh: receivers stay local, the
         # sender axis is all-gathered. ``alive`` doesn't change within a
@@ -316,6 +326,112 @@ def biased_fractional_counts(s: float, u_race: jax.Array, u_split: jax.Array,
     hq = j - h_favval
     h0 = jnp.where(even, h_favval, k_starved)
     h1 = jnp.where(even, k_starved, h_favval)
+    return jnp.stack([h0, h1, hq], axis=-1)
+
+
+def targeted_camp_sizes(cfg: SimConfig) -> tuple:
+    """(size_per_value_camp, free_static): how many receivers the targeted
+    adversary seeds per value camp.  A camp must muster count > F of its
+    value at its own receivers; equivocators (free_static of them, each
+    able to tell every receiver a different value) substitute for honest
+    camp members one-for-one."""
+    free_static = cfg.n_faulty if cfg.fault_model == "equivocate" else 0
+    return max(cfg.n_faulty + 1 - free_static, 1), free_static
+
+
+def targeted_counts(cfg: SimConfig, hist: jax.Array, node_ids: jax.Array,
+                    n_free: jax.Array | None = None) -> jax.Array:
+    """Partitioned count-controlling adversary: attack AGREEMENT directly.
+
+    Where ``adversarial_counts`` ties every receiver identically (attacking
+    termination), this adversary PARTITIONS the receivers — the true worst
+    case of the "first N-F arrivals win" nondeterminism (node.ts:52,88),
+    where nothing forces two receivers to tally the same multiset.  Three
+    camps by global receiver id (sized by targeted_camp_sizes; the value
+    camps sit at the top of the id range, clear of the first_f faulty
+    convention):
+
+      camp 0   (s ids)  max-0 multisets: h0 = min(c0 + free, m), then "?",
+                        the 1-class last.  In phase 1 they adopt 0; in
+                        phase 2 they see count0 > F and decide 0.
+      camp 1   (s ids)  the mirror image -> decide 1.  The decide rule
+                        checks count0 > F FIRST (node.ts:99), so this camp
+                        only decides 1 if its 0-count stays <= F — which is
+                        exactly what the manufactured "?" pool buys.
+      camp "?" (rest)   max-"?" multisets, remainder split evenly: in
+                        phase 1 (no "?" exist yet) that is a perfect tie,
+                        so the camp adopts "?" (quirk 4's quorum-counts-"?"
+                        is what lets these messages fill quorums); in
+                        phase 2 their votes ARE the "?" pool that starves
+                        camp 1's zero-count below the bar.
+
+    The resulting thresholds (RESULTS 'safety_violation' study;
+    tests/test_targeted.py):
+      * crash-model, balanced inputs, even quorum N-F: agreement is
+        violated for EVERY 1 <= F < N/2, and at F >= N/2 the decide bar
+        m <= F makes decisions impossible (livelock) — the sharpest
+        possible 0/1 threshold, pinned at the fault-tolerance boundary.
+        (Odd quorums cannot manufacture perfect phase-1 ties, which
+        weakens the attack to N <= 3F + 1 — a quirk-born parity effect.)
+      * fault_model='equivocate': equivocators substitute for camp
+        members AND can send "?", repairing quorum parity — ONE
+        equivocator violates agreement at any N.  The reference's
+        count > F decide rule has no Byzantine safety margin.
+      * F = 0: m = N forces full delivery; the closed form degenerates to
+        the global histogram at every receiver — the adversary is
+        powerless, exactly like the reference with zero slack.
+
+    hist: int32 [T, 3] global HONEST (c0, c1, cq); node_ids: global
+    receiver ids [N_local] of this shard; ``n_free`` (int32 [T] or None) =
+    live equivocators, whose per-receiver values the adversary aims at the
+    receiver's camp (value camps: the camp value; "?" camp: "?").
+    Returns int32 [T, N_local, 3] summing to m whenever the live
+    population covers the quorum.  Realizable as an explicit delivery
+    schedule: scheduler.realize_counts_mask + tests/test_targeted.py.
+    """
+    m = cfg.quorum
+    size_v, _ = targeted_camp_sizes(cfg)
+    c0, c1, cq = hist[:, 0:1], hist[:, 1:2], hist[:, 2:3]   # [T, 1]
+    free = jnp.zeros_like(c0) if n_free is None else n_free[:, None]
+    camp1 = (node_ids >= cfg.n_nodes - size_v)[None, :]     # [1, N]
+    camp0 = (node_ids >= cfg.n_nodes - 2 * size_v)[None, :] & ~camp1
+    in_value_camp = camp0 | camp1
+
+    # value camps: preferred class first (honest + all free), "?" second,
+    # the starved class last.  free is exhausted whenever h_pref < m, so
+    # no leftover-free case exists.
+    want = jnp.where(camp0, c0, c1)
+    other = jnp.where(camp0, c1, c0)
+    v_pref = jnp.minimum(want + free, m)
+    v_q = jnp.minimum(cq, m - v_pref)
+    v_oth = jnp.minimum(other, m - v_pref - v_q)
+    v0 = jnp.where(camp0, v_pref, v_oth)
+    v1 = jnp.where(camp0, v_oth, v_pref)
+
+    # "?" camp: every "?" available (honest + free-as-"?"), remainder
+    # filled evenly from the value classes.  An even remainder is a
+    # perfect tie -> the receiver adopts "?" (phase 1's manufacture step);
+    # drop one "?" when that fixes the remainder's parity.
+    q_q = jnp.minimum(cq + free, m)
+    rem = m - q_q
+    drop = ((rem % 2) == 1) & (q_q > 0)
+    q_q = q_q - drop
+    rem = rem + drop
+    tie = rem // 2
+    q0 = jnp.minimum(c0, tie)
+    q1 = jnp.minimum(c1, tie)
+    left = rem - q0 - q1
+    e0 = jnp.clip(left, 0, c0 - q0)
+    q0 = q0 + e0
+    left = left - e0
+    e1 = jnp.clip(left, 0, c1 - q1)
+    q1 = q1 + e1
+    # if the classes could not absorb the parity drop, restore it
+    q_q = q_q + jnp.clip(left - e1, 0, drop.astype(jnp.int32))
+
+    h0 = jnp.where(in_value_camp, v0, q0)
+    h1 = jnp.where(in_value_camp, v1, q1)
+    hq = jnp.where(in_value_camp, v_q, q_q)
     return jnp.stack([h0, h1, hq], axis=-1)
 
 

@@ -73,6 +73,34 @@ def resume_consensus(cfg: SimConfig, state: NetState, faults: FaultSpec,
     return r - 1, state
 
 
+@functools.partial(jax.jit, static_argnums=0)
+def run_consensus_slice(cfg: SimConfig, state: NetState, faults: FaultSpec,
+                        base_key: jax.Array, from_round: jax.Array,
+                        until_round: jax.Array):
+    """At most ``until_round - from_round`` rounds of the compiled loop.
+
+    The slice primitive behind mid-run observability (cfg.poll_rounds):
+    the round body is a pure function of (round index, state) with all
+    randomness keyed on (seed, round, phase, trial, node) — never on how
+    the loop was entered — so running the network in slices is bit-identical
+    to the one-shot ``run_consensus`` (tests/test_http_api.py pins this).
+    Both round bounds are TRACED scalars: every slice of every chunk size
+    shares one compiled executable per config.
+
+    Returns (next_round, state); ``next_round == from_round`` means no
+    progress was possible (already settled or past the round cap).
+    """
+    carry = (jnp.int32(from_round), state)
+
+    def cond(carry):
+        r, st = carry
+        return _run_cond(cfg, carry) & (r < until_round)
+
+    r, state = jax.lax.while_loop(
+        cond, functools.partial(_run_body, cfg, faults, base_key), carry)
+    return r, state
+
+
 def simulate(cfg: SimConfig, initial_values, faulty_list=None,
              faults: Optional[FaultSpec] = None, crash_rounds=None):
     """Convenience one-shot: build state, run, return (rounds, state, faults).

@@ -29,6 +29,7 @@ def _setup(**overrides):
     return cfg, state, faults
 
 
+@pytest.mark.slow
 def test_resume_bit_identical(tmp_path):
     cfg, state, faults = _setup()
     base_key = jax.random.key(cfg.seed)
@@ -56,6 +57,7 @@ def test_resume_bit_identical(tmp_path):
                                   np.asarray(final_full.killed))
 
 
+@pytest.mark.slow
 def test_resume_bit_identical_new_streams(tmp_path):
     """The resume guarantee must hold for EVERY random stream: the
     equivocate fault plane (per-edge bits / mixed-population sampler) and
@@ -89,6 +91,7 @@ def test_resume_bit_identical_new_streams(tmp_path):
             np.asarray(getattr(final_full, leaf)), err_msg=leaf)
 
 
+@pytest.mark.slow
 def test_resume_on_mesh_bit_identical(tmp_path):
     """A single-device checkpoint resumes on a device mesh (and the result
     is bit-identical to the uninterrupted single-device run): checkpoints
@@ -115,6 +118,7 @@ def test_resume_on_mesh_bit_identical(tmp_path):
                                   np.asarray(final_full.k))
 
 
+@pytest.mark.slow
 def test_resume_with_crash_at_round_bit_identical(tmp_path):
     """Mid-run crashes scheduled AFTER the checkpoint round still fire on
     resume: FaultSpec.crash_round is persisted and the kernel re-derives

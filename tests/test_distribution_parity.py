@@ -1,0 +1,154 @@
+"""Oracle <-> scheduler DISTRIBUTION parity (r3 VERDICT items 4 + 7).
+
+SURVEY §7 ranks "faithful asynchrony that still exhibits textbook Ben-Or
+round distributions" as hard-part #1.  These tests settle it with a sharper
+statement than a statistical match — a structural theorem about the
+reference contract itself:
+
+  Within the reference's expressible scenario space, crash-from-birth
+  faults are pinned to exactly F (launchNodes.ts:12-13), so the live
+  population equals the quorum N-F.  Every tally therefore contains the
+  FULL live population in ANY delivery order — the event-loop asynchrony
+  is tally-invisible:
+
+  (1) Decisions/adoptions depend only on shared counts, and coin draws
+      matter only through their per-round multiset (the same shared-stream
+      segment in any order).  Every run that DECIDES has a final trace
+      that is bit-identical across delivery orders (fifo == shuffle).
+  (2) Order-dependence survives only in runs CAPPED immediately after a
+      coin phase: the final x of undecided lanes is the raw coin
+      assignment, which permutes with delivery order while its per-trial
+      multiset stays invariant.
+  (3) Consequently the rounds-to-decide law has a single stochastic
+      driver — iid fair coins — and matches the tpu backend's
+      uniform-quorum scheduler law (two-sample KS over ~10^3 per-trial
+      samples).  The asynchrony-model gap the round-3 VERDICT hypothesized
+      ("event-loop delivery is not uniform-without-replacement") is
+      vacuous inside the reference contract: there is no delivery slack
+      for the schedulers to disagree over.  (Slack exists only in
+      framework extensions — alive > quorum via FaultSpec.none — which
+      the oracles, faithfully, cannot express.)
+
+The engine is the batched native oracle (one ctypes call per [S] seed
+vector, native/express_oracle.cpp:benor_express_run_batch).
+"""
+
+import numpy as np
+import pytest
+
+from benor_tpu.backends import native_oracle
+from benor_tpu.config import SimConfig
+
+pytestmark = pytest.mark.skipif(not native_oracle.native_available(),
+                                reason="g++ unavailable")
+
+N, F = 100, 40
+FAULTY = [True] * F + [False] * (N - F)
+# balanced healthy inputs: phase-1 ties -> "?" votes -> every round coins
+VALS = [0] * F + [i % 2 for i in range(N - F)]
+HEALTHY = slice(F, N)
+
+
+def _batch(order, max_rounds=64, n_seeds=200):
+    cfg = SimConfig(n_nodes=N, n_faulty=F, backend="native",
+                    max_rounds=max_rounds, oracle_order=order)
+    return native_oracle.run_batch(cfg, VALS, FAULTY,
+                                   np.arange(n_seeds, dtype=np.uint32))
+
+
+def test_batch_matches_single_runs():
+    """The [S]-seed batch entry is bit-identical to S single-seed calls."""
+    n, f = 20, 6
+    vals = [i % 2 for i in range(n)]
+    faulty = [True] * f + [False] * (n - f)
+    for order in ("fifo", "shuffle"):
+        cfg = SimConfig(n_nodes=n, n_faulty=f, backend="native",
+                        max_rounds=24, oracle_order=order)
+        seeds = np.arange(12, dtype=np.uint32)
+        out = native_oracle.run_batch(cfg, vals, faulty, seeds)
+        assert (out["steps"] >= 0).all()
+        for i, sd in enumerate(seeds):
+            net = native_oracle.NativeExpressNetwork(
+                cfg.replace(seed=int(sd)), vals, faulty)
+            net.start()
+            np.testing.assert_array_equal(net._x, out["x"][i])
+            np.testing.assert_array_equal(net._k, out["k"][i])
+            np.testing.assert_array_equal(net._decided.astype(bool),
+                                          out["decided"][i])
+
+
+def test_ks_helper_matches_scipy():
+    """results.ks_two_sample (scipy-free, used by the RESULTS study) agrees
+    with scipy's asymptotic two-sample KS."""
+    scipy_stats = pytest.importorskip("scipy.stats")
+    from benor_tpu.results import ks_two_sample
+
+    rng = np.random.default_rng(0)
+    a = rng.integers(2, 7, 400)
+    b = rng.integers(2, 7, 500) + (rng.random(500) < 0.15)
+    d, p = ks_two_sample(a, b)
+    ref = scipy_stats.ks_2samp(a, b, method="asymp")
+    assert d == pytest.approx(ref.statistic, abs=1e-12)
+    assert p == pytest.approx(ref.pvalue, abs=0.02)
+
+
+@pytest.mark.slow
+def test_decided_runs_are_delivery_order_invariant():
+    """Theorem (1): every decided run's final trace is BIT-IDENTICAL
+    between fifo and shuffle delivery — the asynchrony is tally-invisible
+    under the reference contract (alive == quorum)."""
+    a = _batch("fifo")
+    b = _batch("shuffle")
+    assert a["decided"][:, HEALTHY].all(), "scenario must decide"
+    np.testing.assert_array_equal(a["x"], b["x"])
+    np.testing.assert_array_equal(a["k"], b["k"])
+    np.testing.assert_array_equal(a["decided"], b["decided"])
+
+
+@pytest.mark.slow
+def test_capped_coin_phase_permutes_assignment_only():
+    """Theorem (2): cap the run right after the round-1 coin phase — the
+    one window where delivery order is observable.  Per-node coin values
+    permute; the per-trial multiset is invariant."""
+    a = _batch("fifo", max_rounds=1, n_seeds=40)
+    b = _batch("shuffle", max_rounds=1, n_seeds=40)
+    ax, bx = a["x"][:, HEALTHY], b["x"][:, HEALTHY]
+    assert not a["decided"][:, HEALTHY].any()
+    # some seed shows a different per-node assignment...
+    assert (ax != bx).any(axis=1).all(), \
+        "every capped-after-coin seed should permute some assignment"
+    # ...but the multiset of coin values never changes
+    np.testing.assert_array_equal(np.sort(ax, axis=1), np.sort(bx, axis=1))
+
+
+@pytest.mark.slow
+def test_rounds_to_decide_law_matches_tpu_uniform_scheduler():
+    """Theorem (3): the oracle's per-trial rounds-to-decide law equals the
+    tpu backend's under the uniform-quorum scheduler — two-sample KS on
+    ~500 independent per-trial samples (lanes are lockstep-correlated, so
+    the honest unit is the trial)."""
+    import jax
+
+    from benor_tpu.sim import run_consensus
+    from benor_tpu.state import FaultSpec, init_state
+
+    S = 500
+    out = _batch("shuffle", n_seeds=S)
+    k_oracle = out["k"][:, HEALTHY].max(axis=1) - 1
+
+    cfg = SimConfig(n_nodes=N, n_faulty=F, trials=S, delivery="quorum",
+                    scheduler="uniform", path="histogram", max_rounds=64,
+                    seed=11)
+    faults = FaultSpec.from_faulty_list(cfg, FAULTY)
+    state = init_state(cfg, np.tile(np.asarray(VALS, np.int8), (S, 1)),
+                       faults)
+    _, fin = run_consensus(cfg, state, faults, jax.random.key(11))
+    k_tpu = np.asarray(fin.k)[:, HEALTHY].max(axis=1) - 1
+
+    from benor_tpu.results import ks_two_sample
+    stat, pvalue = ks_two_sample(k_oracle, k_tpu)
+    assert pvalue > 0.01, (stat, pvalue, np.bincount(k_oracle),
+                           np.bincount(k_tpu))
+    # both laws live where textbook Ben-Or puts them: almost everything
+    # decides within a few coin rounds
+    assert abs(k_oracle.mean() - k_tpu.mean()) < 0.2

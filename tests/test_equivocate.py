@@ -88,6 +88,7 @@ def _equiv_trial_mean_k(n, f, trials, seed, path):
     return (k * dec).sum(axis=1) / dec.sum(axis=1)
 
 
+@pytest.mark.slow
 def test_dense_vs_histogram_parity():
     n, f, trials = 96, 36, 256
     a = _equiv_trial_mean_k(n, f, trials, seed=11, path="dense")
@@ -144,6 +145,7 @@ def test_all_delivery_tallies_every_sender():
 
 
 @pytest.mark.parametrize("path", ["dense", "histogram"])
+@pytest.mark.slow
 def test_validity_holds_under_equivocation(path):
     """VALIDITY survives equivocation at ANY F under the uniform scheduler:
     with unanimous honest inputs v, the ¬v count comes only from delivered
@@ -192,6 +194,7 @@ def test_all_delivery_small_f_split_is_exact():
 # must not depend on how lanes are sharded.
 # ---------------------------------------------------------------------------
 @pytest.mark.parametrize("path", ["dense", "histogram"])
+@pytest.mark.slow
 def test_sharded_bit_identity(path):
     n, f, trials = 32, 8, 4
     cfg = _cfg(n, f, path, trials=trials, max_rounds=16, seed=9)

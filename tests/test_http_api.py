@@ -138,6 +138,90 @@ def test_post_malformed_content_length_400():
         assert b"Content-Length" in resp
 
 
+# --- mid-run observability (cfg.poll_rounds) ---------------------------
+# The reference polls /getState every 200 ms WHILE consensus runs and
+# observes k growing toward the k>10 livelock assertion
+# (benorconsensus.test.ts:149-160, :341).  poll_rounds=c restores that
+# contract: the compiled loop runs in c-round slices with the snapshot
+# republished between slices.
+
+# N=10, F=5 "Exceeding Fault Tolerance" livelock: count > F is
+# unsatisfiable, so the network stays undecided for max_rounds — the one
+# scenario guaranteed to stay live long enough to observe mid-run.
+_LIVELOCK = dict(n=10, f=5, vals=[1, 1, 0, 0, 1, 1, 0, 0, 1, 1],
+                 faulty=[True] * 5 + [False] * 5)
+
+
+@pytest.mark.parametrize("scenario", ["livelock", "decides"])
+@pytest.mark.parametrize("poll_rounds", [1, 3])
+def test_poll_rounds_final_state_bit_identical(scenario, poll_rounds):
+    """Sliced execution must change WHEN snapshots are visible, never what
+    the final one is: every observable field and rounds_executed match the
+    one-shot compiled loop exactly (sim.run_consensus_slice contract)."""
+    if scenario == "livelock":
+        kw = dict(_LIVELOCK, max_rounds=16)
+    else:
+        kw = dict(n=7, f=2, vals=[1, 0, 1, 1, 0, 1, 1],
+                  faulty=[True, True] + [False] * 5, max_rounds=32)
+    nets = {}
+    for pr in (0, poll_rounds):
+        net = launch_network(kw["n"], kw["f"], kw["vals"], kw["faulty"],
+                             backend="tpu", seed=3, delivery="quorum",
+                             max_rounds=kw["max_rounds"], poll_rounds=pr)
+        net.start()
+        nets[pr] = net
+    assert nets[0].rounds_executed == nets[poll_rounds].rounds_executed
+    assert nets[0].get_states() == nets[poll_rounds].get_states()
+
+
+def test_poll_rounds_observes_live_undecided_network():
+    """Mid-run snapshots show a live (decided=False) network with k growing
+    across slices — deterministically captured via the on_slice hook."""
+    net = launch_network(_LIVELOCK["n"], _LIVELOCK["f"], _LIVELOCK["vals"],
+                         _LIVELOCK["faulty"], backend="tpu", seed=0,
+                         delivery="quorum", max_rounds=16, poll_rounds=1)
+    snaps = []
+    net.start(on_slice=lambda: snaps.append(net.get_state(5)))
+    assert len(snaps) >= 10
+    ks = [s["k"] for s in snaps]
+    assert all(s["decided"] is False for s in snaps)    # live throughout
+    assert ks == sorted(ks) and len(set(ks)) >= 10      # k grows
+    # livelock parity: k exceeds 10 (benorconsensus.test.ts:341)
+    assert net.get_state(5)["k"] > 10
+
+
+def test_poll_rounds_http_getstate_sees_live_network():
+    """Over real sockets: /getState DURING /start returns an undecided
+    snapshot with 1 <= k < final (the reference's poll loop observation).
+    The start handler is slowed per-slice via the on_slice hook so the
+    poller cannot miss the window."""
+    import functools
+    import threading
+    import time
+
+    net = launch_network(_LIVELOCK["n"], _LIVELOCK["f"], _LIVELOCK["vals"],
+                         _LIVELOCK["faulty"], backend="tpu", seed=0,
+                         delivery="quorum", max_rounds=16, poll_rounds=1)
+    net.start = functools.partial(net.start,
+                                  on_slice=lambda: time.sleep(0.05))
+    with NodeHttpCluster(net, BASE + 70):
+        starter = threading.Thread(
+            target=lambda: _get(BASE + 70, "/start"), daemon=True)
+        starter.start()
+        live = []
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and starter.is_alive():
+            s = json.loads(_get(BASE + 70 + 6, "/getState")[1])
+            if s["decided"] is False and s["k"] is not None and s["k"] >= 1:
+                live.append(s["k"])
+            time.sleep(0.01)
+        starter.join(timeout=20)
+        assert live, "poller never saw a live mid-run snapshot"
+        final = json.loads(_get(BASE + 70 + 6, "/getState")[1])
+        assert final["k"] > 10                      # livelock parity
+        assert min(live) < final["k"]               # k was observed growing
+
+
 def test_serve_network_usable_as_context_manager():
     """serve_network() returns an already-serving cluster; entering it as a
     context manager must be a no-op start (regression: threads were started

@@ -48,6 +48,7 @@ class TestKernel:
         assert abs(h0.mean() - d.mean()) < 0.05 * d.std()
         assert abs(h0.std() - d.std()) < 0.05 * d.std()
 
+    @pytest.mark.slow
     def test_deterministic_and_stream_separated(self):
         args = ([4000, 3000, 1000], 5000, 1024)
         a = _counts(42, 3, 0, *args)
@@ -156,6 +157,7 @@ class TestWeakCoinKernel:
         mismatch = (a != shared).mean()
         assert abs(mismatch - 0.15) < 0.01                   # eps/2 = 0.15
 
+    @pytest.mark.slow
     def test_protocol_ks_vs_xla_weak_coin(self):
         from stat_harness import trial_mean_k
         kw = dict(table_max=64, coin_mode="weak_common", coin_eps=0.5)
@@ -167,6 +169,7 @@ class TestWeakCoinKernel:
                        pallas.std() / len(pallas) ** 0.5)
         assert abs(xla.mean() - pallas.mean()) < 4 * sem + 1e-9
 
+    @pytest.mark.slow
     def test_sharded_bit_identical(self):
         from benor_tpu.parallel import make_mesh, run_consensus_sharded
         from benor_tpu.sim import run_consensus
@@ -198,6 +201,7 @@ class TestWeakCoinKernel:
 class TestEquivKernel:
     """Fused equivocate-regime sampler (ops/pallas_hist.py:_equiv_kernel)."""
 
+    @pytest.mark.slow
     def test_moments_all_honest_zero(self):
         # honest all-0: the honest split is deterministic (h0 = rem), so
         # class-1 counts come ONLY from the equivocators' fair bits:
@@ -213,6 +217,7 @@ class TestEquivKernel:
         assert abs(h1.mean() - exp_mean) < 0.05 * np.sqrt(exp_var)
         assert abs(h1.std() - np.sqrt(exp_var)) < 0.05 * np.sqrt(exp_var)
 
+    @pytest.mark.slow
     def test_deterministic_and_stream_separated(self):
         args = ([4000, 3000, 1000], 1500, 5000, 1024)
         a = _equiv_counts(42, 3, 0, *args)
@@ -221,6 +226,7 @@ class TestEquivKernel:
         assert not np.array_equal(a, _equiv_counts(42, 3, 1, *args))
         assert not np.array_equal(a, _equiv_counts(43, 3, 0, *args))
 
+    @pytest.mark.slow
     def test_protocol_ks_vs_xla_equiv_sampler(self):
         """Full consensus with fault_model='equivocate': the fused kernel's
         stream vs the four-grid_uniforms XLA pipeline must be
@@ -239,6 +245,7 @@ class TestEquivKernel:
                        pallas.std() / len(pallas) ** 0.5)
         assert abs(xla.mean() - pallas.mean()) < 4 * sem + 1e-9
 
+    @pytest.mark.slow
     def test_sharded_bit_identical(self):
         """Global-id counters + psum'd (hist, n_equiv): sharded equivocate
         runs with the kernel are bit-identical to single-device."""
@@ -278,6 +285,7 @@ class TestProtocolParity:
     aggregation — see tests/stat_harness.py for why each matters); the CF
     regime is forced at m=495 via table_max so the kernel engages on CPU."""
 
+    @pytest.mark.slow
     def test_ks_vs_xla_sampler(self):
         from stat_harness import trial_mean_k
         xla = trial_mean_k(750, 255, 128, 301, table_max=64,
@@ -293,6 +301,7 @@ class TestProtocolParity:
                        pallas.std() / len(pallas) ** 0.5)
         assert abs(xla.mean() - pallas.mean()) < 4 * sem + 1e-9
 
+    @pytest.mark.slow
     def test_sharded_bit_identical(self):
         """use_pallas_hist under shard_map: global-id counters + the psum'd
         global histogram make the sharded run bit-identical to the
@@ -324,6 +333,7 @@ class TestProtocolParity:
         finally:
             sampling.EXACT_TABLE_MAX = old
 
+    @pytest.mark.slow
     def test_flag_ignored_outside_cf_regime(self):
         """In the exact-table regime the flag must be a no-op (bitwise)."""
         from benor_tpu.sim import simulate

@@ -40,6 +40,7 @@ def test_counts_respect_alive_and_mask():
     assert (out[0, :, 2] == 0).all()
 
 
+@pytest.mark.slow
 def test_end_to_end_pallas_equals_xla():
     """Full consensus runs produce identical results with/without pallas."""
     n, f, trials = 60, 15, 16

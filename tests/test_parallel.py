@@ -43,6 +43,7 @@ def _assert_identical(a, b):
 
 @pytest.mark.parametrize("mesh_shape", MESH_SHAPES)
 @pytest.mark.parametrize("path", ["dense", "histogram"])
+@pytest.mark.slow
 def test_sharded_bit_identical_quorum_uniform(mesh_shape, path):
     cfg = SimConfig(n_nodes=N, n_faulty=F, trials=T, delivery="quorum",
                     scheduler="uniform", path=path, seed=7)
@@ -51,6 +52,7 @@ def test_sharded_bit_identical_quorum_uniform(mesh_shape, path):
 
 
 @pytest.mark.parametrize("mesh_shape", [(2, 4), (8, 1)])
+@pytest.mark.slow
 def test_sharded_bit_identical_all_delivery(mesh_shape):
     cfg = SimConfig(n_nodes=N, n_faulty=F, trials=T, delivery="all", seed=1)
     a, b = _run_pair(cfg, mesh_shape)
@@ -58,6 +60,7 @@ def test_sharded_bit_identical_all_delivery(mesh_shape):
 
 
 @pytest.mark.parametrize("mesh_shape", [(1, 8), (4, 2)])
+@pytest.mark.slow
 def test_sharded_bit_identical_common_coin_adversarial(mesh_shape):
     # The adversarial scheduler forces livelock under private coins; the
     # common coin must still converge identically on every mesh shape.
@@ -68,6 +71,7 @@ def test_sharded_bit_identical_common_coin_adversarial(mesh_shape):
 
 
 @pytest.mark.parametrize("mesh_shape", [(2, 4)])
+@pytest.mark.slow
 def test_sharded_bit_identical_byzantine(mesh_shape):
     cfg = SimConfig(n_nodes=N, n_faulty=F, trials=T, delivery="quorum",
                     scheduler="uniform", fault_model="byzantine", seed=11)
@@ -84,6 +88,7 @@ def test_mesh_divisibility_validated():
                               make_mesh(8, 1))
 
 
+@pytest.mark.slow
 def test_backend_mesh_shape_switch():
     """TpuNetwork honors cfg.mesh_shape end-to-end via the parity API."""
     from benor_tpu.api import launch_network, start_consensus

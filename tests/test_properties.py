@@ -31,6 +31,7 @@ def _run(n, f, trials, seed, *, vals=None, faulty=None, faults=None,
 
 @pytest.mark.parametrize("path", ["dense", "histogram"])
 @pytest.mark.parametrize("seed", [1, 2, 3])
+@pytest.mark.slow
 def test_agreement(path, seed):
     """No two healthy decided lanes of a trial hold different values."""
     x, decided, _, healthy = _run(60, 15, 64, seed, path=path)
@@ -42,6 +43,7 @@ def test_agreement(path, seed):
 
 @pytest.mark.parametrize("path", ["dense", "histogram"])
 @pytest.mark.parametrize("v", [0, 1])
+@pytest.mark.slow
 def test_validity_unanimous(path, v):
     """If every healthy node starts with v, every decision is v."""
     n, f, trials = 40, 10, 32
@@ -115,6 +117,7 @@ def test_byzantine_agreement_full_delivery():
     assert (decided & healthy).any(axis=1).mean() > 0.9
 
 
+@pytest.mark.slow
 def test_byzantine_quorum_sampling_breaks_reference_rule():
     """A *finding* the simulator must reproduce: the reference's decide rule
     (plurality-adopt + decide on count > F, node.ts:99-112) is NOT safe once
@@ -164,6 +167,7 @@ def test_crash_at_round_kills_and_network_survives():
     assert (decided | faulty).all(), "healthy lanes must still decide"
 
 
+@pytest.mark.slow
 def test_mesh_shape_invariance_of_results():
     """SURVEY §7 hard-part 5: same seed, different mesh shapes -> identical
     results (RNG keyed on global ids, not shard layout)."""

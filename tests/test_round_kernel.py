@@ -188,6 +188,7 @@ class TestFullRun:
         assert np.asarray(final.decided).all()
         assert (np.asarray(final.x) == 1).all()
 
+    @pytest.mark.slow
     def test_freeze_decided_off_keeps_lanes_looping(self):
         """freeze_decided=False models the reference's literal quirk 5
         (decided nodes keep executing rounds, node.ts:147-157): decided
@@ -222,6 +223,7 @@ class TestFullRun:
         assert (k_frozen[multi].min(axis=1) <
                 k_frozen[multi].max(axis=1)).any()
 
+    @pytest.mark.slow
     def test_agreement_and_validity_invariants_random(self):
         # Property: agreement (all deciders agree) + validity (decided value
         # was some node's input) over randomized inputs — reference :399-450

@@ -89,6 +89,7 @@ class TestHypergeomExact:
         assert counts.min() >= 0
         assert (counts[..., 0] <= c0).all() and (counts[..., 1] <= c1).all()
 
+    @pytest.mark.slow
     def test_multivariate_counts_sum_and_range(self):
         T, N, m = 8, 64, 48
         hist = jnp.tile(jnp.array([[30, 25, 9]], jnp.int32), (T, 1))
@@ -148,6 +149,7 @@ def _assert_stats_agree(d, h):
 class TestBiasedPriorityCounts:
     """Histogram-level biased scheduler (strength >= 1, strict priority)."""
 
+    @pytest.mark.slow
     def test_counts_invariants(self):
         from benor_tpu.ops import rng as _rng
         from benor_tpu.ops.tally import biased_priority_counts
@@ -169,6 +171,7 @@ class TestBiasedPriorityCounts:
         np.testing.assert_array_equal(odd[..., 1], 10)
         np.testing.assert_array_equal(odd[..., 0], 4)
 
+    @pytest.mark.slow
     def test_dense_histogram_agree_statistically(self):
         """Both paths implement the same strict-priority adversary: their
         MC-aggregate behavior must match (different RNG realizations, so
@@ -188,6 +191,7 @@ class TestBiasedFractionalCounts:
         (12, 4, 10, 20, 0.6),     # favored short of quorum (tau ~ 1)
         (10, 2, 68, 56, 0.75),    # favored exhausted (deterministic)
     ])
+    @pytest.mark.slow
     def test_race_marginal_matches_brute_force(self, nf_val, nq, ns, m, s):
         """J = #favored among the m smallest must match an explicit
         numpy simulation of the dense delay race in mean and spread."""
@@ -211,6 +215,7 @@ class TestBiasedFractionalCounts:
         np.testing.assert_array_equal(out.sum(-1) <= m, True)
         assert out.min() >= 0
 
+    @pytest.mark.slow
     def test_dense_histogram_agree_statistically(self):
         """Same fractional-delay adversary on both paths: MC aggregates must
         match (different RNG realizations, so statistical, not bitwise)."""
@@ -226,6 +231,7 @@ class TestApproxRegimeProtocol:
     Harness (balanced inputs, zero crashes, F > N/3, per-trial
     aggregation): tests/stat_harness.py."""
 
+    @pytest.mark.slow
     def test_cf_forced_matches_exact_table_m495(self):
         """Force CF at m=495 (deep inside the exact regime, where the exact
         shared-CDF table is available as ground truth): rounds-to-decide
@@ -252,6 +258,7 @@ class TestApproxRegimeProtocol:
         b = trial_mean_k(750, 255, 128, 103, table_max=4096)
         assert st.ks_2samp(a, b).pvalue > 1e-3
 
+    @pytest.mark.slow
     def test_production_cf_matches_exact_table_m4506(self):
         """The production boundary: m=4506 > EXACT_TABLE_MAX runs CF by
         default; raising the table cap to 8192 forces the exact shared-CDF
@@ -272,6 +279,7 @@ class TestApproxRegimeProtocol:
 class TestPathParity:
     """Two-sample KS: dense (exact) vs histogram (sampled) rounds-to-decide."""
 
+    @pytest.mark.slow
     def test_ks_dense_vs_histogram(self):
         dense = _rounds_to_decide("dense", seed=11)
         hist = _rounds_to_decide("histogram", seed=12)
@@ -284,6 +292,7 @@ class TestPathParity:
             f"KS={res.statistic:.4f} p={res.pvalue:.2e} "
             f"(dense mean {dense.mean():.3f}, hist mean {hist.mean():.3f})")
 
+    @pytest.mark.slow
     def test_dense_seeds_self_consistent(self):
         """Control: two seeds of the SAME path pass the same KS gate."""
         a = _rounds_to_decide("dense", seed=21)

@@ -11,6 +11,7 @@ from benor_tpu.sweep import (balanced_inputs, baseline_configs,
                              run_point, save_points)
 
 
+@pytest.mark.slow
 def test_run_point_summary_consistency():
     cfg = SimConfig(n_nodes=50, n_faulty=10, trials=64, max_rounds=32,
                     delivery="quorum", scheduler="uniform", seed=5)
@@ -26,6 +27,7 @@ def test_run_point_summary_consistency():
     assert pt.trials_per_sec > 0
 
 
+@pytest.mark.slow
 def test_rounds_vs_f_monotone_ish():
     """More faults -> fewer live senders -> never *faster* on average."""
     cfg = SimConfig(n_nodes=40, n_faulty=0, trials=96, max_rounds=48,
@@ -57,6 +59,7 @@ def test_coin_comparison_rejects_odd_quorum():
         coin_comparison(cfg, verbose=False)
 
 
+@pytest.mark.slow
 def test_trajectory_endpoint_matches_run_consensus():
     """Fixed-round scan == early-exit while_loop once everything settled
     (decided lanes freeze; settled rounds are state no-ops)."""
@@ -129,6 +132,7 @@ class TestWeakCommonCoin:
         r, final = run_consensus(cfg, state, faults, jax.random.key(seed))
         return cfg, int(r), np.asarray(final.decided)
 
+    @pytest.mark.slow
     def test_limits_and_transition(self):
         # eps=0 ~ common: O(1) rounds; eps=1 ~ private: livelock;
         # decided fraction is monotone non-increasing across the grid
@@ -199,6 +203,7 @@ class TestWeakCommonCoin:
                 assert dec.mean() < 0.2, (eps, dec.mean())
 
 
+@pytest.mark.slow
 def test_results_generator_end_to_end(tmp_path):
     """The science-deliverable generator (benor_tpu.results.generate) runs
     every study end-to-end at toy scale and writes both artifacts; the
@@ -208,9 +213,24 @@ def test_results_generator_end_to_end(tmp_path):
     out = generate(out_dir=str(tmp_path), n_large=400, trials_large=4,
                    presets=False)
     for key in ("balanced_curve", "margin_sweep", "coin_contrast",
-                "disagreement", "equivocation", "trajectory", "scaling",
-                "rule_comparison", "weak_coin"):
+                "disagreement", "safety_violation", "equivocation",
+                "trajectory", "scaling", "rule_comparison", "weak_coin",
+                "oracle_parity"):
         assert key in out, key
+    op = out["oracle_parity"]
+    assert op["order_invariant_decided_runs"] is True
+    assert op["ks_pvalue"] > 0.01
+    # targeted adversary: 0/1 safety curve — violated strictly inside
+    # (0, 1/2), intact at the edges, livelock past 1/2, and the
+    # one-equivocator row always violated
+    sv = out["safety_violation"]
+    for row in sv:
+        if row["fault_model"] == "equivocate":
+            assert row["disagree_frac"] == 1.0
+        elif row["f"] == 0 or row["f"] > 200:     # f=0 / past N/2 at N=400
+            assert row["disagree_frac"] == 0.0
+        else:
+            assert row["disagree_frac"] == 1.0, row
     # the N//3 threshold rows must disagree about decidability (N=400:
     # F=133 has 3F<N, F=134 has 3F>N)
     eq = {r["label"]: r for r in out["equivocation"]}
@@ -321,13 +341,33 @@ class TestCli:
         calls.clear()
         cli._ensure_live_backend(retries=1, timeout_s=1)
         assert calls == []
-        # non-axon platforms skip the probe entirely (the hang-at-init
+        # explicit non-axon pins skip the probe entirely (the hang-at-init
         # failure mode is axon-specific; a healthy TPU pays no overhead)
         monkeypatch.setattr(backend_mod, "probe_with_retries",
                             lambda *a, **kw: pytest.fail("probed"))
-        for plat in ("cpu", "tpu", ""):
+        for plat in ("cpu", "tpu"):
             monkeypatch.setenv("JAX_PLATFORMS", plat)
             cli._ensure_live_backend(retries=1, timeout_s=1)
+        assert calls == []
+        # UNSET env still probes when the axon plugin is importable: the
+        # plugin self-registers as the ambient default backend, so the
+        # hang risk is identical to an explicit JAX_PLATFORMS=axon
+        # (ADVICE r3); with the plugin absent, no probe.
+        monkeypatch.setenv("JAX_PLATFORMS", "")
+        probed = []
+        monkeypatch.setattr(backend_mod, "probe_with_retries",
+                            lambda *a, **kw: probed.append(1) or "axon")
+        import importlib.util
+        if importlib.util.find_spec("axon") is not None:
+            cli._ensure_live_backend(retries=1, timeout_s=1)
+            assert probed == [1]
+        real_find_spec = importlib.util.find_spec
+        monkeypatch.setattr(importlib.util, "find_spec",
+                            lambda name, *a: None if name == "axon"
+                            else real_find_spec(name, *a))
+        probed.clear()
+        cli._ensure_live_backend(retries=1, timeout_s=1)
+        assert probed == []
         assert calls == []
 
     def test_coins_cli_weak_rows(self, capsys):

@@ -1,0 +1,196 @@
+"""The 'targeted' partitioned count-controlling adversary (r3 VERDICT item 3).
+
+Pins every claim in ops/tally.py:targeted_counts and config.py:
+
+  * AGREEMENT VIOLATION for every 1 <= F < N/2 (even quorum, balanced
+    inputs, no crashes): the healthy network decides BOTH values — the
+    sharpest possible safety threshold, sitting exactly at the
+    fault-tolerance boundary F = N/2 where the run flips to livelock.
+  * The odd-quorum weakening (no phase-1 ties can be manufactured; the
+    attack then needs N <= 3F + 1) — a parity effect born of quirk 4.
+  * ONE equivocator violates agreement at any N (fault_model='equivocate'
+    lets the adversary repair quorum parity and substitute camp members).
+  * F = 0 leaves the adversary powerless (quorum N = full delivery).
+  * Dense and histogram paths are bit-identical (closed form on both).
+  * The closed-form counts are REALIZABLE as an explicit delivery schedule
+    (scheduler.realize_counts_mask -> dense_counts reproduces them).
+  * The sharded runner is bit-identical to single-device for this
+    scheduler (mesh-shape independence).
+
+The contrast the RESULTS 'safety_violation' study records: the
+delay-bounded 'biased' scheduler produces a soft probabilistic
+disagreement curve (results.py:disagreement_sweep); this adversary's curve
+is exactly 0/1 with a step at each boundary.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from benor_tpu.config import SimConfig, VAL0, VAL1, VALQ
+from benor_tpu.ops import scheduler, tally
+from benor_tpu.sim import run_consensus
+from benor_tpu.state import FaultSpec, init_state
+from benor_tpu.sweep import balanced_inputs, summarize_final
+
+
+def _run(n, f, path="histogram", fault_model="crash", trials=4, seed=0,
+         max_rounds=16):
+    cfg = SimConfig(n_nodes=n, n_faulty=f, trials=trials, delivery="quorum",
+                    scheduler="targeted", path=path, fault_model=fault_model,
+                    max_rounds=max_rounds, seed=seed)
+    faults = (FaultSpec.first_f(cfg) if fault_model == "equivocate"
+              else FaultSpec.none(trials, n))
+    state = init_state(cfg, balanced_inputs(trials, n), faults)
+    r, final = run_consensus(cfg, state, faults, jax.random.key(seed))
+    dec, _, _, _, disagree = summarize_final(final, faults.faulty,
+                                             cfg.max_rounds)
+    return int(r), float(dec), float(disagree), final, faults
+
+
+@pytest.mark.parametrize("n,f", [(100, 2), (100, 10), (100, 26), (100, 48),
+                                 (1000, 400)])
+@pytest.mark.slow
+def test_agreement_violated_below_half_even_quorum(n, f):
+    assert (n - f) % 2 == 0, "cases must have an even quorum"
+    _, dec, disagree, final, faults = _run(n, f)
+    assert disagree == 1.0, "every trial must decide both values"
+    # both camps really decided (not a ?-value artifact)
+    hd = np.asarray(final.decided) & ~np.asarray(faults.faulty)
+    x = np.asarray(final.x)
+    assert ((x == VAL0) & hd).any(axis=-1).all()
+    assert ((x == VAL1) & hd).any(axis=-1).all()
+
+
+@pytest.mark.parametrize("n,f", [(100, 50), (100, 60), (99, 50)])
+def test_livelock_at_and_above_half(n, f):
+    r, dec, disagree, _, _ = _run(n, f)
+    assert dec == 0.0 and disagree == 0.0
+    assert r == 16, "must run to the cap"
+
+
+def test_powerless_at_f_zero():
+    _, dec, disagree, final, faults = _run(100, 0)
+    assert dec == 1.0 and disagree == 0.0
+    hd = np.asarray(final.decided)
+    assert len(np.unique(np.asarray(final.x)[hd])) == 1
+
+
+@pytest.mark.parametrize("n,f,violates", [(100, 5, False), (100, 35, True)])
+def test_odd_quorum_weakening(n, f, violates):
+    """No "?" can be manufactured (no perfect phase-1 ties), so the attack
+    needs the starved fill itself to stay under the bar: N <= 3F + 1."""
+    assert (n - f) % 2 == 1
+    _, _, disagree, _, _ = _run(n, f)
+    assert (disagree == 1.0) is violates
+
+
+@pytest.mark.parametrize("n", [10, 100, 999])
+def test_single_equivocator_splits_any_n(n):
+    _, dec, disagree, _, _ = _run(n, 1, fault_model="equivocate")
+    assert disagree == 1.0
+
+
+@pytest.mark.parametrize("n,f,fault_model", [
+    (64, 16, "crash"), (64, 31, "crash"), (65, 16, "crash"),
+    (64, 4, "equivocate")])
+@pytest.mark.slow
+def test_dense_histogram_bit_identical(n, f, fault_model):
+    r1, _, _, fin1, _ = _run(n, f, "dense", fault_model)
+    r2, _, _, fin2, _ = _run(n, f, "histogram", fault_model)
+    assert r1 == r2
+    np.testing.assert_array_equal(np.asarray(fin1.x), np.asarray(fin2.x))
+    np.testing.assert_array_equal(np.asarray(fin1.decided),
+                                  np.asarray(fin2.decided))
+    np.testing.assert_array_equal(np.asarray(fin1.k), np.asarray(fin2.k))
+
+
+@pytest.mark.parametrize("mesh_shape", [(2, 4), (4, 2)])
+@pytest.mark.slow
+def test_sharded_bit_identical(mesh_shape):
+    from benor_tpu.parallel import make_mesh, run_consensus_sharded
+    cfg = SimConfig(n_nodes=16, n_faulty=4, trials=8, delivery="quorum",
+                    scheduler="targeted", path="histogram", max_rounds=16,
+                    seed=3)
+    faults = FaultSpec.none(cfg.trials, cfg.n_nodes)
+    state = init_state(cfg, balanced_inputs(cfg.trials, cfg.n_nodes), faults)
+    key = jax.random.key(cfg.seed)
+    r1, s1 = run_consensus(cfg, state, faults, key)
+    r2, s2 = run_consensus_sharded(cfg, state, faults, key,
+                                   make_mesh(*mesh_shape))
+    assert int(r1) == int(r2)
+    np.testing.assert_array_equal(np.asarray(s1.x), np.asarray(s2.x))
+    np.testing.assert_array_equal(np.asarray(s1.decided),
+                                  np.asarray(s2.decided))
+    np.testing.assert_array_equal(np.asarray(s1.k), np.asarray(s2.k))
+
+
+class TestRealizability:
+    """The closed forms describe deliveries an asynchronous network could
+    actually exhibit: realize_counts_mask builds an explicit per-edge
+    schedule whose dense_counts reproduce the counts bit-for-bit."""
+
+    def _random_population(self, key, trials, n):
+        k1, k2 = jax.random.split(key)
+        sent = jax.random.randint(k1, (trials, n), 0, 3).astype(np.int8)
+        alive = np.array(jax.random.bernoulli(k2, 0.9, (trials, n)))
+        return np.array(sent), alive
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_targeted_counts_realizable(self, seed):
+        trials, n, f = 8, 64, 20
+        cfg = SimConfig(n_nodes=n, n_faulty=f, trials=trials,
+                        delivery="quorum", scheduler="targeted",
+                        path="dense", seed=seed)
+        sent, alive = self._random_population(jax.random.key(seed), trials, n)
+        # live population must cover the quorum for the sum-to-m contract
+        alive[:, : cfg.quorum] = True
+        import jax.numpy as jnp
+        hist = tally.class_histogram(jnp.asarray(sent), jnp.asarray(alive))
+        counts = tally.targeted_counts(cfg, hist, np.arange(n))
+        mask = scheduler.realize_counts_mask(counts, jnp.asarray(sent),
+                                             jnp.asarray(alive))
+        realized = tally.dense_counts(mask, jnp.asarray(sent),
+                                      jnp.asarray(alive))
+        np.testing.assert_array_equal(np.asarray(realized),
+                                      np.asarray(counts))
+        assert (np.asarray(counts).sum(-1) == cfg.quorum).all()
+
+    @pytest.mark.parametrize("seed", [0, 3])
+    def test_adversarial_counts_realizable(self, seed):
+        """The tie-forcing adversary's counts are realizable too — the
+        witness covers both count-controlling schedulers."""
+        trials, n, m = 8, 64, 44
+        sent, alive = self._random_population(jax.random.key(seed), trials, n)
+        alive[:, :m] = True
+        import jax.numpy as jnp
+        hist = tally.class_histogram(jnp.asarray(sent), jnp.asarray(alive))
+        counts = jnp.broadcast_to(
+            tally.adversarial_counts(hist, m)[:, None, :], (trials, n, 3))
+        mask = scheduler.realize_counts_mask(counts, jnp.asarray(sent),
+                                             jnp.asarray(alive))
+        realized = tally.dense_counts(mask, jnp.asarray(sent),
+                                      jnp.asarray(alive))
+        np.testing.assert_array_equal(np.asarray(realized),
+                                      np.asarray(counts))
+
+
+def test_oracle_backends_reject_targeted():
+    """The event-loop oracles replicate the reference exactly; the
+    framework-only adversary must fail loudly there (api.py guard)."""
+    from benor_tpu.api import launch_network
+    for backend in ("express", "native"):
+        with pytest.raises(ValueError, match="scheduler='uniform'"):
+            launch_network(6, 2, [1] * 6, [True] * 2 + [False] * 4,
+                           backend=backend, scheduler="targeted",
+                           delivery="quorum")
+
+
+def test_camp_sizes():
+    cfg = SimConfig(n_nodes=100, n_faulty=10, delivery="quorum",
+                    scheduler="targeted")
+    assert tally.targeted_camp_sizes(cfg) == (11, 0)
+    cfg = cfg.replace(fault_model="equivocate")
+    assert tally.targeted_camp_sizes(cfg) == (1, 10)
+    cfg = cfg.replace(n_faulty=3)
+    assert tally.targeted_camp_sizes(cfg) == (1, 3)

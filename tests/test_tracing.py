@@ -1,6 +1,7 @@
 """Debug tracing hooks: per-round callbacks out of the compiled loop."""
 
 import numpy as np
+import pytest
 
 import jax
 
@@ -9,6 +10,7 @@ from benor_tpu.sim import simulate
 from benor_tpu.utils import tracing
 
 
+@pytest.mark.slow
 def test_round_events_emitted_in_order():
     rows = []
     sink = lambda r, d, k: rows.append((r, d, k))
@@ -45,6 +47,7 @@ def test_debug_off_emits_nothing():
     assert rows == []
 
 
+@pytest.mark.slow
 def test_round_events_under_sharded_runner():
     """cfg.debug must not be silently dropped by the shard_map runner
     (round-2 VERDICT weak #5): one event per round, network-global counts,
