@@ -77,6 +77,41 @@ def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
     frozen = state.decided & cfg.freeze_decided
     active = alive & quorum_ok & ~frozen
 
+    if tally.pallas_round_active(cfg):
+        # Fully-fused round (r3 VERDICT item 2): BOTH phases run as pallas
+        # kernels (ops/pallas_round.py) with the decide/adopt/coin/commit
+        # chain inside the vote kernel — per-lane HBM traffic collapses to
+        # the state in/out (no [T,N,3] counts, no x1, no coin tensor).
+        # Bit-identical to the unfused pallas path: same streams, and the
+        # vote histogram is the same integer sum tile-wise.  Mesh-safe:
+        # global-id offsets + psum of the local partial histogram.
+        from ..ops.pallas_round import (proposal_hist_pallas,
+                                        vote_commit_pallas)
+        interp = jax.default_backend() == "cpu"
+        hist1 = tally.class_histogram(state.x, alive, ctx)   # sent1 == x
+        vote_src = jnp.where(
+            killed, jnp.int32(-2),
+            jnp.where(frozen, state.x.astype(jnp.int32), jnp.int32(-1)))
+        hist2 = ctx.psum_nodes(proposal_hist_pallas(
+            base_key, r, rng.PHASE_PROPOSAL, hist1, vote_src,
+            m, N, interpret=interp,
+            node_offset=ctx.node_ids(N)[0],
+            trial_offset=ctx.trial_ids(T)[0]))
+        if cfg.coin_mode == "private":
+            shared = jnp.zeros((T,), jnp.int32)
+        else:
+            shared = rng.coin_flips(base_key, r, ctx.trial_ids(T),
+                                    rng.ids(1), common=True)[:, 0]
+        new_x, new_decided, new_k = vote_commit_pallas(
+            base_key, r, rng.PHASE_VOTE, hist2, state.x, state.decided,
+            state.k, killed, quorum_ok[:, 0], shared,
+            m, F, N, cfg.rule, cfg.coin_mode, float(cfg.coin_eps),
+            bool(cfg.freeze_decided), interpret=interp,
+            node_offset=ctx.node_ids(N)[0],
+            trial_offset=ctx.trial_ids(T)[0])
+        return NetState(x=new_x, decided=new_decided, k=new_k,
+                        killed=killed)
+
     # --- phase 1: "proposal phase" (node.ts:46-82) -----------------------
     # Dense sharded path: gather the (round-constant) alive mask once for
     # both phases instead of once per tally.  Equivocators (alive,

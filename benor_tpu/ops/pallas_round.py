@@ -1,0 +1,264 @@
+"""Fused round kernels: the whole Ben-Or round as two VMEM passes.
+
+r3 VERDICT item 2 (the HBM roofline gap): on the flagship path each
+phase's sampler kernel (ops/pallas_hist.py:cf_counts_pallas) writes int32
+counts [T, N, 3] (12 B/lane) that a chain of XLA elementwise kernels then
+re-reads — phase 1 to compute x1/vote values, phase 2 to compute
+decide0/decide1 (node.ts:99-104), plurality-adopt (node.ts:106-112), the
+coin (a separate pallas kernel, 4 B/lane write + read), and the commit
+masks — every intermediate materialized in HBM because XLA cannot fuse
+INTO a pallas call.  The two kernels here eliminate all of it:
+
+  proposal_hist_pallas  — per-lane proposal tallies + majority/tie + the
+                          vote value, reduced IN-KERNEL to a per-tile
+                          partial vote histogram (~1 B/lane out; the
+                          [T,N,3] counts and [T,N] x1 never exist).
+  vote_commit_pallas    — per-lane vote tallies + coin + decide/adopt/
+                          commit; HBM traffic is the state in/out only.
+
+Stream identity: the vote draws use the SAME key/counter scheme as
+cf_counts_pallas(phase=PHASE_VOTE) and the coin the SAME scheme as
+coin_flips_pallas / weak_coin_flips_pallas (word 0 = private bit, word 1 =
+deviation uniform), so a run with ``use_pallas_round=True`` is
+BIT-IDENTICAL to the unfused ``use_pallas_hist=True`` path — pinned by
+tests/test_pallas_round.py, which makes interpret-mode CPU testing exact
+rather than statistical.
+
+Engages (models/benor.py) on top of the pallas-hist regime for
+fault_model='crash', any rule, coin_mode private / common / weak_common
+with 0 < eps < 1 (the weak endpoints short-circuit to the plain streams on
+the XLA side, exactly like the unfused dispatch).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_hist import (_COIN_SALT, TILE_N, _bits_to_uniform, _cf_draw,
+                          _lane_ids, _stream_scal, _threefry2x32)
+from ..config import VAL0, VAL1, VALQ
+
+
+def _prop_hist_kernel(m, scal_ref, c0_ref, c1_ref, cq_ref, src_ref,
+                      out_ref):
+    """One lane-tile of the fused PROPOSAL phase: per-lane CF tallies ->
+    phase-1 majority/tie -> each lane's vote value -> this tile's partial
+    vote-class histogram.  NO per-lane output reaches HBM at all — the
+    [T, N, 3] proposal counts and the [T, N] x1 tensor of the unfused
+    path become one [T, 128]-padded partial per tile (~1 B/lane).
+
+    src_ref: VMEM int32 [T, TILE_N] vote source: -2 = dead (not counted),
+    -1 = live undecided (vote the in-kernel x1), 0/1/2 = frozen lane's
+    decided value (the reference's decided nodes keep vouching,
+    node.ts:147-157).  out_ref: VMEM int32 [1, T, 128] — columns 0..2 are
+    the tile's (c0, c1, cq) vote counts, the rest zero padding (a 3-wide
+    minor dim would fight Mosaic tiling).
+    """
+    node, trial = _lane_ids(scal_ref, src_ref.shape)
+    b0, b1 = _threefry2x32(scal_ref[0], scal_ref[1], node, trial)
+    u0 = _bits_to_uniform(b0)
+    u1 = _bits_to_uniform(b1)
+    c0 = c0_ref[...]
+    c1 = c1_ref[...]
+    cq = cq_ref[...]
+    total = c0 + c1 + cq
+    mf = jnp.float32(m)
+    p0 = _cf_draw(u0, total, c0, mf)
+    p1 = _cf_draw(u1, jnp.maximum(total - c0, 0.0), c1,
+                  jnp.maximum(mf - p0, 0.0))
+    x1 = jnp.where(p0 > p1, VAL0,
+                   jnp.where(p1 > p0, VAL1, VALQ))         # node.ts:63-69
+    src = src_ref[...]
+    vote = jnp.where(src == -1, x1, src)
+    alive = src != -2
+    t = src.shape[0]
+    parts = [jnp.sum((vote == v) & alive, axis=1,
+                     dtype=jnp.int32)[None, :, None]        # [1, T, 1]
+             for v in (VAL0, VAL1, VALQ)]
+    col = jax.lax.broadcasted_iota(jnp.int32, (1, t, 128), 2)
+    out_ref[...] = ((col == 0) * parts[0] + (col == 1) * parts[1]
+                    + (col == 2) * parts[2])
+
+
+@functools.partial(jax.jit, static_argnames=("m", "n_nodes", "interpret"))
+def proposal_hist_pallas(base_key: jax.Array, r: jax.Array, phase: int,
+                         hist: jax.Array, vote_src: jax.Array,
+                         m: int, n_nodes: int, interpret: bool = False,
+                         node_offset: jax.Array | int = 0,
+                         trial_offset: jax.Array | int = 0) -> jax.Array:
+    """Fused proposal phase -> this shard's LOCAL vote histogram int32
+    [T, 3] (callers psum it over the nodes axis under a mesh).
+
+    hist: int32 [T, 3] global PROPOSAL class counts; vote_src: int32
+    [T, N_local] (-2 dead / -1 undecided / 0,1,2 frozen value).  Uses the
+    PHASE_PROPOSAL stream of cf_counts_pallas verbatim, so the implied
+    per-lane x1 — and hence the histogram — is bit-identical to the
+    unfused pallas path (integer sums are order-free).
+    """
+    T = hist.shape[0]
+    n_pad = (-n_nodes) % TILE_N
+    np_total = n_nodes + n_pad
+
+    r = jnp.asarray(r, jnp.int32)
+    scal = _stream_scal(base_key, r, phase, node_offset, trial_offset)
+    cls = hist.astype(jnp.float32)[..., None]               # [T, 3, 1]
+    c0, c1, cq = cls[:, 0], cls[:, 1], cls[:, 2]
+    src = vote_src.astype(jnp.int32)
+    if n_pad:
+        src = jnp.pad(src, ((0, 0), (0, n_pad)), constant_values=-2)
+
+    vec = pl.BlockSpec((T, 1), lambda j: (0, 0), memory_space=pltpu.VMEM)
+    parts = pl.pallas_call(
+        functools.partial(_prop_hist_kernel, m),
+        out_shape=jax.ShapeDtypeStruct((np_total // TILE_N, T, 128),
+                                       jnp.int32),
+        grid=(np_total // TILE_N,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  vec, vec, vec,
+                  pl.BlockSpec((T, TILE_N), lambda j: (0, j),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1, T, 128), lambda j: (j, 0, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(scal, c0, c1, cq, src)
+    return jnp.sum(parts, axis=0)[:, :3]
+
+
+def _vote_commit_kernel(m, n_faulty, rule, coin_mode, eps, freeze,
+                        vote_scal_ref, coin_scal_ref, rk_ref,
+                        c0_ref, c1_ref, cq_ref, qok_ref, shared_ref,
+                        x_ref, dec_ref, k_ref, killed_ref,
+                        nx_ref, ndec_ref, nk_ref):
+    """One lane-tile: vote-phase CF draws -> decide/adopt/coin -> commit.
+
+    vote_scal_ref / coin_scal_ref: SMEM uint32 [4] stream keys (the
+    PHASE_VOTE sampler stream and the _COIN_SALT coin stream — identical
+    to the standalone kernels').  rk_ref: SMEM int32 [1] = r + 1 (the
+    committed k for lanes that run the round, node.ts:147).
+    c0/c1/cq_ref: VMEM f32 [T, 1] global vote-class counts;
+    qok_ref / shared_ref: VMEM int32 [T, 1] quorum gate / per-trial shared
+    coin bit; x/dec/k/killed_ref: VMEM int32 [T, TILE_N] current state.
+    """
+    # --- the sampler body, verbatim from pallas_hist._cf_kernel ---------
+    node, trial = _lane_ids(vote_scal_ref, nx_ref.shape)
+    b0, b1 = _threefry2x32(vote_scal_ref[0], vote_scal_ref[1], node, trial)
+    u0 = _bits_to_uniform(b0)
+    u1 = _bits_to_uniform(b1)
+    c0 = c0_ref[...]
+    c1 = c1_ref[...]
+    cq = cq_ref[...]
+    total = c0 + c1 + cq
+    mf = jnp.float32(m)
+    v0 = _cf_draw(u0, total, c0, mf)
+    v1 = _cf_draw(u1, jnp.maximum(total - c0, 0.0), c1,
+                  jnp.maximum(mf - v0, 0.0))
+
+    # --- the coin, verbatim from _coin_kernel / _weak_coin_kernel -------
+    pbits, dbits = _threefry2x32(coin_scal_ref[0], coin_scal_ref[1],
+                                 node, trial)
+    private = (pbits & jnp.uint32(1)).astype(jnp.int32)
+    if coin_mode == "private":
+        coin = private
+    elif coin_mode == "common":
+        coin = jnp.broadcast_to(shared_ref[...], private.shape)
+    else:  # weak_common, 0 < eps < 1
+        dev = _bits_to_uniform(dbits) < jnp.float32(eps)
+        coin = jnp.where(dev, private, shared_ref[...])
+
+    # --- decide / adopt / commit (models/benor.py lines 115-174) --------
+    ff = jnp.float32(n_faulty)
+    decide0 = v0 > ff                                    # node.ts:99
+    decide1 = v1 > ff                                    # node.ts:102
+    if rule == "reference":                              # quirk 9
+        any_votes = (v0 + v1) > 0.0
+        adopt0 = any_votes & (v0 > v1)
+        adopt1 = any_votes & (v0 < v1)
+        x2 = jnp.where(decide0, VAL0,
+             jnp.where(decide1, VAL1,
+             jnp.where(adopt0, VAL0,
+             jnp.where(adopt1, VAL1, coin))))
+    else:                                                # textbook
+        x2 = jnp.where(decide0, VAL0,
+             jnp.where(decide1, VAL1, coin))
+
+    x = x_ref[...]
+    decided = dec_ref[...]
+    killed = killed_ref[...]
+    alive = killed == 0
+    if freeze:
+        frozen = decided != 0
+    else:
+        frozen = jnp.zeros_like(alive)
+    active = alive & (qok_ref[...] != 0) & ~frozen
+    newly = active & (decide0 | decide1)
+    nx_ref[...] = jnp.where(active, x2, x)
+    ndec_ref[...] = jnp.where(newly, 1, decided)
+    nk_ref[...] = jnp.where(active, rk_ref[0], k_ref[...])
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "m", "n_faulty", "n_nodes", "rule", "coin_mode", "eps", "freeze",
+    "interpret"))
+def vote_commit_pallas(base_key: jax.Array, r: jax.Array, phase: int,
+                       hist: jax.Array, x: jax.Array, decided: jax.Array,
+                       k: jax.Array, killed: jax.Array,
+                       quorum_ok: jax.Array, shared: jax.Array,
+                       m: int, n_faulty: int, n_nodes: int, rule: str,
+                       coin_mode: str, eps: float, freeze: bool,
+                       interpret: bool = False,
+                       node_offset: jax.Array | int = 0,
+                       trial_offset: jax.Array | int = 0):
+    """Fused vote phase -> (new_x int8, new_decided bool, new_k int32).
+
+    hist: int32 [T, 3] global vote-class counts (psum'd under a mesh);
+    x int8 / decided bool / k int32 / killed bool [T, N] current state;
+    quorum_ok bool [T]; shared int32-able [T] per-trial shared coin bit
+    (ignored for coin_mode='private').  Drop-in replacement for
+    cf_counts_pallas(vote) + coin kernel + the XLA decide/adopt/commit
+    chain — bit-identical to that unfused pallas path by stream identity.
+    """
+    T = hist.shape[0]
+    n_pad = (-n_nodes) % TILE_N
+    np_total = n_nodes + n_pad
+
+    r = jnp.asarray(r, jnp.int32)
+    vote_scal = _stream_scal(base_key, r, phase, node_offset, trial_offset)
+    coin_scal = _stream_scal(base_key, r, _COIN_SALT, node_offset,
+                             trial_offset)
+    rk = (r + 1).reshape(1)
+
+    cls = hist.astype(jnp.float32)[..., None]               # [T, 3, 1]
+    c0, c1, cq = cls[:, 0], cls[:, 1], cls[:, 2]            # [T, 1]
+    qok = quorum_ok.astype(jnp.int32)[:, None]
+    sh = shared.astype(jnp.int32)[:, None]
+
+    def pad(a, fill):
+        a = a.astype(jnp.int32)
+        if n_pad:
+            a = jnp.pad(a, ((0, 0), (0, n_pad)), constant_values=fill)
+        return a
+
+    state_in = (pad(x, VALQ), pad(decided, 0), pad(k, 0), pad(killed, 1))
+
+    vec = pl.BlockSpec((T, 1), lambda j: (0, 0), memory_space=pltpu.VMEM)
+    lane = pl.BlockSpec((T, TILE_N), lambda j: (0, j),
+                        memory_space=pltpu.VMEM)
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    nx, ndec, nk = pl.pallas_call(
+        functools.partial(_vote_commit_kernel, m, n_faulty, rule,
+                          coin_mode, eps, freeze),
+        out_shape=[jax.ShapeDtypeStruct((T, np_total), jnp.int32)] * 3,
+        grid=(np_total // TILE_N,),
+        in_specs=[smem, smem, smem, vec, vec, vec, vec, vec,
+                  lane, lane, lane, lane],
+        out_specs=[lane] * 3,
+        interpret=interpret,
+    )(vote_scal, coin_scal, rk, c0, c1, cq, qok, sh, *state_in)
+    return (nx[:, :n_nodes].astype(jnp.int8),
+            ndec[:, :n_nodes].astype(bool),
+            nk[:, :n_nodes])

@@ -48,6 +48,20 @@ def pallas_equiv_active(cfg: SimConfig) -> bool:
     return pallas_stream_active(cfg) and cfg.fault_model == "equivocate"
 
 
+def pallas_round_active(cfg: SimConfig) -> bool:
+    """True iff the fully-fused vote-phase kernel (ops/pallas_round.py)
+    serves this config: the pallas-hist CF regime, crash faults, and a
+    coin the kernel can produce in-VMEM (private / common / weak with
+    0 < eps < 1 — the weak endpoints short-circuit to plain streams on
+    the XLA side, mirroring the unfused dispatch in models/benor.py)."""
+    if not (cfg.use_pallas_round and pallas_hist_active(cfg)
+            and cfg.fault_model == "crash"):
+        return False
+    if cfg.coin_mode == "weak_common":
+        return 0.0 < cfg.coin_eps < 1.0
+    return cfg.coin_mode in ("private", "common")
+
+
 def dense_gather_needed(cfg: SimConfig) -> bool:
     """True iff receiver_counts will take the dense masked path (and thus
     gather sender arrays).  Callers use this to prefetch the round-constant

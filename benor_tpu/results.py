@@ -58,6 +58,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Dict, List
 
 import numpy as np
@@ -277,7 +278,9 @@ def oracle_parity(trials: int, seed: int = 0, n: int = 100, f: int = 40,
     cfg_o = SimConfig(n_nodes=n, n_faulty=f, backend="native",
                       max_rounds=64, oracle_order="shuffle")
     seeds = np.arange(s_seeds, dtype=np.uint32)
+    t0 = time.perf_counter()
     out_s = native_oracle.run_batch(cfg_o, vals, faulty, seeds)
+    oracle_elapsed = time.perf_counter() - t0
     out_f = native_oracle.run_batch(cfg_o.replace(oracle_order="fifo"),
                                     vals, faulty, seeds)
     # the invariance theorem covers DECIDED runs only (a run capped
@@ -309,7 +312,8 @@ def oracle_parity(trials: int, seed: int = 0, n: int = 100, f: int = 40,
                                          minlength=8)[:8].tolist(),
         "tpu_round_hist": np.bincount(k_tpu, minlength=8)[:8].tolist(),
         "ks_statistic": round(stat, 5), "ks_pvalue": round(pvalue, 5),
-        "oracle_msgs_per_sec": None,
+        "oracle_msgs_per_sec": round(
+            float(out_s["steps"].sum()) / max(oracle_elapsed, 1e-9), 1),
     }
     if verbose:
         print(f"  order-invariant (fifo==shuffle, decided): "

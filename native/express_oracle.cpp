@@ -321,11 +321,11 @@ int64_t benor_express_run_batch(int32_t n, int32_t f, int32_t max_rounds,
                                 uint8_t *out_decided, int32_t *out_k,
                                 uint8_t *out_killed, int64_t *out_steps) {
   int64_t tripped = 0;
-  std::vector<uint8_t> killed0(faulty, faulty + n);
   for (int64_t s = 0; s < n_seeds; s++) {
-    std::vector<uint8_t> killed = killed0;  // fresh initial mask per seed
+    // initial killed mask == the faulty mask (no pre-start /stop in batch
+    // mode); the ctor only reads it, so the same buffer serves every seed
     Oracle o(n, f, max_rounds, seeds[s], step_cap, order, initial_values,
-             faulty, killed.data());
+             faulty, faulty);
     int64_t steps = o.start();
     out_steps[s] = steps;
     if (steps < 0) tripped++;

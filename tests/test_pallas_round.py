@@ -1,0 +1,170 @@
+"""The fused vote-phase kernel (ops/pallas_round.py, r3 VERDICT item 2).
+
+The kernel folds the CF vote sampler + coin + decide/adopt/commit chain
+into one VMEM pass.  Because it reuses the EXACT streams of the unfused
+pallas path (cf_counts_pallas's PHASE_VOTE key, the _COIN_SALT coin
+block), a use_pallas_round=True run must be BIT-IDENTICAL to the
+use_pallas_hist=True run — which makes these interpret-mode CPU tests
+exact pins, not statistical gates.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from benor_tpu.config import SimConfig
+from benor_tpu.ops import sampling, tally
+from benor_tpu.sim import run_consensus
+from benor_tpu.state import FaultSpec, init_state
+from benor_tpu.sweep import balanced_inputs
+
+N, T = 96, 8
+
+
+def _run(use_round, table_max=4, **kw):
+    """Full consensus run in the forced CF regime (quorum > table_max)."""
+    old = sampling.EXACT_TABLE_MAX
+    sampling.EXACT_TABLE_MAX = table_max
+    try:
+        cfg = SimConfig(n_nodes=N, trials=T, delivery="quorum",
+                        scheduler="uniform", path="histogram",
+                        use_pallas_hist=True, use_pallas_round=use_round,
+                        max_rounds=24, **kw)
+        if use_round:
+            assert tally.pallas_round_active(cfg)
+        faults = (FaultSpec.first_f(cfg) if cfg.n_faulty
+                  else FaultSpec.none(T, N))
+        state = init_state(cfg, balanced_inputs(T, N), faults)
+        r, fin = run_consensus(cfg, state, faults, jax.random.key(cfg.seed))
+        return int(r), fin
+    finally:
+        sampling.EXACT_TABLE_MAX = old
+
+
+def _assert_same(a, b):
+    (ra, fa), (rb, fb) = a, b
+    assert ra == rb
+    np.testing.assert_array_equal(np.asarray(fa.x), np.asarray(fb.x))
+    np.testing.assert_array_equal(np.asarray(fa.decided),
+                                  np.asarray(fb.decided))
+    np.testing.assert_array_equal(np.asarray(fa.k), np.asarray(fb.k))
+
+
+@pytest.mark.parametrize("kw", [
+    dict(n_faulty=24, seed=3),                             # crash faults
+    dict(n_faulty=30, seed=5, rule="textbook"),
+    dict(n_faulty=24, seed=7, coin_mode="common"),
+    dict(n_faulty=24, seed=9, coin_mode="weak_common", coin_eps=0.5),
+    dict(n_faulty=24, seed=11, freeze_decided=False),
+    dict(n_faulty=0, seed=13),                             # fault-free
+], ids=["crash", "textbook", "common", "weak", "nofreeze", "faultfree"])
+def test_fused_bit_identical_to_unfused_pallas(kw):
+    _assert_same(_run(False, **kw), _run(True, **kw))
+
+
+def test_fused_bit_identical_zero_crash_multiround():
+    """Balanced inputs + zero crashes + F > N/3: the genuinely multi-round
+    flagship regime (sampling noise random-walk, several coin rounds)."""
+    old = sampling.EXACT_TABLE_MAX
+    sampling.EXACT_TABLE_MAX = 4
+    try:
+        outs = []
+        for use_round in (False, True):
+            cfg = SimConfig(n_nodes=N, n_faulty=40, trials=T,
+                            delivery="quorum", scheduler="uniform",
+                            path="histogram", use_pallas_hist=True,
+                            use_pallas_round=use_round, max_rounds=32,
+                            seed=2)
+            faults = FaultSpec.none(T, N)
+            state = init_state(cfg, balanced_inputs(T, N), faults)
+            r, fin = run_consensus(cfg, state, faults,
+                                   jax.random.key(cfg.seed))
+            outs.append((int(r), fin))
+        _assert_same(*outs)
+        assert outs[0][0] > 1, "regime must be multi-round to be a real pin"
+    finally:
+        sampling.EXACT_TABLE_MAX = old
+
+
+def test_fused_bit_identical_stalled_quorum():
+    """A trial with fewer live senders than the quorum stalls forever on
+    both paths (quorum_ok gating inside the kernel)."""
+    old = sampling.EXACT_TABLE_MAX
+    sampling.EXACT_TABLE_MAX = 4
+    try:
+        outs = []
+        for use_round in (False, True):
+            cfg = SimConfig(n_nodes=N, n_faulty=24, trials=T,
+                            delivery="quorum", scheduler="uniform",
+                            path="histogram", use_pallas_hist=True,
+                            use_pallas_round=use_round, max_rounds=8,
+                            seed=4)
+            # kill MORE than F lanes: alive < quorum in every trial
+            faulty = np.zeros(N, bool)
+            faulty[:24] = True
+            faults = FaultSpec.from_faulty_list(cfg, faulty)
+            state = init_state(cfg, balanced_inputs(T, N), faults)
+            state = state.__class__(
+                x=state.x, decided=state.decided, k=state.k,
+                killed=state.killed.at[:, :30].set(True))
+            r, fin = run_consensus(cfg, state, faults,
+                                   jax.random.key(cfg.seed))
+            outs.append((int(r), fin))
+        _assert_same(*outs)
+        assert not np.asarray(outs[0][1].decided).any()
+    finally:
+        sampling.EXACT_TABLE_MAX = old
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mesh_shape", [(2, 4), (4, 2)])
+def test_fused_sharded_bit_identical(mesh_shape):
+    from benor_tpu.parallel import make_mesh, run_consensus_sharded
+
+    old = sampling.EXACT_TABLE_MAX
+    sampling.EXACT_TABLE_MAX = 4
+    try:
+        cfg = SimConfig(n_nodes=32, n_faulty=12, trials=8,
+                        delivery="quorum", scheduler="uniform",
+                        path="histogram", use_pallas_hist=True,
+                        use_pallas_round=True, max_rounds=16, seed=6)
+        faults = FaultSpec.none(cfg.trials, cfg.n_nodes)
+        state = init_state(cfg, balanced_inputs(cfg.trials, cfg.n_nodes),
+                           faults)
+        key = jax.random.key(cfg.seed)
+        r1, f1 = run_consensus(cfg, state, faults, key)
+        r2, f2 = run_consensus_sharded(cfg, state, faults, key,
+                                       make_mesh(*mesh_shape))
+        assert int(r1) == int(r2)
+        np.testing.assert_array_equal(np.asarray(f1.x), np.asarray(f2.x))
+        np.testing.assert_array_equal(np.asarray(f1.decided),
+                                      np.asarray(f2.decided))
+        np.testing.assert_array_equal(np.asarray(f1.k), np.asarray(f2.k))
+    finally:
+        sampling.EXACT_TABLE_MAX = old
+
+
+def test_gating():
+    base = dict(n_nodes=N, n_faulty=24, trials=T, delivery="quorum",
+                scheduler="uniform", path="histogram",
+                use_pallas_hist=True, use_pallas_round=True)
+    old = sampling.EXACT_TABLE_MAX
+    sampling.EXACT_TABLE_MAX = 4
+    try:
+        assert tally.pallas_round_active(SimConfig(**base))
+        # off without the flag, the hist kernel, the CF regime, or crash
+        assert not tally.pallas_round_active(
+            SimConfig(**{**base, "use_pallas_round": False}))
+        assert not tally.pallas_round_active(
+            SimConfig(**{**base, "use_pallas_hist": False}))
+        assert not tally.pallas_round_active(
+            SimConfig(**{**base, "fault_model": "byzantine"}))
+        assert not tally.pallas_round_active(
+            SimConfig(**{**base, "scheduler": "adversarial"}))
+        # weak-coin endpoints short-circuit to plain streams (XLA side)
+        assert not tally.pallas_round_active(SimConfig(
+            **{**base, "coin_mode": "weak_common", "coin_eps": 0.0}))
+        assert tally.pallas_round_active(SimConfig(
+            **{**base, "coin_mode": "weak_common", "coin_eps": 0.4}))
+    finally:
+        sampling.EXACT_TABLE_MAX = old
