@@ -214,7 +214,7 @@ def main(argv=None) -> int:
     s.add_argument("--trials", type=int, default=256)
     s.add_argument("--max-rounds", type=int, default=64)
     s.add_argument("--scheduler",
-                   choices=("uniform", "biased", "adversarial"),
+                   choices=("uniform", "biased", "adversarial", "targeted"),
                    default="uniform")
     s.add_argument("--coin", choices=("private", "common"), default="private")
     s.add_argument("--fault-model",

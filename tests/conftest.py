@@ -21,3 +21,12 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compile cache (repo-root .jax_cache, shared with bench/CLI):
+# the suite's wall-clock is compile-dominated — every distinct SimConfig
+# re-jits its while-loop — so a warm cache cuts the `-m "not slow"`
+# iteration lane by several-fold on repeat runs.  Results are unaffected
+# (the cache stores XLA executables keyed on HLO + platform).
+from benor_tpu.utils.cache import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()

@@ -161,6 +161,7 @@ def test_resume_with_crash_at_round_bit_identical(tmp_path):
     assert np.asarray(final_res.killed)[:, :f][:, due].all()
 
 
+@pytest.mark.slow
 def test_resume_preserves_custom_base_key(tmp_path):
     """A run started with a non-default key resumes on the SAME streams."""
     cfg, state, faults = _setup()

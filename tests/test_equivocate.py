@@ -104,6 +104,7 @@ def test_dense_vs_histogram_parity():
 # ---------------------------------------------------------------------------
 # Structural invariants of the tallied counts.
 # ---------------------------------------------------------------------------
+@pytest.mark.slow
 def test_dense_counts_sum_to_quorum_and_exclude_equivocator_slots():
     n, f, trials = 24, 6, 16
     cfg = _cfg(n, f, "dense", trials=trials, seed=5)
@@ -125,6 +126,7 @@ def test_dense_counts_sum_to_quorum_and_exclude_equivocator_slots():
     assert not np.array_equal(c, np.asarray(counts2))
 
 
+@pytest.mark.slow
 def test_all_delivery_tallies_every_sender():
     n, f, trials = 20, 5, 8
     cfg = SimConfig(n_nodes=n, n_faulty=f, delivery="all", trials=trials,
@@ -165,6 +167,7 @@ def test_validity_holds_under_equivocation(path):
     assert dec.all() and int(rounds) < cfg.max_rounds
 
 
+@pytest.mark.slow
 def test_all_delivery_small_f_split_is_exact():
     """With trial-global n_equiv the 'all'-delivery class split uses the
     exact shared-CDF binomial table: at F=2 the per-receiver byz-ones

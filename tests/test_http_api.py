@@ -154,6 +154,7 @@ _LIVELOCK = dict(n=10, f=5, vals=[1, 1, 0, 0, 1, 1, 0, 0, 1, 1],
 
 @pytest.mark.parametrize("scenario", ["livelock", "decides"])
 @pytest.mark.parametrize("poll_rounds", [1, 3])
+@pytest.mark.slow
 def test_poll_rounds_final_state_bit_identical(scenario, poll_rounds):
     """Sliced execution must change WHEN snapshots are visible, never what
     the final one is: every observable field and rounds_executed match the
@@ -190,6 +191,7 @@ def test_poll_rounds_observes_live_undecided_network():
     assert net.get_state(5)["k"] > 10
 
 
+@pytest.mark.slow
 def test_poll_rounds_http_getstate_sees_live_network():
     """Over real sockets: /getState DURING /start returns an undecided
     snapshot with 1 <= k < final (the reference's poll loop observation).

@@ -58,10 +58,12 @@ def _assert_same(a, b):
     dict(n_faulty=24, seed=11, freeze_decided=False),
     dict(n_faulty=0, seed=13),                             # fault-free
 ], ids=["crash", "textbook", "common", "weak", "nofreeze", "faultfree"])
+@pytest.mark.slow
 def test_fused_bit_identical_to_unfused_pallas(kw):
     _assert_same(_run(False, **kw), _run(True, **kw))
 
 
+@pytest.mark.slow
 def test_fused_bit_identical_zero_crash_multiround():
     """Balanced inputs + zero crashes + F > N/3: the genuinely multi-round
     flagship regime (sampling noise random-walk, several coin rounds)."""
@@ -86,6 +88,7 @@ def test_fused_bit_identical_zero_crash_multiround():
         sampling.EXACT_TABLE_MAX = old
 
 
+@pytest.mark.slow
 def test_fused_bit_identical_stalled_quorum():
     """A trial with fewer live senders than the quorum stalls forever on
     both paths (quorum_ok gating inside the kernel)."""

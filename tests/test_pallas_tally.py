@@ -18,6 +18,7 @@ from benor_tpu.sim import simulate
 
 @pytest.mark.parametrize("shape", [(2, 64, 64), (1, 128, 128),
                                    (3, 120, 120), (2, 200, 200)])
+@pytest.mark.slow
 def test_kernel_matches_xla_dense_counts(shape):
     T, R, S = shape
     k1, k2, k3 = jax.random.split(jax.random.key(0), 3)

@@ -56,6 +56,7 @@ def test_validity_unanimous(path, v):
 
 
 @pytest.mark.parametrize("scheduler", ["uniform", "biased"])
+@pytest.mark.slow
 def test_termination_under_threshold(scheduler):
     """F < N/2 with a fair/bounded scheduler: every trial terminates."""
     x, decided, k, healthy = _run(
@@ -65,6 +66,7 @@ def test_termination_under_threshold(scheduler):
 
 
 @pytest.mark.parametrize("path", ["dense", "histogram"])
+@pytest.mark.slow
 def test_textbook_rule_agreement_and_termination(path):
     """rule='textbook' (coin whenever no value has > F votes — classic
     Ben-Or, no plurality-adopt) still satisfies agreement and terminates
@@ -98,6 +100,7 @@ def test_textbook_coin_contrast_under_adversary():
     assert dec[healthy.astype(bool)].all(), "common coin must converge"
 
 
+@pytest.mark.slow
 def test_no_decision_value_is_question_mark():
     """Decided lanes never hold "?" — decisions are on 0/1 only."""
     x, decided, _, healthy = _run(25, 8, 64, 17)
@@ -141,6 +144,7 @@ def test_byzantine_quorum_sampling_breaks_reference_rule():
         "under Byzantine faults + quorum sampling")
 
 
+@pytest.mark.slow
 def test_crash_at_round_kills_and_network_survives():
     """crash_at_round: faulty lanes die at their round; with quorum still
     available the healthy majority terminates."""

@@ -76,6 +76,7 @@ class TestHypergeomExact:
         assert np.abs(draws - exact).max() <= max(2.0, 0.02 * dist.std()), \
             f"CF quantile error {np.abs(draws - exact).max()} counts"
 
+    @pytest.mark.slow
     def test_multivariate_large_m_uses_approx_and_sums(self):
         T, N = 4, 1024
         m = sampling.EXACT_TABLE_MAX + 1000
@@ -250,6 +251,7 @@ class TestApproxRegimeProtocol:
                        cf.std() / len(cf) ** 0.5)
         assert abs(exact.mean() - cf.mean()) < 4 * sem + 1e-9
 
+    @pytest.mark.slow
     def test_cf_forced_seed_control_m495(self):
         """Control: two seeds of the SAME (exact) regime pass the same
         gates, so the comparison above is calibrated, not vacuous."""

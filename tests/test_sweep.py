@@ -38,6 +38,7 @@ def test_rounds_vs_f_monotone_ish():
     assert pts[0].mean_k <= pts[-1].mean_k + 0.5  # noise tolerance
 
 
+@pytest.mark.slow
 def test_coin_comparison_adversarial_contrast():
     """Count-controlling adversary: private coin livelocks, common escapes.
 
@@ -145,6 +146,7 @@ class TestWeakCommonCoin:
         # the transition brackets the predicted eps* = 1 - f = 0.6
         assert fracs[1] > 0.9 and fracs[-1] < 0.5, fracs
 
+    @pytest.mark.slow
     def test_mesh_bit_identity(self):
         import jax
 
@@ -174,6 +176,7 @@ class TestWeakCommonCoin:
         with pytest.raises(ValueError, match="weak_common"):
             SimConfig(n_nodes=4, n_faulty=0, coin_eps=0.5)
 
+    @pytest.mark.slow
     def test_critical_line_shifts_under_equivocation(self):
         """Weak coins vs EQUIVOCATING adversaries compose predictably: the
         adversary ties iff deviating-minority + free pool reach the tie
@@ -247,6 +250,7 @@ def test_results_generator_end_to_end(tmp_path):
     assert (tmp_path / "results.json").exists()
 
 
+@pytest.mark.slow
 def test_save_points_roundtrip(tmp_path):
     cfg = SimConfig(n_nodes=10, n_faulty=2, trials=8, delivery="quorum",
                     scheduler="uniform", seed=8)
@@ -285,6 +289,7 @@ class TestCli:
         from benor_tpu.__main__ import main
         assert main(["demo", "-n", "4", "-f", "3"]) == 1  # start.ts:25-29
 
+    @pytest.mark.slow
     def test_sweep_cli(self, tmp_path, capsys):
         from benor_tpu.__main__ import main
         out = str(tmp_path / "s.json")
@@ -370,6 +375,7 @@ class TestCli:
         assert probed == []
         assert calls == []
 
+    @pytest.mark.slow
     def test_coins_cli_weak_rows(self, capsys):
         from benor_tpu.__main__ import main
         assert main(["coins", "--n", "20", "--f", "6", "--trials", "8",
@@ -377,6 +383,7 @@ class TestCli:
         out = capsys.readouterr().out
         assert "weak_common(eps=0.1):" in out
 
+    @pytest.mark.slow
     def test_sweep_cli_balanced(self, tmp_path, capsys):
         """--balanced: zero crashes + balanced inputs (the science regime);
         points carry the disagree_frac field."""

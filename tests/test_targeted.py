@@ -137,6 +137,7 @@ class TestRealizability:
         return np.array(sent), alive
 
     @pytest.mark.parametrize("seed", [0, 1, 2])
+    @pytest.mark.slow
     def test_targeted_counts_realizable(self, seed):
         trials, n, f = 8, 64, 20
         cfg = SimConfig(n_nodes=n, n_faulty=f, trials=trials,

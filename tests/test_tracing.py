@@ -33,6 +33,7 @@ def test_round_events_emitted_in_order():
     assert decs[-1] == int(np.asarray(final.decided).sum())
 
 
+@pytest.mark.slow
 def test_debug_off_emits_nothing():
     rows = []
     sink = lambda *a: rows.append(a)
