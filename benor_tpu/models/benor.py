@@ -88,10 +88,18 @@ def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
         from ..ops.pallas_round import (proposal_hist_pallas,
                                         vote_commit_pallas)
         interp = jax.default_backend() == "cpu"
-        hist1 = tally.class_histogram(state.x, alive, ctx)   # sent1 == x
+        hist1 = tally.class_histogram(_sent_values(cfg, state.x, faults),
+                                      alive, ctx)
+        # vote source per lane: -2 dead, -1 undecided (kernel computes
+        # x1), -3 undecided byzantine (kernel flips its x1), else the
+        # frozen lane's broadcast value (byzantine pre-flipped here)
+        undec = jnp.int32(-1) if cfg.fault_model != "byzantine" else \
+            jnp.where(faults.faulty, jnp.int32(-3), jnp.int32(-1))
         vote_src = jnp.where(
             killed, jnp.int32(-2),
-            jnp.where(frozen, state.x.astype(jnp.int32), jnp.int32(-1)))
+            jnp.where(frozen,
+                      _sent_values(cfg, state.x, faults).astype(jnp.int32),
+                      undec))
         hist2 = ctx.psum_nodes(proposal_hist_pallas(
             base_key, r, rng.PHASE_PROPOSAL, hist1, vote_src,
             m, N, interpret=interp,

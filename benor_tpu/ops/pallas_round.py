@@ -53,11 +53,14 @@ def _prop_hist_kernel(m, scal_ref, c0_ref, c1_ref, cq_ref, src_ref,
     path become one [T, 128]-padded partial per tile (~1 B/lane).
 
     src_ref: VMEM int32 [T, TILE_N] vote source: -2 = dead (not counted),
-    -1 = live undecided (vote the in-kernel x1), 0/1/2 = frozen lane's
-    decided value (the reference's decided nodes keep vouching,
-    node.ts:147-157).  out_ref: VMEM int32 [1, T, 128] — columns 0..2 are
-    the tile's (c0, c1, cq) vote counts, the rest zero padding (a 3-wide
-    minor dim would fight Mosaic tiling).
+    -1 = live undecided (vote the in-kernel x1), -3 = live undecided
+    byzantine (vote the BIT-FLIP of the in-kernel x1 — every receiver
+    hears the flipped broadcast, models/benor.py:_flip), 0/1/2 = frozen
+    lane's decided value, pre-flipped by the caller where byzantine (the
+    reference's decided nodes keep vouching, node.ts:147-157).
+    out_ref: VMEM int32 [1, T, 128] — columns 0..2 are the tile's
+    (c0, c1, cq) vote counts, the rest zero padding (a 3-wide minor dim
+    would fight Mosaic tiling).
     """
     node, trial = _lane_ids(scal_ref, src_ref.shape)
     b0, b1 = _threefry2x32(scal_ref[0], scal_ref[1], node, trial)
@@ -73,8 +76,10 @@ def _prop_hist_kernel(m, scal_ref, c0_ref, c1_ref, cq_ref, src_ref,
                   jnp.maximum(mf - p0, 0.0))
     x1 = jnp.where(p0 > p1, VAL0,
                    jnp.where(p1 > p0, VAL1, VALQ))         # node.ts:63-69
+    x1_flip = jnp.where(x1 == VAL0, VAL1,
+                        jnp.where(x1 == VAL1, VAL0, VALQ))
     src = src_ref[...]
-    vote = jnp.where(src == -1, x1, src)
+    vote = jnp.where(src == -1, x1, jnp.where(src == -3, x1_flip, src))
     alive = src != -2
     t = src.shape[0]
     parts = [jnp.sum((vote == v) & alive, axis=1,

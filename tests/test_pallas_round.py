@@ -32,7 +32,9 @@ def _run(use_round, table_max=4, **kw):
                         max_rounds=24, **kw)
         if use_round:
             assert tally.pallas_round_active(cfg)
-        faults = (FaultSpec.first_f(cfg) if cfg.n_faulty
+        cr = (np.where(np.arange(N) < cfg.n_faulty, 3, 0)
+              if cfg.fault_model == "crash_at_round" else None)
+        faults = (FaultSpec.first_f(cfg, crash_rounds=cr) if cfg.n_faulty
                   else FaultSpec.none(T, N))
         state = init_state(cfg, balanced_inputs(T, N), faults)
         r, fin = run_consensus(cfg, state, faults, jax.random.key(cfg.seed))
@@ -57,7 +59,10 @@ def _assert_same(a, b):
     dict(n_faulty=24, seed=9, coin_mode="weak_common", coin_eps=0.5),
     dict(n_faulty=24, seed=11, freeze_decided=False),
     dict(n_faulty=0, seed=13),                             # fault-free
-], ids=["crash", "textbook", "common", "weak", "nofreeze", "faultfree"])
+    dict(n_faulty=20, seed=15, fault_model="byzantine"),
+    dict(n_faulty=20, seed=17, fault_model="crash_at_round"),
+], ids=["crash", "textbook", "common", "weak", "nofreeze", "faultfree",
+        "byzantine", "crash-at-round"])
 @pytest.mark.slow
 def test_fused_bit_identical_to_unfused_pallas(kw):
     _assert_same(_run(False, **kw), _run(True, **kw))
@@ -155,13 +160,19 @@ def test_gating():
     sampling.EXACT_TABLE_MAX = 4
     try:
         assert tally.pallas_round_active(SimConfig(**base))
-        # off without the flag, the hist kernel, the CF regime, or crash
+        # byzantine / crash_at_round ride the flip sentinel + per-round
+        # killed mask; equivocate has its own (unfused) kernel
+        assert tally.pallas_round_active(
+            SimConfig(**{**base, "fault_model": "byzantine"}))
+        assert tally.pallas_round_active(
+            SimConfig(**{**base, "fault_model": "crash_at_round"}))
+        assert not tally.pallas_round_active(
+            SimConfig(**{**base, "fault_model": "equivocate"}))
+        # off without the flag, the hist kernel, or the uniform scheduler
         assert not tally.pallas_round_active(
             SimConfig(**{**base, "use_pallas_round": False}))
         assert not tally.pallas_round_active(
             SimConfig(**{**base, "use_pallas_hist": False}))
-        assert not tally.pallas_round_active(
-            SimConfig(**{**base, "fault_model": "byzantine"}))
         assert not tally.pallas_round_active(
             SimConfig(**{**base, "scheduler": "adversarial"}))
         # weak-coin endpoints short-circuit to plain streams (XLA side)
