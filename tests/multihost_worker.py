@@ -124,6 +124,46 @@ def main() -> None:
             print(f"worker{pid}[resume]: cut@2 + resume == uninterrupted "
                   f"(rounds={int(r_res)}) OK", flush=True)
 
+    # round-4 paths across REAL process boundaries: the targeted
+    # (partitioned) adversary's closed form and the fully-fused round
+    # kernels — both must stay bit-identical when the mesh spans hosts
+    from benor_tpu.ops import sampling
+
+    extra = [
+        ("targeted", dict(scheduler="targeted"), None),
+        ("fused-round", dict(use_pallas_hist=True, use_pallas_round=True),
+         4),
+    ]
+    for label, overrides, table_max in extra:
+        old_tm = sampling.EXACT_TABLE_MAX
+        try:
+            if table_max is not None:
+                sampling.EXACT_TABLE_MAX = table_max
+            kw = dict(n_nodes=N, n_faulty=8, trials=T, delivery="quorum",
+                      scheduler="uniform", path="histogram", max_rounds=16,
+                      seed=9)
+            kw.update(overrides)
+            cfg = SimConfig(**kw)
+            faults = FaultSpec.none(T, N)
+            full = init_state(cfg, np.tile((np.arange(N) % 2)
+                                           .astype(np.int8), (T, 1)), faults)
+            base_key = jax.random.key(cfg.seed)
+            r1, f1 = run_consensus(cfg, full, faults, base_key)
+            gstate, gfaults = assemble(mesh)
+            r, fin = run_consensus_multihost(cfg, gstate, gfaults,
+                                             base_key, mesh)
+            for leaf in ("x", "decided", "k", "killed"):
+                got = np.asarray(multihost_utils.process_allgather(
+                    getattr(fin, leaf), tiled=True))
+                np.testing.assert_array_equal(
+                    got, np.asarray(getattr(f1, leaf)),
+                    err_msg=f"{label}:{leaf}")
+            assert int(r) == int(r1)
+            print(f"worker{pid}[{label}]: cross-process bit-identical OK",
+                  flush=True)
+        finally:
+            sampling.EXACT_TABLE_MAX = old_tm
+
     jax.distributed.shutdown()
 
 

@@ -92,6 +92,48 @@ def test_resume_bit_identical_new_streams(tmp_path):
 
 
 @pytest.mark.slow
+def test_resume_bit_identical_fused_round(tmp_path):
+    """Cut + resume with the fully-fused round kernels engaged
+    (use_pallas_round): the kernel streams are keyed on (key, round,
+    phase, global ids) like everything else, so the guarantee carries."""
+    from benor_tpu.ops import sampling
+    from benor_tpu.sweep import balanced_inputs
+
+    old = sampling.EXACT_TABLE_MAX
+    sampling.EXACT_TABLE_MAX = 4
+    try:
+        n, f = 96, 40
+        cfg = SimConfig(n_nodes=n, n_faulty=f, trials=16, max_rounds=48,
+                        delivery="quorum", scheduler="uniform",
+                        path="histogram", use_pallas_hist=True,
+                        use_pallas_round=True, seed=5)
+        from benor_tpu.ops import tally
+        assert tally.pallas_round_active(cfg)
+        faults = FaultSpec.none(16, n)
+        state = init_state(cfg, balanced_inputs(16, n), faults)
+        base_key = jax.random.key(cfg.seed)
+
+        rounds_full, final_full = run_consensus(cfg, state, faults,
+                                                base_key)
+        assert int(rounds_full) >= 3, "config must take several rounds"
+
+        cfg_cap = cfg.replace(max_rounds=2)
+        rounds_cap, mid = run_consensus(cfg_cap, state, faults, base_key)
+        path = str(tmp_path / "ckpt.npz")
+        save_checkpoint(path, cfg, mid, faults,
+                        next_round=int(rounds_cap) + 1)
+
+        rounds_res, final_res, _ = resume_from(path)
+        assert int(rounds_res) == int(rounds_full)
+        for leaf in ("x", "decided", "k", "killed"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(final_res, leaf)),
+                np.asarray(getattr(final_full, leaf)), err_msg=leaf)
+    finally:
+        sampling.EXACT_TABLE_MAX = old
+
+
+@pytest.mark.slow
 def test_resume_on_mesh_bit_identical(tmp_path):
     """A single-device checkpoint resumes on a device mesh (and the result
     is bit-identical to the uninterrupted single-device run): checkpoints
