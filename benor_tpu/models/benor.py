@@ -79,46 +79,25 @@ def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
 
     if tally.pallas_round_active(cfg):
         # Fully-fused round (r3 VERDICT item 2): BOTH phases run as pallas
-        # kernels (ops/pallas_round.py) with the decide/adopt/coin/commit
-        # chain inside the vote kernel — per-lane HBM traffic collapses to
-        # the state in/out (no [T,N,3] counts, no x1, no coin tensor).
-        # Bit-identical to the unfused pallas path: same streams, and the
-        # vote histogram is the same integer sum tile-wise.  Mesh-safe:
-        # global-id offsets + psum of the local partial histogram.
-        from ..ops.pallas_round import (proposal_hist_pallas,
-                                        vote_commit_pallas)
-        interp = jax.default_backend() == "cpu"
-        hist1 = tally.class_histogram(_sent_values(cfg, state.x, faults),
-                                      alive, ctx)
-        # vote source per lane: -2 dead, -1 undecided (kernel computes
-        # x1), -3 undecided byzantine (kernel flips its x1), else the
-        # frozen lane's broadcast value (byzantine pre-flipped here)
-        undec = jnp.int32(-1) if cfg.fault_model != "byzantine" else \
-            jnp.where(faults.faulty, jnp.int32(-3), jnp.int32(-1))
-        vote_src = jnp.where(
-            killed, jnp.int32(-2),
-            jnp.where(frozen,
-                      _sent_values(cfg, state.x, faults).astype(jnp.int32),
-                      undec))
-        hist2 = ctx.psum_nodes(proposal_hist_pallas(
-            base_key, r, rng.PHASE_PROPOSAL, hist1, vote_src,
-            m, N, interpret=interp,
-            node_offset=ctx.node_ids(N)[0],
-            trial_offset=ctx.trial_ids(T)[0]))
-        if cfg.coin_mode == "private":
-            shared = jnp.zeros((T,), jnp.int32)
-        else:
-            shared = rng.coin_flips(base_key, r, ctx.trial_ids(T),
-                                    rng.ids(1), common=True)[:, 0]
-        new_x, new_decided, new_k = vote_commit_pallas(
-            base_key, r, rng.PHASE_VOTE, hist2, state.x, state.decided,
-            state.k, killed, quorum_ok[:, 0], shared,
-            m, F, N, cfg.rule, cfg.coin_mode, float(cfg.coin_eps),
-            bool(cfg.freeze_decided), interpret=interp,
-            node_offset=ctx.node_ids(N)[0],
-            trial_offset=ctx.trial_ids(T)[0])
-        return NetState(x=new_x, decided=new_decided, k=new_k,
-                        killed=killed)
+        # kernels over the packed per-lane state word
+        # (ops/pallas_round.py) with the decide/adopt/coin/commit chain
+        # inside the vote kernel — no [T,N,3] counts, x1, or coin tensor
+        # ever reaches HBM.  Bit-identical to the unfused pallas path
+        # (same streams), mesh-safe (global-id offsets + psum'd partials).
+        # This per-round wrapper packs/unpacks at the round boundary; the
+        # single-device runner (sim.run_consensus) instead carries the
+        # packed array through the whole loop (pallas_round.run_packed).
+        # state.killed is packed PRE-crash-update: the kernels (and
+        # sent_hist_from_pack) re-derive killed_now from crash_round + r,
+        # matching the XLA path's start-of-round update above.
+        from ..ops import pallas_round as pr
+        pack = pr.pack_state(state, faults.faulty)
+        cr = (pr._pad_cr(faults, pack.shape[1])
+              if cfg.fault_model == "crash_at_round" else None)
+        hist1 = pr.sent_hist_from_pack(cfg, pack, cr, r, ctx)
+        new_pack, _, _ = pr.packed_round(cfg, pack, faults, base_key, r,
+                                         hist1, ctx, N)
+        return pr.unpack_state(new_pack, N)
 
     # --- phase 1: "proposal phase" (node.ts:46-82) -----------------------
     # Dense sharded path: gather the (round-constant) alive mask once for

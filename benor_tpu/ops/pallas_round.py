@@ -1,4 +1,5 @@
-"""Fused round kernels: the whole Ben-Or round as two VMEM passes.
+"""Fused round kernels: the whole Ben-Or round as two VMEM passes over a
+PACKED per-lane state word.
 
 r3 VERDICT item 2 (the HBM roofline gap): on the flagship path each
 phase's sampler kernel (ops/pallas_hist.py:cf_counts_pallas) writes int32
@@ -7,27 +8,39 @@ re-reads — phase 1 to compute x1/vote values, phase 2 to compute
 decide0/decide1 (node.ts:99-104), plurality-adopt (node.ts:106-112), the
 coin (a separate pallas kernel, 4 B/lane write + read), and the commit
 masks — every intermediate materialized in HBM because XLA cannot fuse
-INTO a pallas call.  The two kernels here eliminate all of it:
+INTO a pallas call.  The two kernels here eliminate all of it, and the
+whole per-lane state travels as ONE int32 word so the boundary costs no
+dtype-conversion or padding copies either:
+
+    bits 0-1  x (0, 1, 2 = "?")          bit 4   faulty (byzantine flip)
+    bit  2    decided                     bits 5+ k (round counter)
+    bit  3    killed
 
   proposal_hist_pallas  — per-lane proposal tallies + majority/tie + the
-                          vote value, reduced IN-KERNEL to a per-tile
-                          partial vote histogram (~1 B/lane out; the
-                          [T,N,3] counts and [T,N] x1 never exist).
+                          vote value, reduced IN-KERNEL to per-tile
+                          partials: vote-class histogram + alive count
+                          (~1 B/lane of output; the [T,N,3] counts and
+                          [T,N] x1 never exist).
   vote_commit_pallas    — per-lane vote tallies + coin + decide/adopt/
-                          commit; HBM traffic is the state in/out only.
+                          commit -> the new packed word, plus per-tile
+                          partials of the NEXT round's proposal histogram
+                          and the settled/unsettled counts (so the
+                          while-loop predicate reads no per-lane data).
 
-Stream identity: the vote draws use the SAME key/counter scheme as
-cf_counts_pallas(phase=PHASE_VOTE) and the coin the SAME scheme as
-coin_flips_pallas / weak_coin_flips_pallas (word 0 = private bit, word 1 =
-deviation uniform), so a run with ``use_pallas_round=True`` is
-BIT-IDENTICAL to the unfused ``use_pallas_hist=True`` path — pinned by
-tests/test_pallas_round.py, which makes interpret-mode CPU testing exact
-rather than statistical.
+``run_packed`` (used by sim.run_consensus) carries the padded packed
+array through the entire while-loop: pack/unpack happen once per RUN.
+``packed_round`` wraps one round for the per-round callers
+(models/benor.py under the sharded runner, trajectory/slice paths).
 
-Engages (models/benor.py) on top of the pallas-hist regime for
-fault_model='crash', any rule, coin_mode private / common / weak_common
-with 0 < eps < 1 (the weak endpoints short-circuit to the plain streams on
-the XLA side, exactly like the unfused dispatch).
+Stream identity: the draws use the SAME key/counter schemes as
+cf_counts_pallas / coin_flips_pallas / weak_coin_flips_pallas, so a
+``use_pallas_round=True`` run is BIT-IDENTICAL to the unfused
+``use_pallas_hist=True`` path — pinned by tests/test_pallas_round.py,
+which makes interpret-mode CPU testing exact rather than statistical.
+
+Engages (ops/tally.py:pallas_round_active) on top of the pallas-hist
+regime for every fault model except equivocate, coin_mode private /
+common / weak_common with 0 < eps < 1.
 """
 
 from __future__ import annotations
@@ -42,27 +55,95 @@ from jax.experimental.pallas import tpu as pltpu
 from .pallas_hist import (_COIN_SALT, TILE_N, _bits_to_uniform, _cf_draw,
                           _lane_ids, _stream_scal, _threefry2x32)
 from ..config import VAL0, VAL1, VALQ
+from ..state import NetState
+
+_DEC, _KILL, _FAULT, _KSHIFT = 2, 3, 4, 5
 
 
-def _prop_hist_kernel(m, scal_ref, c0_ref, c1_ref, cq_ref, src_ref,
-                      out_ref):
-    """One lane-tile of the fused PROPOSAL phase: per-lane CF tallies ->
-    phase-1 majority/tie -> each lane's vote value -> this tile's partial
-    vote-class histogram.  NO per-lane output reaches HBM at all — the
-    [T, N, 3] proposal counts and the [T, N] x1 tensor of the unfused
-    path become one [T, 128]-padded partial per tile (~1 B/lane).
+def pack_state(state: NetState, faulty: jax.Array) -> jax.Array:
+    """NetState leaves + faulty mask -> padded packed int32 [T, Np].
 
-    src_ref: VMEM int32 [T, TILE_N] vote source: -2 = dead (not counted),
-    -1 = live undecided (vote the in-kernel x1), -3 = live undecided
-    byzantine (vote the BIT-FLIP of the in-kernel x1 — every receiver
-    hears the flipped broadcast, models/benor.py:_flip), 0/1/2 = frozen
-    lane's decided value, pre-flipped by the caller where byzantine (the
-    reference's decided nodes keep vouching, node.ts:147-157).
-    out_ref: VMEM int32 [1, T, 128] — columns 0..2 are the tile's
-    (c0, c1, cq) vote counts, the rest zero padding (a 3-wide minor dim
-    would fight Mosaic tiling).
+    Pad lanes carry the killed bit (inert everywhere: excluded from
+    histograms and alive counts, never active, counted as settled)."""
+    p = (state.x.astype(jnp.int32) & 3
+         | (state.decided.astype(jnp.int32) << _DEC)
+         | (state.killed.astype(jnp.int32) << _KILL)
+         | (faulty.astype(jnp.int32) << _FAULT)
+         | (state.k.astype(jnp.int32) << _KSHIFT))
+    n = p.shape[-1]
+    n_pad = (-n) % TILE_N
+    if n_pad:
+        p = jnp.pad(p, ((0, 0), (0, n_pad)),
+                    constant_values=(VALQ | (1 << _KILL)))
+    return p
+
+
+def unpack_state(pack: jax.Array, n_nodes: int) -> NetState:
+    p = pack[:, :n_nodes]
+    return NetState(x=(p & 3).astype(jnp.int8),
+                    decided=((p >> _DEC) & 1).astype(bool),
+                    k=(p >> _KSHIFT),
+                    killed=((p >> _KILL) & 1).astype(bool))
+
+
+def _fields(p, rr, cr, fault_model, freeze):
+    """Unpack the state word + apply the crash-at-round update in-kernel.
+
+    Returns (x, decided, killed_now, faulty, k, alive, frozen) — all int32
+    except the bool masks."""
+    x = p & 3
+    decided = (p >> _DEC) & 1
+    killed = (p >> _KILL) & 1
+    faulty = (p >> _FAULT) & 1
+    k = p >> _KSHIFT
+    if fault_model == "crash_at_round":
+        crashing = (faulty == 1) & (cr > 0) & (rr >= cr)
+        killed = jnp.where(crashing, 1, killed)
+    alive = killed == 0
+    frozen = (decided == 1) if freeze else jnp.zeros_like(alive)
+    return x, decided, killed, faulty, k, alive, frozen
+
+
+def _flip(v):
+    """Byzantine bit-flip on packed x values: 0 <-> 1, "?" unchanged."""
+    return jnp.where(v == VAL0, VAL1, jnp.where(v == VAL1, VAL0, v))
+
+
+def _sent(fault_model, vote, faulty):
+    if fault_model == "byzantine":
+        return jnp.where(faulty == 1, _flip(vote), vote)
+    return vote
+
+
+def _partial_cols(t, cols):
+    """[T]-vectors -> the [1, T, 128] partial layout (col i = cols[i])."""
+    col = jax.lax.broadcasted_iota(jnp.int32, (1, t, 128), 2)
+    out = jnp.zeros((1, t, 128), jnp.int32)
+    for i, v in enumerate(cols):
+        out = out + (col == i) * v[None, :, None]
+    return out
+
+
+def _prop_hist_kernel(m, fault_model, freeze, has_cr, *refs):
+    """One lane-tile of the fused PROPOSAL phase.
+
+    Per-lane CF tallies from the global proposal histogram -> phase-1
+    majority/tie (node.ts:63-69) -> each lane's (byzantine-flipped) vote
+    value -> per-tile partials: cols 0-2 vote-class histogram, col 3 the
+    tile's alive count (feeding n_alive / the quorum gate).
     """
-    node, trial = _lane_ids(scal_ref, src_ref.shape)
+    if has_cr:
+        scal_ref, rr_ref, c0_ref, c1_ref, cq_ref, p_ref, cr_ref, out_ref \
+            = refs
+        cr = cr_ref[...]
+    else:
+        scal_ref, rr_ref, c0_ref, c1_ref, cq_ref, p_ref, out_ref = refs
+        cr = None
+    p = p_ref[...]
+    x, decided, killed, faulty, k, alive, frozen = _fields(
+        p, rr_ref[0], cr, fault_model, freeze)
+
+    node, trial = _lane_ids(scal_ref, p.shape)
     b0, b1 = _threefry2x32(scal_ref[0], scal_ref[1], node, trial)
     u0 = _bits_to_uniform(b0)
     u1 = _bits_to_uniform(b1)
@@ -74,83 +155,41 @@ def _prop_hist_kernel(m, scal_ref, c0_ref, c1_ref, cq_ref, src_ref,
     p0 = _cf_draw(u0, total, c0, mf)
     p1 = _cf_draw(u1, jnp.maximum(total - c0, 0.0), c1,
                   jnp.maximum(mf - p0, 0.0))
-    x1 = jnp.where(p0 > p1, VAL0,
-                   jnp.where(p1 > p0, VAL1, VALQ))         # node.ts:63-69
-    x1_flip = jnp.where(x1 == VAL0, VAL1,
-                        jnp.where(x1 == VAL1, VAL0, VALQ))
-    src = src_ref[...]
-    vote = jnp.where(src == -1, x1, jnp.where(src == -3, x1_flip, src))
-    alive = src != -2
-    t = src.shape[0]
-    parts = [jnp.sum((vote == v) & alive, axis=1,
-                     dtype=jnp.int32)[None, :, None]        # [1, T, 1]
-             for v in (VAL0, VAL1, VALQ)]
-    col = jax.lax.broadcasted_iota(jnp.int32, (1, t, 128), 2)
-    out_ref[...] = ((col == 0) * parts[0] + (col == 1) * parts[1]
-                    + (col == 2) * parts[2])
+    x1 = jnp.where(p0 > p1, VAL0, jnp.where(p1 > p0, VAL1, VALQ))
 
-
-@functools.partial(jax.jit, static_argnames=("m", "n_nodes", "interpret"))
-def proposal_hist_pallas(base_key: jax.Array, r: jax.Array, phase: int,
-                         hist: jax.Array, vote_src: jax.Array,
-                         m: int, n_nodes: int, interpret: bool = False,
-                         node_offset: jax.Array | int = 0,
-                         trial_offset: jax.Array | int = 0) -> jax.Array:
-    """Fused proposal phase -> this shard's LOCAL vote histogram int32
-    [T, 3] (callers psum it over the nodes axis under a mesh).
-
-    hist: int32 [T, 3] global PROPOSAL class counts; vote_src: int32
-    [T, N_local] (-2 dead / -1 undecided / 0,1,2 frozen value).  Uses the
-    PHASE_PROPOSAL stream of cf_counts_pallas verbatim, so the implied
-    per-lane x1 — and hence the histogram — is bit-identical to the
-    unfused pallas path (integer sums are order-free).
-    """
-    T = hist.shape[0]
-    n_pad = (-n_nodes) % TILE_N
-    np_total = n_nodes + n_pad
-
-    r = jnp.asarray(r, jnp.int32)
-    scal = _stream_scal(base_key, r, phase, node_offset, trial_offset)
-    cls = hist.astype(jnp.float32)[..., None]               # [T, 3, 1]
-    c0, c1, cq = cls[:, 0], cls[:, 1], cls[:, 2]
-    src = vote_src.astype(jnp.int32)
-    if n_pad:
-        src = jnp.pad(src, ((0, 0), (0, n_pad)), constant_values=-2)
-
-    vec = pl.BlockSpec((T, 1), lambda j: (0, 0), memory_space=pltpu.VMEM)
-    parts = pl.pallas_call(
-        functools.partial(_prop_hist_kernel, m),
-        out_shape=jax.ShapeDtypeStruct((np_total // TILE_N, T, 128),
-                                       jnp.int32),
-        grid=(np_total // TILE_N,),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
-                  vec, vec, vec,
-                  pl.BlockSpec((T, TILE_N), lambda j: (0, j),
-                               memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec((1, T, 128), lambda j: (j, 0, 0),
-                               memory_space=pltpu.VMEM),
-        interpret=interpret,
-    )(scal, c0, c1, cq, src)
-    return jnp.sum(parts, axis=0)[:, :3]
+    vote = _sent(fault_model, jnp.where(frozen, x, x1), faulty)
+    t = p.shape[0]
+    out_ref[...] = _partial_cols(t, [
+        jnp.sum((vote == v) & alive, axis=1, dtype=jnp.int32)
+        for v in (VAL0, VAL1, VALQ)
+    ] + [jnp.sum(alive, axis=1, dtype=jnp.int32)])
 
 
 def _vote_commit_kernel(m, n_faulty, rule, coin_mode, eps, freeze,
-                        vote_scal_ref, coin_scal_ref, rk_ref,
-                        c0_ref, c1_ref, cq_ref, qok_ref, shared_ref,
-                        x_ref, dec_ref, k_ref, killed_ref,
-                        nx_ref, ndec_ref, nk_ref):
-    """One lane-tile: vote-phase CF draws -> decide/adopt/coin -> commit.
+                        fault_model, has_cr, *refs):
+    """One lane-tile of the fused VOTE phase + commit.
 
-    vote_scal_ref / coin_scal_ref: SMEM uint32 [4] stream keys (the
-    PHASE_VOTE sampler stream and the _COIN_SALT coin stream — identical
-    to the standalone kernels').  rk_ref: SMEM int32 [1] = r + 1 (the
-    committed k for lanes that run the round, node.ts:147).
-    c0/c1/cq_ref: VMEM f32 [T, 1] global vote-class counts;
-    qok_ref / shared_ref: VMEM int32 [T, 1] quorum gate / per-trial shared
-    coin bit; x/dec/k/killed_ref: VMEM int32 [T, TILE_N] current state.
+    CF vote draws -> decide/adopt/coin (node.ts:99-112) -> the new packed
+    state word, plus per-tile partials: cols 0-2 the NEXT round's proposal
+    histogram (of the new sent values; exact for static-killed fault
+    models — the crash_at_round caller recomputes it in XLA instead),
+    col 3 settled count, col 4 unsettled count (the loop predicate).
     """
+    if has_cr:
+        (vote_scal_ref, coin_scal_ref, rk_ref, c0_ref, c1_ref, cq_ref,
+         qok_ref, shared_ref, p_ref, cr_ref, np_ref, part_ref) = refs
+        cr = cr_ref[...]
+    else:
+        (vote_scal_ref, coin_scal_ref, rk_ref, c0_ref, c1_ref, cq_ref,
+         qok_ref, shared_ref, p_ref, np_ref, part_ref) = refs
+        cr = None
+    p = p_ref[...]
+    rr = rk_ref[0] - 1
+    x, decided, killed, faulty, k, alive, frozen = _fields(
+        p, rr, cr, fault_model, freeze)
+
     # --- the sampler body, verbatim from pallas_hist._cf_kernel ---------
-    node, trial = _lane_ids(vote_scal_ref, nx_ref.shape)
+    node, trial = _lane_ids(vote_scal_ref, p.shape)
     b0, b1 = _threefry2x32(vote_scal_ref[0], vote_scal_ref[1], node, trial)
     u0 = _bits_to_uniform(b0)
     u1 = _bits_to_uniform(b1)
@@ -175,10 +214,10 @@ def _vote_commit_kernel(m, n_faulty, rule, coin_mode, eps, freeze,
         dev = _bits_to_uniform(dbits) < jnp.float32(eps)
         coin = jnp.where(dev, private, shared_ref[...])
 
-    # --- decide / adopt / commit (models/benor.py lines 115-174) --------
+    # --- decide / adopt / commit (models/benor.py) ----------------------
     ff = jnp.float32(n_faulty)
-    decide0 = v0 > ff                                    # node.ts:99
-    decide1 = v1 > ff                                    # node.ts:102
+    decide0 = v0 > ff
+    decide1 = v1 > ff
     if rule == "reference":                              # quirk 9
         any_votes = (v0 + v1) > 0.0
         adopt0 = any_votes & (v0 > v1)
@@ -191,79 +230,234 @@ def _vote_commit_kernel(m, n_faulty, rule, coin_mode, eps, freeze,
         x2 = jnp.where(decide0, VAL0,
              jnp.where(decide1, VAL1, coin))
 
-    x = x_ref[...]
-    decided = dec_ref[...]
-    killed = killed_ref[...]
-    alive = killed == 0
-    if freeze:
-        frozen = decided != 0
-    else:
-        frozen = jnp.zeros_like(alive)
     active = alive & (qok_ref[...] != 0) & ~frozen
     newly = active & (decide0 | decide1)
-    nx_ref[...] = jnp.where(active, x2, x)
-    ndec_ref[...] = jnp.where(newly, 1, decided)
-    nk_ref[...] = jnp.where(active, rk_ref[0], k_ref[...])
+    new_x = jnp.where(active, x2, x)
+    new_dec = jnp.where(newly, 1, decided)
+    new_k = jnp.where(active, rk_ref[0], k)
+    np_ref[...] = (new_x | (new_dec << _DEC) | (killed << _KILL)
+                   | (faulty << _FAULT) | (new_k << _KSHIFT))
+
+    sent_next = _sent(fault_model, new_x, faulty)
+    settled = (new_dec == 1) | (killed == 1)
+    t = p.shape[0]
+    part_ref[...] = _partial_cols(t, [
+        jnp.sum((sent_next == v) & alive, axis=1, dtype=jnp.int32)
+        for v in (VAL0, VAL1, VALQ)
+    ] + [jnp.sum(settled, axis=1, dtype=jnp.int32),
+         jnp.sum(~settled, axis=1, dtype=jnp.int32)])
+
+
+def _smem():
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _vec(t):
+    return pl.BlockSpec((t, 1), lambda j: (0, 0), memory_space=pltpu.VMEM)
+
+
+def _lane(t):
+    return pl.BlockSpec((t, TILE_N), lambda j: (0, j),
+                        memory_space=pltpu.VMEM)
+
+
+def _part(t):
+    return pl.BlockSpec((1, t, 128), lambda j: (j, 0, 0),
+                        memory_space=pltpu.VMEM)
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "m", "n_faulty", "n_nodes", "rule", "coin_mode", "eps", "freeze",
-    "interpret"))
-def vote_commit_pallas(base_key: jax.Array, r: jax.Array, phase: int,
-                       hist: jax.Array, x: jax.Array, decided: jax.Array,
-                       k: jax.Array, killed: jax.Array,
-                       quorum_ok: jax.Array, shared: jax.Array,
-                       m: int, n_faulty: int, n_nodes: int, rule: str,
-                       coin_mode: str, eps: float, freeze: bool,
-                       interpret: bool = False,
-                       node_offset: jax.Array | int = 0,
-                       trial_offset: jax.Array | int = 0):
-    """Fused vote phase -> (new_x int8, new_decided bool, new_k int32).
+    "m", "fault_model", "freeze", "interpret"))
+def proposal_hist_pallas(base_key, r, phase, hist, pack, crash_round,
+                         m: int, fault_model: str, freeze: bool,
+                         interpret: bool = False, node_offset=0,
+                         trial_offset=0):
+    """Fused proposal phase over the packed state -> partials int32
+    [T, 128]: cols 0-2 this shard's LOCAL vote histogram, col 3 its alive
+    count (callers psum both over the nodes axis under a mesh).
 
-    hist: int32 [T, 3] global vote-class counts (psum'd under a mesh);
-    x int8 / decided bool / k int32 / killed bool [T, N] current state;
-    quorum_ok bool [T]; shared int32-able [T] per-trial shared coin bit
-    (ignored for coin_mode='private').  Drop-in replacement for
-    cf_counts_pallas(vote) + coin kernel + the XLA decide/adopt/commit
-    chain — bit-identical to that unfused pallas path by stream identity.
+    hist: int32 [T, 3] global PROPOSAL class counts; pack: padded packed
+    state [T, Np]; crash_round: int32 [T, Np-padded] (crash_at_round
+    only, else None).  Uses the PHASE_PROPOSAL stream of cf_counts_pallas
+    verbatim, so the implied per-lane x1 — and hence the histogram — is
+    bit-identical to the unfused pallas path.
     """
-    T = hist.shape[0]
-    n_pad = (-n_nodes) % TILE_N
-    np_total = n_nodes + n_pad
+    T, np_total = pack.shape
+    r = jnp.asarray(r, jnp.int32)
+    scal = _stream_scal(base_key, r, phase, node_offset, trial_offset)
+    cls = hist.astype(jnp.float32)[..., None]
+    c0, c1, cq = cls[:, 0], cls[:, 1], cls[:, 2]
+    has_cr = fault_model == "crash_at_round"
 
+    args = [scal, r.reshape(1), c0, c1, cq, pack]
+    specs = [_smem(), _smem(), _vec(T), _vec(T), _vec(T), _lane(T)]
+    if has_cr:
+        args.append(crash_round)
+        specs.append(_lane(T))
+    parts = pl.pallas_call(
+        functools.partial(_prop_hist_kernel, m, fault_model, freeze,
+                          has_cr),
+        out_shape=jax.ShapeDtypeStruct((np_total // TILE_N, T, 128),
+                                       jnp.int32),
+        grid=(np_total // TILE_N,),
+        in_specs=specs,
+        out_specs=_part(T),
+        interpret=interpret,
+    )(*args)
+    return jnp.sum(parts, axis=0)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "m", "n_faulty", "rule", "coin_mode", "eps", "freeze", "fault_model",
+    "interpret"))
+def vote_commit_pallas(base_key, r, phase, hist, pack, crash_round,
+                       quorum_ok, shared, m: int, n_faulty: int, rule: str,
+                       coin_mode: str, eps: float, freeze: bool,
+                       fault_model: str, interpret: bool = False,
+                       node_offset=0, trial_offset=0):
+    """Fused vote phase + commit -> (new_pack [T, Np], partials [T, 128]).
+
+    Partials: cols 0-2 the next round's LOCAL proposal histogram (valid
+    for static-killed fault models), col 3 settled count, col 4 unsettled
+    count.  hist: int32 [T, 3] global VOTE class counts (psum'd under a
+    mesh); quorum_ok: bool [T]; shared: int32-able [T] per-trial shared
+    coin bit (ignored for coin_mode='private').
+    """
+    T, np_total = pack.shape
     r = jnp.asarray(r, jnp.int32)
     vote_scal = _stream_scal(base_key, r, phase, node_offset, trial_offset)
     coin_scal = _stream_scal(base_key, r, _COIN_SALT, node_offset,
                              trial_offset)
     rk = (r + 1).reshape(1)
-
-    cls = hist.astype(jnp.float32)[..., None]               # [T, 3, 1]
-    c0, c1, cq = cls[:, 0], cls[:, 1], cls[:, 2]            # [T, 1]
+    cls = hist.astype(jnp.float32)[..., None]
+    c0, c1, cq = cls[:, 0], cls[:, 1], cls[:, 2]
     qok = quorum_ok.astype(jnp.int32)[:, None]
     sh = shared.astype(jnp.int32)[:, None]
+    has_cr = fault_model == "crash_at_round"
 
-    def pad(a, fill):
-        a = a.astype(jnp.int32)
-        if n_pad:
-            a = jnp.pad(a, ((0, 0), (0, n_pad)), constant_values=fill)
-        return a
-
-    state_in = (pad(x, VALQ), pad(decided, 0), pad(k, 0), pad(killed, 1))
-
-    vec = pl.BlockSpec((T, 1), lambda j: (0, 0), memory_space=pltpu.VMEM)
-    lane = pl.BlockSpec((T, TILE_N), lambda j: (0, j),
-                        memory_space=pltpu.VMEM)
-    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
-    nx, ndec, nk = pl.pallas_call(
+    args = [vote_scal, coin_scal, rk, c0, c1, cq, qok, sh, pack]
+    specs = [_smem(), _smem(), _smem(), _vec(T), _vec(T), _vec(T),
+             _vec(T), _vec(T), _lane(T)]
+    if has_cr:
+        args.append(crash_round)
+        specs.append(_lane(T))
+    new_pack, parts = pl.pallas_call(
         functools.partial(_vote_commit_kernel, m, n_faulty, rule,
-                          coin_mode, eps, freeze),
-        out_shape=[jax.ShapeDtypeStruct((T, np_total), jnp.int32)] * 3,
+                          coin_mode, eps, freeze, fault_model, has_cr),
+        out_shape=[jax.ShapeDtypeStruct((T, np_total), jnp.int32),
+                   jax.ShapeDtypeStruct((np_total // TILE_N, T, 128),
+                                        jnp.int32)],
         grid=(np_total // TILE_N,),
-        in_specs=[smem, smem, smem, vec, vec, vec, vec, vec,
-                  lane, lane, lane, lane],
-        out_specs=[lane] * 3,
+        in_specs=specs,
+        out_specs=[_lane(T), _part(T)],
         interpret=interpret,
-    )(vote_scal, coin_scal, rk, c0, c1, cq, qok, sh, *state_in)
-    return (nx[:, :n_nodes].astype(jnp.int8),
-            ndec[:, :n_nodes].astype(bool),
-            nk[:, :n_nodes])
+    )(*args)
+    return new_pack, jnp.sum(parts, axis=0)
+
+
+def _pad_cr(faults, np_total):
+    cr = faults.crash_round.astype(jnp.int32)
+    n_pad = np_total - cr.shape[-1]
+    if n_pad:
+        cr = jnp.pad(cr, ((0, 0), (0, n_pad)))
+    return cr
+
+
+def sent_hist_from_pack(cfg, pack, crash_round, r, ctx):
+    """XLA fallback for the proposal histogram (round 1 of every run, and
+    every round under crash_at_round, whose future crashes invalidate the
+    vote kernel's emitted next-round partials)."""
+    p = pack
+    x = p & 3
+    killed = (p >> _KILL) & 1
+    faulty = (p >> _FAULT) & 1
+    if cfg.fault_model == "crash_at_round":
+        rr = jnp.asarray(r, jnp.int32)
+        crashing = (faulty == 1) & (crash_round > 0) & (rr >= crash_round)
+        killed = jnp.where(crashing, 1, killed)
+    alive = killed == 0
+    sent = _sent(cfg.fault_model, x, faulty)
+    cnt = [jnp.sum((sent == v) & alive, axis=-1, dtype=jnp.int32)
+           for v in (VAL0, VAL1, VALQ)]
+    return ctx.psum_nodes(jnp.stack(cnt, axis=-1))
+
+
+def packed_round(cfg, pack, faults, base_key, r, hist1, ctx, n_local):
+    """One fused round over the packed state.
+
+    ``n_local`` is this shard's TRUE (unpadded) node count — the global-id
+    base derivation needs it.  ``hist1`` is this round's global proposal
+    histogram.  Returns (new_pack, hist1_next or None, unsettled [T]);
+    hist1_next is None under crash_at_round (recompute via
+    sent_hist_from_pack).
+    """
+    from . import rng
+
+    T, np_total = pack.shape
+    interp = jax.default_backend() == "cpu"
+    m = cfg.quorum
+    cr = (_pad_cr(faults, np_total)
+          if cfg.fault_model == "crash_at_round" else None)
+    node_off = ctx.node_ids(n_local)[0]
+    trial_off = ctx.trial_ids(T)[0]
+
+    partsA = proposal_hist_pallas(
+        base_key, r, rng.PHASE_PROPOSAL, hist1, pack, cr, m,
+        cfg.fault_model, bool(cfg.freeze_decided), interpret=interp,
+        node_offset=node_off, trial_offset=trial_off)
+    hist2 = ctx.psum_nodes(partsA[:, :3])
+    n_alive = ctx.psum_nodes(partsA[:, 3])
+    quorum_ok = n_alive >= m
+
+    if cfg.coin_mode == "private":
+        shared = jnp.zeros((T,), jnp.int32)
+    else:
+        shared = rng.coin_flips(base_key, r, ctx.trial_ids(T),
+                                rng.ids(1), common=True)[:, 0]
+
+    new_pack, partsB = vote_commit_pallas(
+        base_key, r, rng.PHASE_VOTE, hist2, pack, cr, quorum_ok, shared,
+        m, cfg.n_faulty, cfg.rule, cfg.coin_mode, float(cfg.coin_eps),
+        bool(cfg.freeze_decided), cfg.fault_model, interpret=interp,
+        node_offset=node_off, trial_offset=trial_off)
+    hist1_next = (None if cfg.fault_model == "crash_at_round"
+                  else ctx.psum_nodes(partsB[:, :3]))
+    unsettled = ctx.psum_nodes(partsB[:, 4])
+    return new_pack, hist1_next, unsettled
+
+
+def run_packed(cfg, state, faults, base_key):
+    """Single-device fast path for sim.run_consensus: the packed state is
+    the while-loop carry, so pack/unpack (and every per-lane XLA op) run
+    once per RUN, not per round.  Bit-identical to the generic loop."""
+    from ..ops.collectives import SINGLE
+    from ..sim import start_state
+
+    state = start_state(cfg, state)
+    pack = pack_state(state, faults.faulty)
+    hist1 = sent_hist_from_pack(
+        cfg, pack, _pad_cr(faults, pack.shape[1])
+        if cfg.fault_model == "crash_at_round" else None,
+        jnp.int32(1), SINGLE)
+    unsettled0 = jnp.sum(
+        ~(((pack >> _DEC) & 1) | ((pack >> _KILL) & 1)).astype(bool),
+        dtype=jnp.int32)
+
+    def cond(carry):
+        r, pack, hist1, unsettled = carry
+        return (r <= cfg.max_rounds) & (unsettled > 0)
+
+    def body(carry):
+        r, pack, hist1, _ = carry
+        if cfg.fault_model == "crash_at_round":
+            hist1 = sent_hist_from_pack(
+                cfg, pack, _pad_cr(faults, pack.shape[1]), r, SINGLE)
+        new_pack, hist1_next, unsettled = packed_round(
+            cfg, pack, faults, base_key, r, hist1, SINGLE, cfg.n_nodes)
+        if hist1_next is None:
+            hist1_next = hist1              # recomputed next iteration
+        return (r + 1, new_pack, hist1_next, jnp.sum(unsettled))
+
+    r, pack, _, _ = jax.lax.while_loop(
+        cond, body, (jnp.int32(1), pack, hist1, unsettled0))
+    return r - 1, unpack_state(pack, cfg.n_nodes)

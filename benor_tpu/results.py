@@ -200,6 +200,22 @@ def safety_violation(n: int, trials: int, seed: int = 0,
     if verbose:
         print(f"  f={f_half:,} (past 1/2): decided={pt.decided_frac:.3f} "
               f"(livelock)", flush=True)
+    # the quirk-born parity effect: an ODD quorum admits no perfect
+    # phase-1 tie, so no "?" voters can be manufactured and the attack
+    # needs N <= 3F + 1 — one odd-quorum row either side of that bound
+    for frac, label in ((0.05, "odd,N>3F+1"), (0.40, "odd,N<3F+1")):
+        f = int(frac * n)
+        f += 1 - (n - f) % 2               # force an odd quorum
+        cfg = SimConfig(n_nodes=n, n_faulty=f, trials=trials, max_rounds=16,
+                        delivery="quorum", scheduler="targeted",
+                        path="histogram", seed=seed)
+        pt = run_point(cfg, initial_values=_balanced(trials, n),
+                       faults=FaultSpec.none(trials, n))
+        rows.append({"f": f, "f_frac": round(f / n, 4),
+                     "fault_model": f"crash ({label})", **pt.to_dict()})
+        if verbose:
+            print(f"  f={f:,} ({label}): disagree={pt.disagree_frac:.3f}",
+                  flush=True)
     # one equivocator: agreement dies at any N
     cfg = SimConfig(n_nodes=n, n_faulty=1, trials=trials, max_rounds=16,
                     delivery="quorum", scheduler="targeted",
@@ -662,6 +678,9 @@ def _write_markdown(out_dir: str, out: Dict) -> None:
             "probabilistic transition, this curve is exactly 0/1: "
             "agreement is violated at EVERY 1 ≤ F < N/2 (even quorum), "
             "and at f ≥ 1/2 the bar `count > F` is unreachable — livelock. "
+            "The `odd` rows show the quirk-born parity effect: an odd "
+            "quorum admits no perfect phase-1 tie, no \"?\" voters can be "
+            "manufactured, and the attack weakens to N ≤ 3F + 1. "
             "The final row arms ONE equivocator: the decide rule has no "
             "Byzantine safety margin at any N.",
             "",

@@ -51,8 +51,17 @@ def run_consensus(cfg: SimConfig, state: NetState, faults: FaultSpec,
 
     Returns (rounds_executed, final_state).  jit-compiled once per config
     (SimConfig is static/hashable); the loop is on-device, zero host round
-    trips per round.
+    trips per round.  In the fused-kernel regime
+    (tally.pallas_round_active) the loop carries the PACKED per-lane state
+    word instead of NetState — pack/unpack and every per-lane XLA op run
+    once per RUN, not per round — with bit-identical results (the kernels
+    share the unfused path's exact random streams).
     """
+    from .ops.tally import pallas_round_active
+
+    if pallas_round_active(cfg) and not cfg.debug:
+        from .ops.pallas_round import run_packed
+        return run_packed(cfg, state, faults, base_key)
     state = start_state(cfg, state)
     carry = (jnp.int32(1), state)
     r, state = jax.lax.while_loop(

@@ -230,6 +230,10 @@ def test_results_generator_end_to_end(tmp_path):
     for row in sv:
         if row["fault_model"] == "equivocate":
             assert row["disagree_frac"] == 1.0
+        elif "odd" in row["fault_model"]:
+            # the parity-weakened attack: violated iff N <= 3F + 1
+            assert (row["disagree_frac"] == 1.0) is \
+                ("N<3F+1" in row["fault_model"]), row
         elif row["f"] == 0 or row["f"] > 200:     # f=0 / past N/2 at N=400
             assert row["disagree_frac"] == 0.0
         else:
