@@ -99,6 +99,19 @@ def run_batch(cfg, initial_values, faulty_list, seeds,
         raise ValueError("Arrays don't match")
     if sum(bool(b) for b in faulty_list) != f:
         raise ValueError("faultyList doesnt have F faulties")
+    # same guard as the network entry point (api.py): the oracle
+    # replicates the REFERENCE semantics exactly — silently running a
+    # requested framework extension would fake wrong-scenario
+    # distributions
+    for knob, val, want in (("fault_model", cfg.fault_model, "crash"),
+                            ("coin_mode", cfg.coin_mode, "private"),
+                            ("rule", cfg.rule, "reference"),
+                            ("scheduler", cfg.scheduler, "uniform")):
+        if val != want:
+            raise ValueError(
+                f"the native oracle supports only {knob}={want!r} (the "
+                f"reference's semantics); got {val!r} — use the 'tpu' "
+                "backend")
     seeds = np.ascontiguousarray(seeds, np.uint32)
     s = len(seeds)
     cap = step_cap if step_cap is not None else \

@@ -69,6 +69,14 @@ class TpuNetwork:
         """
         if self._started:
             return
+        if on_slice is not None and not (self.cfg.poll_rounds > 0
+                                         and self.cfg.mesh_shape is None):
+            # a silently-never-fired callback is indistinguishable from a
+            # real observability bug — fail loudly instead
+            raise ValueError(
+                "start(on_slice=...) requires SimConfig(poll_rounds > 0) "
+                "on the single-device path; this config runs one "
+                "uninterrupted compiled loop")
         base_key = jax.random.key(self.cfg.seed)
         if self.cfg.mesh_shape is not None:
             from ..parallel import make_mesh, run_consensus_sharded

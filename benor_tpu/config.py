@@ -127,15 +127,17 @@ class SimConfig:
     # keyed on the run's base_key, so results are statistically (not
     # bitwise) identical to the XLA path.
     use_pallas_hist: bool = False
-    # Fold the vote phase's decide/adopt/coin/commit elementwise chain into
-    # the sampler kernel itself (ops/pallas_round.py): counts, coin, and
-    # decision logic stay in VMEM; per-lane HBM traffic drops to the state
-    # in/out (the XLA chain re-reads the 12 B/lane counts the sampler wrote
-    # — r3 VERDICT item 2's roofline gap).  Engages ON TOP of
-    # use_pallas_hist in the same CF regime, for fault_model='crash' with
-    # coin_mode private/common/weak_common (0 < eps < 1); silently ignored
-    # elsewhere, like use_pallas_hist.  BIT-identical to the unfused
-    # pallas path (same streams; tests/test_pallas_round.py).
+    # Run the WHOLE round as two pallas kernels over a packed per-lane
+    # state word (ops/pallas_round.py): counts, coin, and decision logic
+    # stay in VMEM; sim.run_consensus carries the packed array through the
+    # entire while-loop, so no per-lane XLA op runs per round (the XLA
+    # chain's re-reads of the 12 B/lane sampler counts were r3 VERDICT
+    # item 2's roofline gap).  Engages ON TOP of use_pallas_hist in the
+    # same CF regime, for every fault model except equivocate (byzantine
+    # flips ride the packed faulty bit; crash_at_round re-derives killed
+    # in-kernel) with coin_mode private/common/weak_common (0 < eps < 1);
+    # silently ignored elsewhere, like use_pallas_hist.  BIT-identical to
+    # the unfused pallas path (same streams; tests/test_pallas_round.py).
     use_pallas_round: bool = False
 
     # --- Monte-Carlo ----------------------------------------------------

@@ -57,6 +57,28 @@ def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
     F = cfg.n_faulty
     m = cfg.quorum
 
+    if tally.pallas_round_active(cfg):
+        # Fully-fused round (r3 VERDICT item 2): BOTH phases run as pallas
+        # kernels over the packed per-lane state word
+        # (ops/pallas_round.py) with the decide/adopt/coin/commit chain
+        # inside the vote kernel — no [T,N,3] counts, x1, or coin tensor
+        # ever reaches HBM.  Bit-identical to the unfused pallas path
+        # (same streams), mesh-safe (global-id offsets + psum'd partials).
+        # This per-round wrapper packs/unpacks at the round boundary; the
+        # single-device runner (sim.run_consensus) instead carries the
+        # packed array through the whole loop (pallas_round.run_packed).
+        # state.killed is packed PRE-crash-update: the kernels (and
+        # sent_hist_from_pack) re-derive killed_now from crash_round + r,
+        # matching the XLA path's start-of-round update below.
+        from ..ops import pallas_round as pr
+        pack = pr.pack_state(state, faults.faulty)
+        cr = (pr._pad_cr(faults, pack.shape[1])
+              if cfg.fault_model == "crash_at_round" else None)
+        hist1 = pr.sent_hist_from_pack(cfg, pack, cr, r, ctx)
+        new_pack, _, _ = pr.packed_round(cfg, pack, faults, base_key, r,
+                                         hist1, ctx, N)
+        return pr.unpack_state(new_pack, N)
+
     # --- crash-at-round fault injection (start of round) -----------------
     killed = state.killed
     if cfg.fault_model == "crash_at_round":
@@ -76,28 +98,6 @@ def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
     # (unless freeze_decided is off) not already decided — quirk 5 handling.
     frozen = state.decided & cfg.freeze_decided
     active = alive & quorum_ok & ~frozen
-
-    if tally.pallas_round_active(cfg):
-        # Fully-fused round (r3 VERDICT item 2): BOTH phases run as pallas
-        # kernels over the packed per-lane state word
-        # (ops/pallas_round.py) with the decide/adopt/coin/commit chain
-        # inside the vote kernel — no [T,N,3] counts, x1, or coin tensor
-        # ever reaches HBM.  Bit-identical to the unfused pallas path
-        # (same streams), mesh-safe (global-id offsets + psum'd partials).
-        # This per-round wrapper packs/unpacks at the round boundary; the
-        # single-device runner (sim.run_consensus) instead carries the
-        # packed array through the whole loop (pallas_round.run_packed).
-        # state.killed is packed PRE-crash-update: the kernels (and
-        # sent_hist_from_pack) re-derive killed_now from crash_round + r,
-        # matching the XLA path's start-of-round update above.
-        from ..ops import pallas_round as pr
-        pack = pr.pack_state(state, faults.faulty)
-        cr = (pr._pad_cr(faults, pack.shape[1])
-              if cfg.fault_model == "crash_at_round" else None)
-        hist1 = pr.sent_hist_from_pack(cfg, pack, cr, r, ctx)
-        new_pack, _, _ = pr.packed_round(cfg, pack, faults, base_key, r,
-                                         hist1, ctx, N)
-        return pr.unpack_state(new_pack, N)
 
     # --- phase 1: "proposal phase" (node.ts:46-82) -----------------------
     # Dense sharded path: gather the (round-constant) alive mask once for

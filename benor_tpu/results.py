@@ -297,6 +297,13 @@ def oracle_parity(trials: int, seed: int = 0, n: int = 100, f: int = 40,
     t0 = time.perf_counter()
     out_s = native_oracle.run_batch(cfg_o, vals, faulty, seeds)
     oracle_elapsed = time.perf_counter() - t0
+    if (out_s["steps"] < 0).any():
+        # steps == -1 marks a step-cap trip: that seed's state is a
+        # mid-run snapshot, not a finished trace — it must not silently
+        # enter the invariance/KS samples or deflate the throughput
+        raise RuntimeError(
+            f"oracle_parity: {(out_s['steps'] < 0).sum()} seeds tripped "
+            "the oracle step cap; raise step_cap or shrink the scenario")
     out_f = native_oracle.run_batch(cfg_o.replace(oracle_order="fifo"),
                                     vals, faulty, seeds)
     # the invariance theorem covers DECIDED runs only (a run capped
