@@ -255,6 +255,14 @@ class SimConfig:
                 "event-loop oracles run to termination in one drain — a "
                 "silent no-op would fake mid-run observability, so use "
                 "backend='tpu'")
+        if self.use_pallas_round and self.max_rounds + 1 >= (1 << 26):
+            # pack_state (ops/pallas_round.py) stores k at bits 5..31 of
+            # an int32; k reaches max_rounds + 1, and (k << 5) must stay
+            # positive or the packed decided/killed/faulty bits corrupt
+            raise ValueError(
+                "use_pallas_round packs the round counter k into the top "
+                "27 bits of an int32; max_rounds must be < 2**26 - 1 "
+                f"(got {self.max_rounds})")
         if self.backend not in ("tpu", "express", "native"):
             raise ValueError(f"unknown backend: {self.backend}")
         if self.oracle_order not in ("fifo", "shuffle"):

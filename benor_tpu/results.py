@@ -295,17 +295,14 @@ def oracle_parity(trials: int, seed: int = 0, n: int = 100, f: int = 40,
                       max_rounds=64, oracle_order="shuffle")
     seeds = np.arange(s_seeds, dtype=np.uint32)
     t0 = time.perf_counter()
-    out_s = native_oracle.run_batch(cfg_o, vals, faulty, seeds)
+    # raise_on_cap: a capped seed's state is a mid-run snapshot, not a
+    # finished trace — it must not silently enter the invariance/KS
+    # samples or deflate the throughput
+    out_s = native_oracle.run_batch(cfg_o, vals, faulty, seeds,
+                                    raise_on_cap=True)
     oracle_elapsed = time.perf_counter() - t0
-    if (out_s["steps"] < 0).any():
-        # steps == -1 marks a step-cap trip: that seed's state is a
-        # mid-run snapshot, not a finished trace — it must not silently
-        # enter the invariance/KS samples or deflate the throughput
-        raise RuntimeError(
-            f"oracle_parity: {(out_s['steps'] < 0).sum()} seeds tripped "
-            "the oracle step cap; raise step_cap or shrink the scenario")
     out_f = native_oracle.run_batch(cfg_o.replace(oracle_order="fifo"),
-                                    vals, faulty, seeds)
+                                    vals, faulty, seeds, raise_on_cap=True)
     # the invariance theorem covers DECIDED runs only (a run capped
     # mid-coin-phase legitimately permutes its coin assignment) — compare
     # on seeds decided under both orders
@@ -313,7 +310,17 @@ def oracle_parity(trials: int, seed: int = 0, n: int = 100, f: int = 40,
            & out_f["decided"][:, healthy].all(axis=1))
     order_invariant = bool((out_s["x"][dec] == out_f["x"][dec]).all()
                            and (out_s["k"][dec] == out_f["k"][dec]).all())
-    k_oracle = out_s["k"][:, healthy].max(axis=1) - 1
+    # KS samples must hold FINISHED rounds-to-decide values only: a trial
+    # that hit max_rounds without all healthy lanes deciding contributes a
+    # CENSORED k (== the cap), which would bias both histograms in slower
+    # regimes (negligible here, mean k ~ 2 — but correctness is free)
+    dec_o = out_s["decided"][:, healthy].all(axis=1)
+    if not dec_o.any():
+        raise RuntimeError(
+            "oracle_parity: every oracle trial was censored at "
+            f"max_rounds={cfg_o.max_rounds}; raise max_rounds or shrink "
+            "the scenario")
+    k_oracle = out_s["k"][dec_o][:, healthy].max(axis=1) - 1
 
     cfg_t = SimConfig(n_nodes=n, n_faulty=f, trials=s_seeds,
                       delivery="quorum", scheduler="uniform",
@@ -322,12 +329,20 @@ def oracle_parity(trials: int, seed: int = 0, n: int = 100, f: int = 40,
     state = init(cfg_t, np.tile(np.asarray(vals, np.int8), (s_seeds, 1)),
                  faults)
     _, fin = run_consensus(cfg_t, state, faults, jax.random.key(seed + 11))
-    k_tpu = np.asarray(fin.k)[:, healthy].max(axis=1) - 1
+    dec_t = np.asarray(fin.decided)[:, healthy].all(axis=1)
+    if not dec_t.any():
+        raise RuntimeError(
+            "oracle_parity: every tpu trial was censored at "
+            f"max_rounds={cfg_t.max_rounds}; raise max_rounds or shrink "
+            "the scenario")
+    k_tpu = np.asarray(fin.k)[dec_t][:, healthy].max(axis=1) - 1
 
     stat, pvalue = ks_two_sample(k_oracle, k_tpu)
     res = {
         "n": n, "f": f, "n_seeds": int(s_seeds),
         "n_decided_both_orders": int(dec.sum()),
+        "n_censored": {"oracle": int((~dec_o).sum()),
+                       "tpu": int((~dec_t).sum())},
         "order_invariant_decided_runs": order_invariant,
         "oracle_mean_rounds": round(float(k_oracle.mean()), 4),
         "tpu_mean_rounds": round(float(k_tpu.mean()), 4),

@@ -1,0 +1,251 @@
+#!/usr/bin/env python3
+"""On-chip recapture daemon — "wedged round" insurance.
+
+The axon TPU tunnel wedges for hours at a time (round 3: 8+ h; round 4:
+the ENTIRE round — every deliverable shipped with CPU/interpret-mode
+numbers only).  This loop turns "the chip came back at 3am" into a
+captured artifact with no human in the loop:
+
+  probe (subprocess, 120 s timeout)  ──down──>  sleep, retry forever
+        │ live
+        v
+  snapshot committed HEAD into a git worktree  (.capture/wt — live edits
+        │                                       in the main tree can't
+        v                                       contaminate the capture)
+  python bench.py          -> BENCH_TPU.json + BENCH_DETAIL.json (repo root)
+  python -m benor_tpu results -> RESULTS/      (N=1M x 32 on the chip)
+        │
+        v
+  record the captured sha; keep watching — a NEW commit triggers a fresh
+  capture (so features landed after the chip returns still get on-chip
+  evidence), an unchanged HEAD just idles.
+
+Artifacts are written into the MAIN repo root but never committed by the
+daemon (committing would race the human's index); the round driver
+commits stragglers at round end.
+
+Usage:  python recapture.py [--once] [--interval 240] [--no-results]
+Logs:   .capture/recapture.log (tail -f it), state in .capture/state.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+CAP = os.path.join(HERE, ".capture")
+WT = os.path.join(CAP, "wt")
+STATE = os.path.join(CAP, "state.json")
+LOGF = os.path.join(CAP, "recapture.log")
+
+#: Generous per-stage budgets: a cold N=1M bench is ~16 regimes + 5 kernel
+#: checks of ~10-40 s remote compiles each; results is ~8-10 min cold.
+BENCH_TIMEOUT = 4200
+RESULTS_TIMEOUT = 4200
+
+
+def log(msg: str) -> None:
+    line = f"[{datetime.datetime.now():%H:%M:%S}] {msg}"
+    print(line, flush=True)
+    try:
+        with open(LOGF, "a") as fh:
+            fh.write(line + "\n")
+    except OSError:
+        pass
+
+
+def _git(*args: str, cwd: str = HERE) -> str:
+    r = subprocess.run(["git", *args], cwd=cwd, capture_output=True,
+                       text=True, check=True)
+    return r.stdout.strip()
+
+
+def head_sha() -> str:
+    return _git("rev-parse", "HEAD")
+
+
+def load_state() -> dict:
+    try:
+        with open(STATE) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return {}
+
+
+def save_state(st: dict) -> None:
+    os.makedirs(CAP, exist_ok=True)
+    with open(STATE, "w") as fh:
+        json.dump(st, fh, indent=1)
+
+
+def refresh_worktree(sha: str) -> None:
+    """Detached worktree at ``sha``; shares the main repo's compile cache
+    via symlink so the capture benefits from (and re-warms) one cache."""
+    os.makedirs(CAP, exist_ok=True)
+    if not os.path.isdir(os.path.join(WT, ".git")) and \
+            not os.path.isfile(os.path.join(WT, ".git")):
+        subprocess.run(["git", "worktree", "add", "--detach", WT, sha],
+                       cwd=HERE, check=True, capture_output=True)
+    else:
+        # -f: bench.py writes its tracked BENCH_DETAIL.json sidecar into
+        # the worktree, which would otherwise block every later checkout
+        _git("checkout", "-f", "--detach", sha, cwd=WT)
+    cache_link = os.path.join(WT, ".jax_cache")
+    main_cache = os.path.join(HERE, ".jax_cache")
+    os.makedirs(main_cache, exist_ok=True)
+    if not os.path.islink(cache_link):
+        if os.path.isdir(cache_link):
+            shutil.rmtree(cache_link)
+        os.symlink(main_cache, cache_link)
+    # native oracle builds on first use, but do it eagerly for clean logs
+    subprocess.run(["make", "-C", os.path.join(WT, "native")],
+                   capture_output=True)
+
+
+def probe(timeout_s: float = 120.0) -> str | None:
+    sys.path.insert(0, HERE)
+    try:
+        from benor_tpu.utils.backend import probe_backend
+    finally:
+        sys.path.pop(0)
+    return probe_backend(timeout_s, log=lambda s: log(f"probe: {s}"))
+
+
+def run_bench(sha: str) -> bool:
+    """bench.py in the worktree; promote artifacts only for a REAL
+    on-chip run (platform tpu-ish, no mid-run CPU fallback)."""
+    log(f"bench: starting at {sha[:10]} (budget {BENCH_TIMEOUT}s)")
+    env = {**os.environ, "BENCH_INIT_RETRIES": "2",
+           "BENCH_PROBE_TIMEOUT": "120"}
+    env.pop("BENCH_ALLOW_CPU", None)
+    try:
+        r = subprocess.run([sys.executable, "bench.py"], cwd=WT, env=env,
+                           capture_output=True, text=True,
+                           timeout=BENCH_TIMEOUT)
+    except subprocess.TimeoutExpired:
+        log("bench: TIMED OUT (tunnel likely wedged mid-run); will retry")
+        return False
+    tail = "\n".join((r.stderr or "").strip().splitlines()[-3:])
+    if r.returncode != 0:
+        log(f"bench: rc={r.returncode}\n{tail}")
+        return False
+    line = (r.stdout or "").strip().splitlines()[-1:]
+    try:
+        out = json.loads(line[0]) if line else None
+    except ValueError:
+        out = None
+    if not isinstance(out, dict) or "metric" not in out:
+        log(f"bench: rc=0 but final stdout line is not the emit() JSON "
+            f"({line[:1]!r}); not promoting")
+        return False
+    plat = out.get("platform", "?")
+    if out.get("fallback_cpu") or plat == "cpu" or out.get("error"):
+        log(f"bench: completed but NOT on-chip (platform={plat}, "
+            f"fallback={out.get('fallback_cpu')}, "
+            f"error={out.get('error')!r}); not promoting")
+        return False
+    out["capture"] = {"sha": sha,
+                      "utc": datetime.datetime.utcnow().isoformat(
+                          timespec="seconds") + "Z"}
+    with open(os.path.join(HERE, "BENCH_TPU.json"), "w") as fh:
+        json.dump(out, fh, indent=1)
+    detail = os.path.join(WT, "BENCH_DETAIL.json")
+    if os.path.exists(detail):
+        shutil.copy2(detail, os.path.join(HERE, "BENCH_DETAIL.json"))
+    log(f"bench: CAPTURED on {plat}: value={out.get('value')} "
+        f"{out.get('unit')} (vs_baseline={out.get('vs_baseline')})")
+    return True
+
+
+def run_results(sha: str) -> bool:
+    log(f"results: starting at {sha[:10]} (budget {RESULTS_TIMEOUT}s)")
+    out_dir = os.path.join(HERE, "RESULTS")
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "benor_tpu", "results", "--out", out_dir],
+            cwd=WT, capture_output=True, text=True, timeout=RESULTS_TIMEOUT)
+    except subprocess.TimeoutExpired:
+        log("results: TIMED OUT; will retry")
+        return False
+    tail = "\n".join(((r.stdout or "") + (r.stderr or ""))
+                     .strip().splitlines()[-4:])
+    if r.returncode != 0:
+        log(f"results: rc={r.returncode}\n{tail}")
+        return False
+    # honesty check: the artifact must say it ran on the accelerator
+    try:
+        with open(os.path.join(out_dir, "results.json")) as fh:
+            meta = json.load(fh).get("meta", {})
+    except (OSError, ValueError):
+        meta = {}
+    plat = str(meta.get("platform", "?"))
+    if "cpu" in plat.lower():
+        log(f"results: artifact platform={plat!r} — fell back, "
+            f"not counting as captured")
+        return False
+    log(f"results: CAPTURED (platform={plat!r}, n={meta.get('n_large')})")
+    return True
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--once", action="store_true",
+                    help="one probe+capture attempt, then exit")
+    ap.add_argument("--interval", type=float, default=240.0,
+                    help="seconds between probes while the tunnel is down")
+    ap.add_argument("--idle-interval", type=float, default=600.0,
+                    help="seconds between HEAD re-checks after a capture")
+    ap.add_argument("--no-results", action="store_true")
+    args = ap.parse_args()
+
+    log(f"recapture daemon up (pid {os.getpid()})")
+    while True:
+        st = load_state()
+        sha = head_sha()
+        done_bench = st.get("bench_sha") == sha
+        done_results = args.no_results or st.get("results_sha") == sha
+        if done_bench and done_results:
+            if args.once:
+                log("nothing to do (HEAD already captured)")
+                return 0
+            time.sleep(args.idle_interval)
+            continue
+        plat = probe()
+        if plat is None or plat == "cpu":
+            log(f"tunnel down (probe={plat!r}); "
+                f"next probe in {args.interval:.0f}s")
+            if args.once:
+                return 1
+            time.sleep(args.interval)
+            continue
+        log(f"tunnel LIVE (platform={plat}) — capturing {sha[:10]}")
+        try:
+            refresh_worktree(sha)
+        except subprocess.CalledProcessError as e:
+            log(f"worktree refresh failed: {e.stderr or e}")
+            if args.once:
+                return 1
+            time.sleep(args.interval)
+            continue
+        if not done_bench and run_bench(sha):
+            st["bench_sha"] = sha
+            save_state(st)
+        if not done_results and run_results(sha):
+            st["results_sha"] = sha
+            save_state(st)
+        if args.once:
+            ok = (st.get("bench_sha") == sha and
+                  (args.no_results or st.get("results_sha") == sha))
+            return 0 if ok else 1
+        time.sleep(30)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -156,3 +156,20 @@ def test_native_parity_api_surface():
     assert net.status(1) == ("faulty", 500)
     net.stop()
     assert net.status(2) == ("faulty", 500)
+
+
+def test_run_batch_surfaces_tripped_count():
+    """ADVICE r4: capped seeds must be countable (and refusable) without
+    every caller remembering to scan steps < 0."""
+    from benor_tpu.backends import native_oracle
+    cfg = SimConfig(n_nodes=5, n_faulty=0, backend="native", max_rounds=12)
+    vals, faulty = [1] * 5, [False] * 5
+    seeds = np.arange(8, dtype=np.uint32)
+    ok = native_oracle.run_batch(cfg, vals, faulty, seeds)
+    assert ok["n_tripped"] == 0
+    capped = native_oracle.run_batch(cfg, vals, faulty, seeds, step_cap=3)
+    assert capped["n_tripped"] == len(seeds)
+    assert (capped["steps"] < 0).all()
+    with pytest.raises(RuntimeError, match="step cap"):
+        native_oracle.run_batch(cfg, vals, faulty, seeds, step_cap=3,
+                                raise_on_cap=True)
