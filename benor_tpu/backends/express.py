@@ -211,6 +211,31 @@ class ExpressNetwork:
     def stop_node(self, node_id: int) -> None:
         self.nodes[node_id].on_stop()
 
+    def inject_message(self, node_id: int, k, x, message_type) -> bool:
+        """External message injection — the reference's POST /message
+        surface (node.ts:43-163) on the oracle's event loop.
+
+        The message is enqueued for ``node_id`` (under 'shuffle' its
+        delivery position is drawn from the seeded delivery stream like
+        any other pending message, so injected runs stay deterministic).
+        If the network has already started, the event loop re-drains so
+        the injection — and any cascade it triggers — settles before
+        returning; pre-start injections sit ahead of the start
+        broadcasts, one valid serialization of the reference's
+        fire-and-forget concurrency.
+
+        Returns False iff the target is killed at injection time: the
+        reference's 200 response sits INSIDE the ``!killed`` guard
+        (node.ts:44-161), so a killed node observably never answers —
+        callers mirror that on the wire.
+        """
+        if self.nodes[node_id].killed:
+            return False
+        self.queue.append((node_id, k, x, message_type))
+        if self._started:
+            self._drain()
+        return True
+
     def get_state(self, node_id: int, trial: int = 0) -> dict:
         self._check_trial(trial)
         return self.nodes[node_id].get_state()

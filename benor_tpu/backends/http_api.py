@@ -20,11 +20,15 @@ Semantics notes:
     reference's poll-during-run contract (benorconsensus.test.ts:149-160).
   * /stop kills only the receiving node (consensus.ts fans /stop out to all
     ports to stop the network, and so does ``stop_all``).
-  * POST /message (node.ts:43-163) answers 405 with an explanation: peer
-    messages are device-array data movement, not RPCs (SURVEY §5.8);
-    external injection would bypass the deterministic scheduler.  The GET
-    routes above are the ones the reference's control plane and test
-    harness actually consume (PARITY.md, 'Deliberate non-parities').
+  * POST /message (node.ts:43-163) is SERVED when the backing network is
+    an event-loop oracle (backend='express'): the forged message joins the
+    seeded drain queue, so injected runs stay deterministic, and a killed
+    target sends no response at all — the reference's 200 sits inside its
+    ``!killed`` guard (node.ts:44-161).  On the TPU backend it answers 405
+    with an explanation: peer messages are device-array data movement, not
+    RPCs (SURVEY §5.8); external injection would bypass the deterministic
+    scheduler.  The GET routes are the ones the reference's control plane
+    and test harness actually consume (PARITY.md).
 
 This layer exists for wire-level interop (curl, the reference's own test
 utilities pointed at localhost) at demo-scale N; in-process code should use
@@ -122,26 +126,81 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(400, {"error": "malformed Content-Length"},
                        as_json=True)
             return
-        # Drain the declared body before replying (same RST consideration).
+        # Read the declared body before replying (same RST consideration).
+        # Only /message consumes it, and a valid message is tens of bytes:
+        # everything else (and anything past the 1 MiB cap) is drained and
+        # discarded so a huge Content-Length cannot balloon memory.
+        keep = self.path == "/message"
+        cap = 1 << 20
+        chunks = []
+        kept = 0
         while length > 0:
             chunk = self.rfile.read(min(length, 1 << 16))
             if not chunk:
                 break
+            if keep and kept < cap:
+                chunks.append(chunk)
+                kept += len(chunk)
             length -= len(chunk)
-        if self.path == "/message":
-            # Deliberate non-parity with node.ts:43-163 (see PARITY.md):
-            # peer messages are device-array data movement under the seeded
-            # N9 scheduler; accepting external injections would bypass it
-            # and break reproducibility.  405 spells that out on the wire.
+        if not keep:
+            self._send(404, {"error": f"no route {self.path}"}, as_json=True)
+        elif kept >= cap:
+            self._send(413, {"error": "body too large"}, as_json=True)
+        else:
+            self._post_message(b"".join(chunks))
+
+    def _post_message(self, body: bytes) -> None:
+        """POST /message — the reference's peer-message route
+        (node.ts:43-163), served where injection is DETERMINISTIC.
+
+        On an event-loop oracle backend (one exposing ``inject_message``)
+        the forged message joins the seeded drain queue like any peer
+        broadcast: 200 {"message": "Message received"} (node.ts:161), or —
+        matching the reference, whose 200 sits inside the ``!killed``
+        guard (node.ts:44-161) — NO response at all when the target is
+        killed (the connection just closes).
+
+        On the TPU backend peer messages are device-array data movement
+        under the seeded N9 scheduler; accepting external injections would
+        bypass it and break reproducibility, so the deliberate non-parity
+        stands: 405 points at the oracle backends (PARITY.md).
+        """
+        net, nid = self.network, self.node_id
+        if not hasattr(net, "inject_message"):
             self._send(405, {
-                "error": "message injection not supported",
+                "error": "message injection not supported on this backend",
                 "detail": "peer messages are simulated on-device under a "
-                          "deterministic seeded scheduler; this control "
-                          "plane serves /status /start /stop /getState "
+                          "deterministic seeded scheduler; inject via an "
+                          "event-loop oracle backend (backend='express') "
+                          "or use /status /start /stop /getState "
                           "(see PARITY.md, 'Deliberate non-parities')",
             }, as_json=True, extra_headers=(("Allow", "GET"),))
+            return
+        try:
+            msg = json.loads(body.decode("utf-8"))
+            k, x, mtype = msg["k"], msg["x"], msg["messageType"]
+        except (ValueError, KeyError, UnicodeDecodeError, TypeError):
+            self._send(400, {"error": "body must be JSON with k, x, "
+                                      "messageType (node.ts:44)"},
+                       as_json=True)
+            return
+        # k keys the per-round buffers and mtype is string-compared: a
+        # JSON-valid but wrong-typed value (k = [1]) would otherwise
+        # poison the queue and blow up INSIDE the drain, wedging /start
+        if not isinstance(k, int) or isinstance(k, bool) \
+                or not isinstance(mtype, str):
+            self._send(400, {"error": "k must be an integer and "
+                                      "messageType a string"},
+                       as_json=True)
+            return
+        # injections serialize with /start (and each other) exactly like
+        # the reference's single-threaded event loop
+        with self.start_lock:
+            delivered = net.inject_message(nid, k, x, mtype)
+        if delivered:
+            self._send(200, {"message": "Message received"}, as_json=True)
         else:
-            self._send(404, {"error": f"no route {self.path}"}, as_json=True)
+            self.close_connection = True    # killed target: no response
 
 
 class NodeHttpCluster:

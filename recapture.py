@@ -165,11 +165,15 @@ def run_bench(sha: str) -> bool:
 
 
 def run_results(sha: str) -> bool:
+    """'benor_tpu results' into a STAGING dir, promoted to the main repo's
+    RESULTS/ only after the on-chip honesty check — a mid-run CPU fallback
+    must never overwrite previously captured on-chip artifacts."""
     log(f"results: starting at {sha[:10]} (budget {RESULTS_TIMEOUT}s)")
-    out_dir = os.path.join(HERE, "RESULTS")
+    stage = os.path.join(CAP, "RESULTS.stage")
+    shutil.rmtree(stage, ignore_errors=True)
     try:
         r = subprocess.run(
-            [sys.executable, "-m", "benor_tpu", "results", "--out", out_dir],
+            [sys.executable, "-m", "benor_tpu", "results", "--out", stage],
             cwd=WT, capture_output=True, text=True, timeout=RESULTS_TIMEOUT)
     except subprocess.TimeoutExpired:
         log("results: TIMED OUT; will retry")
@@ -181,7 +185,7 @@ def run_results(sha: str) -> bool:
         return False
     # honesty check: the artifact must say it ran on the accelerator
     try:
-        with open(os.path.join(out_dir, "results.json")) as fh:
+        with open(os.path.join(stage, "results.json")) as fh:
             meta = json.load(fh).get("meta", {})
     except (OSError, ValueError):
         meta = {}
@@ -190,6 +194,9 @@ def run_results(sha: str) -> bool:
         log(f"results: artifact platform={plat!r} — fell back, "
             f"not counting as captured")
         return False
+    out_dir = os.path.join(HERE, "RESULTS")
+    shutil.rmtree(out_dir, ignore_errors=True)
+    shutil.move(stage, out_dir)
     log(f"results: CAPTURED (platform={plat!r}, n={meta.get('n_large')})")
     return True
 

@@ -67,9 +67,11 @@ class TestHttpParity:
         with NodeHttpCluster(net, BASE):
             assert _get(BASE, "/nope")[0] == 404
 
-    def test_post_message_405_explains_non_parity(self, backend):
-        """Deliberate non-parity with node.ts:43-163 (PARITY.md): external
-        message injection is refused with an explanation, not a 404."""
+    def test_post_message_route(self, backend):
+        """POST /message (node.ts:43-163): served on the event-loop oracle
+        (200 {"message": "Message received"}, node.ts:161); deliberate
+        non-parity on the TPU backend — 405 with an explanation, not a 404
+        (PARITY.md)."""
         net = launch_network(1, 0, [1], [False], backend=backend)
         with NodeHttpCluster(net, BASE):
             req = urllib.request.Request(
@@ -81,8 +83,12 @@ class TestHttpParity:
                     code, body = resp.status, resp.read().decode()
             except urllib.error.HTTPError as e:
                 code, body = e.code, e.read().decode()
-            assert code == 405
-            assert "scheduler" in json.loads(body)["detail"]
+            if backend == "express":
+                assert code == 200
+                assert json.loads(body) == {"message": "Message received"}
+            else:
+                assert code == 405
+                assert "scheduler" in json.loads(body)["detail"]
 
     def test_faulty_node_state_is_null(self, backend):
         """faulty nodes report all-null state (node.ts:21-26)."""
@@ -232,4 +238,131 @@ def test_serve_network_usable_as_context_manager():
     net = launch_network(2, 0, [1, 1], [False, False], backend="tpu")
     with serve_network(net, BASE + 50):
         assert _get(BASE + 50, "/status") == (200, "live")
+    net.close()
+
+
+# ---------------------------------------------------------------------------
+# POST /message injection on the event-loop oracle (node.ts:43-163) —
+# r4 VERDICT task 7: the last reference wire surface, served where
+# injection is deterministic.
+# ---------------------------------------------------------------------------
+
+def _post(port: int, obj: dict):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/message", method="POST",
+        data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _forged_proposal_attack(order: str, base: int):
+    """Unanimous-0 network, forged all-1 proposals injected over HTTP at
+    every healthy node pre-start -> the network decides 1.
+
+    N=4 F=1: each healthy node's proposal buffer reaches the n-f=3
+    threshold on forged [1,1,1] alone, so its FIRST vote is 1, and no
+    healthy node ever votes 0 — count0 can never exceed F, making the
+    flip stable under the quirk-8 refires as real 0-proposals arrive."""
+    net = launch_network(4, 1, [0, 0, 0, 0], [False, False, False, True],
+                         backend="express", seed=7, oracle_order=order)
+    with NodeHttpCluster(net, base):
+        for nid in range(3):                       # healthy nodes
+            for _ in range(3):
+                code, body = _post(base + nid, {
+                    "k": 1, "x": 1, "messageType": "proposal phase"})
+                assert code == 200
+                assert json.loads(body) == {"message": "Message received"}
+        assert _get(base, "/start")[0] == 200
+        states = [json.loads(_get(base + i, "/getState")[1])
+                  for i in range(4)]
+    net.close()
+    return states
+
+
+def test_injected_forged_proposals_flip_the_outcome():
+    """The injection is REAL: without it the unanimous-0 scenario decides
+    0 (validity); with three forged 1-proposals per healthy node it
+    decides 1 — an observable state change through the reference's POST
+    /message wire surface."""
+    clean = launch_network(4, 1, [0, 0, 0, 0], [False, False, False, True],
+                           backend="express", seed=7)
+    clean.start()
+    assert all(s["decided"] and s["x"] == 0
+               for s in clean.get_states() if s["decided"] is not None)
+
+    states = _forged_proposal_attack("fifo", BASE + 80)
+    healthy = [s for s in states[:3]]
+    assert all(s["decided"] for s in healthy)
+    assert all(s["x"] == 1 for s in healthy), healthy
+    assert states[3]["killed"] and states[3]["x"] is None   # faulty: null
+
+
+def test_injection_is_deterministic_under_shuffle():
+    """Under oracle_order='shuffle' the injected message's delivery
+    position is drawn from the SEEDED delivery stream: two identical
+    injected runs are bit-identical."""
+    a = _forged_proposal_attack("shuffle", BASE + 85)
+    b = _forged_proposal_attack("shuffle", BASE + 90)
+    assert a == b
+
+
+def test_post_message_to_killed_node_gets_no_response():
+    """The reference's 200 sits INSIDE the !killed guard (node.ts:44-161):
+    a killed node observably never answers /message.  On the wire that is
+    a closed connection with no status line."""
+    net = launch_network(2, 1, [1, 1], [True, False], backend="express",
+                         seed=0)
+    with NodeHttpCluster(net, BASE + 95):
+        # node 0 is faulty (killed from birth): no response at all
+        resp = _raw_request(
+            BASE + 95,
+            b"POST /message HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Type: application/json\r\nContent-Length: 45\r\n\r\n"
+            b'{"k":1,"x":1,"messageType":"proposal phase"}\n')
+        assert resp == b""
+        # the healthy node still answers
+        code, _ = _post(BASE + 96, {"k": 1, "x": 1,
+                                    "messageType": "proposal phase"})
+        assert code == 200
+    net.close()
+
+
+def test_post_message_malformed_body_400():
+    net = launch_network(1, 0, [1], [False], backend="express", seed=0)
+    with NodeHttpCluster(net, BASE + 98):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{BASE + 98}/message", method="POST",
+            data=b"not json")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 400
+        # a well-formed body missing a field is also a 400, not a crash
+        code, _ = _post(BASE + 98, {"k": 1})
+        assert code == 400
+    net.close()
+
+
+def test_post_injection_after_termination_targets_killed_nodes():
+    """After the halt probe has killed the (all-decided) network, every
+    node is killed: injection gets the reference's no-response behavior
+    and the final state is untouched."""
+    net = launch_network(3, 0, [1, 1, 1], [False] * 3, backend="express",
+                         seed=2)
+    with NodeHttpCluster(net, BASE + 99):
+        _get(BASE + 99, "/start")
+        before = [json.loads(_get(BASE + 99 + i, "/getState")[1])
+                  for i in range(3)]
+        resp = _raw_request(
+            BASE + 99,
+            b"POST /message HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Length: 45\r\n\r\n"
+            b'{"k":9,"x":0,"messageType":"voting phase"}\n  ')
+        assert resp == b""
+        after = [json.loads(_get(BASE + 99 + i, "/getState")[1])
+                 for i in range(3)]
+        assert before == after
     net.close()
