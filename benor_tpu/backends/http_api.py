@@ -167,12 +167,16 @@ class _Handler(BaseHTTPRequestHandler):
         """
         net, nid = self.network, self.node_id
         if not hasattr(net, "inject_message"):
+            # tpu backend: messages are on-device data movement under the
+            # seeded N9 scheduler.  native oracle: the batched C++ engine
+            # runs whole trials in one library call, so there is no
+            # Python-visible queue to inject into between deliveries.
             self._send(405, {
                 "error": "message injection not supported on this backend",
-                "detail": "peer messages are simulated on-device under a "
-                          "deterministic seeded scheduler; inject via an "
-                          "event-loop oracle backend (backend='express') "
-                          "or use /status /start /stop /getState "
+                "detail": "injection is served on the Python event-loop "
+                          "oracle (backend='express'), where the forged "
+                          "message joins the seeded drain queue; this "
+                          "backend serves /status /start /stop /getState "
                           "(see PARITY.md, 'Deliberate non-parities')",
             }, as_json=True, extra_headers=(("Allow", "GET"),))
             return

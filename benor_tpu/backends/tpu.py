@@ -69,33 +69,40 @@ class TpuNetwork:
         """
         if self._started:
             return
-        if on_slice is not None and not (self.cfg.poll_rounds > 0
-                                         and self.cfg.mesh_shape is None):
+        if on_slice is not None and not self.cfg.poll_rounds > 0:
             # a silently-never-fired callback is indistinguishable from a
             # real observability bug — fail loudly instead
             raise ValueError(
-                "start(on_slice=...) requires SimConfig(poll_rounds > 0) "
-                "on the single-device path; this config runs one "
-                "uninterrupted compiled loop")
+                "start(on_slice=...) requires SimConfig(poll_rounds > 0); "
+                "this config runs one uninterrupted compiled loop")
         base_key = jax.random.key(self.cfg.seed)
-        if self.cfg.mesh_shape is not None:
-            from ..parallel import make_mesh, run_consensus_sharded
-            mesh = make_mesh(*self.cfg.mesh_shape)
-            rounds, final = run_consensus_sharded(
-                self.cfg, self.state, self.faults, base_key, mesh)
-            self.rounds_executed = int(rounds)
-            self.state = final
-        elif self.cfg.poll_rounds > 0:
+        if self.cfg.poll_rounds > 0:
+            # sliced mid-run observability — single-device AND sharded
+            # (the mesh case swaps in the shard_map'd slice primitive;
+            # everything else, including bit-identity with the one-shot
+            # path, is shared)
             from ..models.benor import all_settled
             from ..sim import run_consensus_slice, start_state
             import jax.numpy as jnp
+            if self.cfg.mesh_shape is not None:
+                from ..parallel import (make_mesh,
+                                        run_consensus_slice_sharded)
+                mesh = make_mesh(*self.cfg.mesh_shape)
+
+                def slice_fn(st, r, until):
+                    return run_consensus_slice_sharded(
+                        self.cfg, st, self.faults, base_key, mesh, r, until)
+            else:
+                def slice_fn(st, r, until):
+                    return run_consensus_slice(
+                        self.cfg, st, self.faults, base_key,
+                        jnp.int32(r), jnp.int32(until))
             state = start_state(self.cfg, self.state)
             self.state = state               # k=1 visible (node.ts:172)
             r = 1
             while True:
-                r_next, state = run_consensus_slice(
-                    self.cfg, state, self.faults, base_key,
-                    jnp.int32(r), jnp.int32(r + self.cfg.poll_rounds))
+                r_next, state = slice_fn(state, r,
+                                         r + self.cfg.poll_rounds)
                 self.state = state           # publish the live snapshot
                 if on_slice is not None:
                     on_slice()
@@ -105,6 +112,13 @@ class TpuNetwork:
                     break
                 r = rn
             self.rounds_executed = rn - 1
+        elif self.cfg.mesh_shape is not None:
+            from ..parallel import make_mesh, run_consensus_sharded
+            mesh = make_mesh(*self.cfg.mesh_shape)
+            rounds, final = run_consensus_sharded(
+                self.cfg, self.state, self.faults, base_key, mesh)
+            self.rounds_executed = int(rounds)
+            self.state = final
         else:
             rounds, final = run_consensus(self.cfg, self.state, self.faults,
                                           base_key)

@@ -6,12 +6,13 @@ from .multihost import (global_mesh, init_multihost, local_block,
                         make_global, resume_consensus_multihost,
                         run_consensus_multihost, to_global)
 from .sharded import (MESH_CTX, resume_consensus_sharded,
-                      run_consensus_sharded, shard_inputs)
+                      run_consensus_sharded, run_consensus_slice_sharded,
+                      shard_inputs)
 
 __all__ = [
     "AXIS_NODES", "AXIS_TRIALS", "STATE_SPEC", "make_mesh", "state_sharding",
     "MESH_CTX", "resume_consensus_sharded", "run_consensus_sharded",
-    "shard_inputs",
+    "run_consensus_slice_sharded", "shard_inputs",
     "init_multihost", "global_mesh", "local_block", "to_global",
     "make_global", "run_consensus_multihost", "resume_consensus_multihost",
 ]

@@ -56,28 +56,14 @@ def _local_run(cfg: SimConfig, fresh: bool, state: NetState,
     The loop carries a replicated ``settled`` flag computed via psum so all
     shards take identical trip counts (a shard-local predicate would
     deadlock the collectives inside the body).
+
+    Implemented as an unbounded _local_slice (until_round past the cap),
+    so the round loop exists ONCE.
     """
-    ctx = MESH_CTX
     if fresh:
         state = start_state(cfg, state)
-
-    def body(carry):
-        r, st, _ = carry
-        st = benor_round(cfg, st, faults, base_key, r, ctx)
-        if cfg.debug:  # per-round host callback (SURVEY §5.1) — globalized
-            # counts, emitted once per round by the (0, 0) shard; unordered
-            # (ordered effects unsupported on >1 device, see tracing.py)
-            from ..utils.tracing import emit_round_event
-            emit_round_event(st, ctx)
-        return (r + 1, st, all_settled(st, ctx))
-
-    def cond(carry):
-        r, _, settled = carry
-        return (r <= cfg.max_rounds) & ~settled
-
-    r, state, _ = jax.lax.while_loop(
-        cond, body,
-        (from_round.astype(jnp.int32), state, all_settled(state, ctx)))
+    r, state = _local_slice(cfg, state, faults, base_key, from_round,
+                            jnp.int32(cfg.max_rounds + 1))
     return r - 1, state
 
 
@@ -115,6 +101,69 @@ def run_consensus_sharded(cfg: SimConfig, state: NetState, faults: FaultSpec,
     meshlib.check_divisible(cfg.trials, cfg.n_nodes, mesh)
     state, faults = shard_inputs(state, faults, mesh)
     return _compiled(cfg, mesh)(state, faults, base_key, jnp.int32(1))
+
+
+def _local_slice(cfg: SimConfig, state: NetState, faults: FaultSpec,
+                 base_key: jax.Array, from_round: jax.Array,
+                 until_round: jax.Array) -> Tuple[jax.Array, NetState]:
+    """Per-shard slice body: at most ``until_round - from_round`` rounds.
+
+    The sharded counterpart of sim.run_consensus_slice (same contract:
+    returns (next_round, state); the caller applies the /start transition
+    once).  Both round bounds are TRACED replicated scalars, so every
+    slice of every chunk size reuses one compiled executable per
+    (config, mesh) — the same trick _local_run plays for resume.  The
+    replicated ``settled`` psum keeps trip counts identical across shards.
+    """
+    ctx = MESH_CTX
+
+    def body(carry):
+        r, st, _ = carry
+        st = benor_round(cfg, st, faults, base_key, r, ctx)
+        if cfg.debug:
+            from ..utils.tracing import emit_round_event
+            emit_round_event(st, ctx)
+        return (r + 1, st, all_settled(st, ctx))
+
+    def cond(carry):
+        r, _, settled = carry
+        return (r <= cfg.max_rounds) & ~settled & (r < until_round)
+
+    r, state, _ = jax.lax.while_loop(
+        cond, body,
+        (from_round.astype(jnp.int32), state, all_settled(state, ctx)))
+    return r, state
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_slice(cfg: SimConfig, mesh: Mesh):
+    sspec = meshlib.STATE_SPEC
+    fn = shard_map(
+        functools.partial(_local_slice, cfg),
+        mesh=mesh,
+        in_specs=(sspec, sspec, P(), P(), P()),
+        out_specs=(P(), sspec),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def run_consensus_slice_sharded(cfg: SimConfig, state: NetState,
+                                faults: FaultSpec, base_key: jax.Array,
+                                mesh: Mesh, from_round, until_round
+                                ) -> Tuple[jax.Array, NetState]:
+    """Mid-run observability (cfg.poll_rounds) under a device mesh.
+
+    Same semantics as sim.run_consensus_slice; because every random draw
+    is keyed on global (trial, node, round) ids, a sliced sharded run is
+    bit-identical to the one-shot sharded run AND to the single-device
+    run for any mesh shape (tests/test_parallel.py pins both).
+    """
+    meshlib.check_divisible(cfg.trials, cfg.n_nodes, mesh)
+    state, faults = shard_inputs(state, faults, mesh)
+    return _compiled_slice(cfg, mesh)(state, faults, base_key,
+                                      jnp.int32(from_round),
+                                      jnp.int32(until_round))
 
 
 def resume_consensus_sharded(cfg: SimConfig, state: NetState,

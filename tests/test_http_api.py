@@ -88,7 +88,7 @@ class TestHttpParity:
                 assert json.loads(body) == {"message": "Message received"}
             else:
                 assert code == 405
-                assert "scheduler" in json.loads(body)["detail"]
+                assert "express" in json.loads(body)["detail"]
 
     def test_faulty_node_state_is_null(self, backend):
         """faulty nodes report all-null state (node.ts:21-26)."""
