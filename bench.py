@@ -291,6 +291,18 @@ def _regimes(n, trials, fracs, max_rounds, seed, use_pallas_hist=False):
                            "use_pallas_hist": False})
         fl = FaultSpec.first_f(cfg)             # alive equivocators
         regs.append((name, cfg, init_state(cfg, bal, fl), fl))
+
+    # uniform-scheduler equivocate at flagship scale: the regime whose
+    # tallies run the fused mixed-population ROUND kernels at N=1M when
+    # the pallas path is on (r4 VERDICT task 6); equivocators are alive,
+    # so the quorum sees the full population and n_equiv = F
+    f_eq = int(0.2 * n)
+    cfg = SimConfig(scheduler="uniform",
+                    **{**base, "fault_model": "equivocate",
+                       "n_faulty": f_eq,
+                       "use_pallas_round": use_pallas_hist})
+    fl = FaultSpec.first_f(cfg)
+    regs.append(("equiv_uniform_f0.20", cfg, init_state(cfg, bal, fl), fl))
     return regs
 
 
@@ -569,39 +581,53 @@ def _pallas_round_check(n: int, trials: int, seed: int) -> dict:
         from benor_tpu.ops import sampling
         n = min(n, 2 * sampling.EXACT_TABLE_MAX)
         trials = min(trials, 4)
-    f = int(0.40 * n)
-    outs, times = [], []
-    for use_round in (False, True):
-        cfg = SimConfig(n_nodes=n, n_faulty=f, trials=trials,
-                        delivery="quorum", scheduler="uniform",
-                        path="histogram", use_pallas_hist=True,
-                        use_pallas_round=use_round, max_rounds=64,
-                        seed=seed)
-        faults = FaultSpec.none(trials, n)
-        state = init_state(cfg, balanced_inputs(trials, n), faults)
-        key = jax.random.key(seed)
-        r, fin = run_consensus(cfg, state, faults, key)
-        int(r)                                   # compile + completion
-        loops = 1 if interpret else 5
-        t0 = time.perf_counter()
-        for _ in range(loops):
+
+    def pair(fault_model, f_frac):
+        f = int(f_frac * n)
+        outs, times = [], []
+        for use_round in (False, True):
+            cfg = SimConfig(n_nodes=n, n_faulty=f, trials=trials,
+                            delivery="quorum", scheduler="uniform",
+                            path="histogram", fault_model=fault_model,
+                            use_pallas_hist=True,
+                            use_pallas_round=use_round, max_rounds=64,
+                            seed=seed)
+            # zero crashes on the flagship regime (crash faults clamp the
+            # draws); equivocators stay ALIVE, so first_f is non-vacuous
+            faults = (FaultSpec.first_f(cfg)
+                      if fault_model == "equivocate"
+                      else FaultSpec.none(trials, n))
+            state = init_state(cfg, balanced_inputs(trials, n), faults)
+            key = jax.random.key(seed)
             r, fin = run_consensus(cfg, state, faults, key)
-        int(r)
-        times.append((time.perf_counter() - t0) / loops)
-        outs.append((int(r), np.asarray(fin.x), np.asarray(fin.decided),
-                     np.asarray(fin.k)))
-    (r0, x0, d0, k0), (r1, x1, d1, k1) = outs
-    assert r0 == r1
-    np.testing.assert_array_equal(x0, x1)
-    np.testing.assert_array_equal(d0, d1)
-    np.testing.assert_array_equal(k0, k1)
-    return {
-        "bit_equal": True, "interpret": interpret,
-        "n": n, "trials": trials, "rounds": r0,
-        "unfused_ms": round(times[0] * 1e3, 3),
-        "fused_ms": round(times[1] * 1e3, 3),
-        "speedup": round(times[0] / times[1], 3) if times[1] > 0 else None,
-    }
+            int(r)                               # compile + completion
+            loops = 1 if interpret else 5
+            t0 = time.perf_counter()
+            for _ in range(loops):
+                r, fin = run_consensus(cfg, state, faults, key)
+            int(r)
+            times.append((time.perf_counter() - t0) / loops)
+            outs.append((int(r), np.asarray(fin.x),
+                         np.asarray(fin.decided), np.asarray(fin.k)))
+        (r0, x0, d0, k0), (r1, x1, d1, k1) = outs
+        assert r0 == r1
+        np.testing.assert_array_equal(x0, x1)
+        np.testing.assert_array_equal(d0, d1)
+        np.testing.assert_array_equal(k0, k1)
+        return {
+            "bit_equal": True, "interpret": interpret,
+            "n": n, "trials": trials, "rounds": r0,
+            "unfused_ms": round(times[0] * 1e3, 3),
+            "fused_ms": round(times[1] * 1e3, 3),
+            "speedup": (round(times[0] / times[1], 3)
+                        if times[1] > 0 else None),
+        }
+
+    res = pair("crash", 0.40)          # the flagship multi-round regime
+    # the equivocate regime's fused mixed-population kernels (r4 VERDICT
+    # task 6): same bit-identity contract, separate timing
+    res["equiv"] = pair("equivocate", 0.20)
+    return res
 
 
 def bench_sweep(platform: str, fallback: bool) -> dict:
@@ -653,7 +679,8 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
                 f"falling back to the XLA sampler for this regime")
             demoted.append({"regime": name,
                             "error": f"{type(e).__name__}: {e}"[:300]})
-            cfg = cfg.replace(use_pallas_hist=False)
+            cfg = cfg.replace(use_pallas_hist=False,
+                              use_pallas_round=False)
             regimes[i] = (name, cfg, state, faults)
             r, final = run_consensus(cfg, state, faults, base_key)
             int(r)
@@ -709,6 +736,7 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
             "regime": name, "f_frac": round(cfg.n_faulty / n, 3),
             "scheduler": cfg.scheduler, "coin": cfg.coin_mode,
             "pallas": cfg.use_pallas_hist,
+            "fused_round": cfg.use_pallas_round,
             "rounds_executed": rounds,
             "decided": round(float(dec_frac), 4),
             "mean_k": round(float(mean_k), 3),

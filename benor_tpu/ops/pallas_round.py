@@ -33,14 +33,16 @@ array through the entire while-loop: pack/unpack happen once per RUN.
 (models/benor.py under the sharded runner, trajectory/slice paths).
 
 Stream identity: the draws use the SAME key/counter schemes as
-cf_counts_pallas / coin_flips_pallas / weak_coin_flips_pallas, so a
-``use_pallas_round=True`` run is BIT-IDENTICAL to the unfused
-``use_pallas_hist=True`` path — pinned by tests/test_pallas_round.py,
-which makes interpret-mode CPU testing exact rather than statistical.
+cf_counts_pallas / equiv_counts_pallas / coin_flips_pallas /
+weak_coin_flips_pallas, so a ``use_pallas_round=True`` run is
+BIT-IDENTICAL to the unfused ``use_pallas_hist=True`` path — pinned by
+tests/test_pallas_round.py, which makes interpret-mode CPU testing exact
+rather than statistical.
 
 Engages (ops/tally.py:pallas_round_active) on top of the pallas-hist
-regime for every fault model except equivocate, coin_mode private /
-common / weak_common with 0 < eps < 1.
+regime for every fault model (equivocate runs the mixed-population
+sampler in-kernel over honest-only histograms + the run-constant
+n_equiv), coin_mode private / common / weak_common with 0 < eps < 1.
 """
 
 from __future__ import annotations
@@ -52,8 +54,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .pallas_hist import (_COIN_SALT, TILE_N, _bits_to_uniform, _cf_draw,
-                          _lane_ids, _stream_scal, _threefry2x32)
+from .pallas_hist import (_COIN_SALT, _EQUIV_SALT_OFFSET, TILE_N,
+                          _bits_to_uniform, _cf_draw, _lane_ids,
+                          _ndtri_as241, _stream_scal, _threefry2x32)
 from ..config import VAL0, VAL1, VALQ
 from ..state import NetState
 
@@ -115,6 +118,47 @@ def _sent(fault_model, vote, faulty):
     return vote
 
 
+def _honest(fault_model, alive, faulty):
+    """Histogram population: under 'equivocate' the faulty bit marks live
+    equivocators, whose broadcast slot is ignored (their per-edge values
+    are drawn receiver-side) — every other fault model tallies all live
+    senders (byzantine lanes count, with flipped values)."""
+    if fault_model == "equivocate":
+        return alive & (faulty == 0)
+    return alive
+
+
+def _mixed_draws(m, scal_ref, scal2_ref, c0, c1, cq, ne, shape):
+    """The equivocate-regime mixed-population sampler, fused.
+
+    Verbatim mirror of pallas_hist._equiv_kernel (draw ORDER included, so
+    the fused round stays bit-identical to the unfused
+    equiv_counts_pallas path): h_b delivered equivocators ~ CF
+    hypergeometric from the phase+64 block's word 0, honest (c0, c1, cq)
+    split of the remainder from the phase block's two words, fair
+    Binomial(h_b, 1/2) class split from the phase+64 block's word 1.
+    Returns the per-lane TOTAL (p0, p1) tallies, f32.
+    """
+    node, trial = _lane_ids(scal_ref, shape)
+    b0, b1 = _threefry2x32(scal_ref[0], scal_ref[1], node, trial)
+    b2, b3 = _threefry2x32(scal2_ref[0], scal2_ref[1], node, trial)
+    u0 = _bits_to_uniform(b0)
+    u1 = _bits_to_uniform(b1)
+    u_b = _bits_to_uniform(b2)
+    u_s = _bits_to_uniform(b3)
+    total_h = c0 + c1 + cq
+    total = total_h + ne
+    mf = jnp.float32(m)
+    h_b = _cf_draw(u_b, total, ne, mf)
+    rem = jnp.maximum(mf - h_b, 0.0)
+    h0 = _cf_draw(u0, total_h, c0, rem)
+    h1 = _cf_draw(u1, jnp.maximum(total_h - c0, 0.0), c1,
+                  jnp.maximum(rem - h0, 0.0))
+    z = _ndtri_as241(u_s)
+    bs = jnp.clip(jnp.round(h_b * 0.5 + z * jnp.sqrt(h_b) * 0.5), 0.0, h_b)
+    return h0 + (h_b - bs), h1 + bs
+
+
 def _partial_cols(t, cols):
     """[T]-vectors -> the [1, T, 128] partial layout (col i = cols[i])."""
     col = jax.lax.broadcasted_iota(jnp.int32, (1, t, 128), 2)
@@ -127,40 +171,50 @@ def _partial_cols(t, cols):
 def _prop_hist_kernel(m, fault_model, freeze, has_cr, *refs):
     """One lane-tile of the fused PROPOSAL phase.
 
-    Per-lane CF tallies from the global proposal histogram -> phase-1
-    majority/tie (node.ts:63-69) -> each lane's (byzantine-flipped) vote
-    value -> per-tile partials: cols 0-2 vote-class histogram, col 3 the
-    tile's alive count (feeding n_alive / the quorum gate).
+    Per-lane CF tallies from the global proposal histogram (the
+    mixed-population sampler under 'equivocate') -> phase-1 majority/tie
+    (node.ts:63-69) -> each lane's (byzantine-flipped) vote value ->
+    per-tile partials: cols 0-2 vote-class histogram over HONEST live
+    lanes, col 3 the tile's alive count (feeding n_alive / the quorum
+    gate — equivocators count as live senders).
     """
-    if has_cr:
-        scal_ref, rr_ref, c0_ref, c1_ref, cq_ref, p_ref, cr_ref, out_ref \
-            = refs
-        cr = cr_ref[...]
-    else:
-        scal_ref, rr_ref, c0_ref, c1_ref, cq_ref, p_ref, out_ref = refs
-        cr = None
+    has_eq = fault_model == "equivocate"
+    refs = list(refs)
+    scal_ref = refs.pop(0)
+    scal2_ref = refs.pop(0) if has_eq else None
+    rr_ref, c0_ref, c1_ref, cq_ref = refs[:4]
+    refs = refs[4:]
+    ne_ref = refs.pop(0) if has_eq else None
+    p_ref = refs.pop(0)
+    cr = refs.pop(0)[...] if has_cr else None
+    (out_ref,) = refs
     p = p_ref[...]
     x, decided, killed, faulty, k, alive, frozen = _fields(
         p, rr_ref[0], cr, fault_model, freeze)
 
-    node, trial = _lane_ids(scal_ref, p.shape)
-    b0, b1 = _threefry2x32(scal_ref[0], scal_ref[1], node, trial)
-    u0 = _bits_to_uniform(b0)
-    u1 = _bits_to_uniform(b1)
     c0 = c0_ref[...]
     c1 = c1_ref[...]
     cq = cq_ref[...]
-    total = c0 + c1 + cq
-    mf = jnp.float32(m)
-    p0 = _cf_draw(u0, total, c0, mf)
-    p1 = _cf_draw(u1, jnp.maximum(total - c0, 0.0), c1,
-                  jnp.maximum(mf - p0, 0.0))
+    if has_eq:
+        p0, p1 = _mixed_draws(m, scal_ref, scal2_ref, c0, c1, cq,
+                              ne_ref[...], p.shape)
+    else:
+        node, trial = _lane_ids(scal_ref, p.shape)
+        b0, b1 = _threefry2x32(scal_ref[0], scal_ref[1], node, trial)
+        u0 = _bits_to_uniform(b0)
+        u1 = _bits_to_uniform(b1)
+        total = c0 + c1 + cq
+        mf = jnp.float32(m)
+        p0 = _cf_draw(u0, total, c0, mf)
+        p1 = _cf_draw(u1, jnp.maximum(total - c0, 0.0), c1,
+                      jnp.maximum(mf - p0, 0.0))
     x1 = jnp.where(p0 > p1, VAL0, jnp.where(p1 > p0, VAL1, VALQ))
 
     vote = _sent(fault_model, jnp.where(frozen, x, x1), faulty)
+    hon = _honest(fault_model, alive, faulty)
     t = p.shape[0]
     out_ref[...] = _partial_cols(t, [
-        jnp.sum((vote == v) & alive, axis=1, dtype=jnp.int32)
+        jnp.sum((vote == v) & hon, axis=1, dtype=jnp.int32)
         for v in (VAL0, VAL1, VALQ)
     ] + [jnp.sum(alive, axis=1, dtype=jnp.int32)])
 
@@ -169,38 +223,48 @@ def _vote_commit_kernel(m, n_faulty, rule, coin_mode, eps, freeze,
                         fault_model, has_cr, *refs):
     """One lane-tile of the fused VOTE phase + commit.
 
-    CF vote draws -> decide/adopt/coin (node.ts:99-112) -> the new packed
-    state word, plus per-tile partials: cols 0-2 the NEXT round's proposal
-    histogram (of the new sent values; exact for static-killed fault
+    CF vote draws (mixed-population under 'equivocate') -> decide/adopt/
+    coin (node.ts:99-112) -> the new packed state word, plus per-tile
+    partials: cols 0-2 the NEXT round's proposal histogram (of the new
+    sent values over HONEST live lanes; exact for static-killed fault
     models — the crash_at_round caller recomputes it in XLA instead),
     col 3 settled count, col 4 unsettled count (the loop predicate).
     """
-    if has_cr:
-        (vote_scal_ref, coin_scal_ref, rk_ref, c0_ref, c1_ref, cq_ref,
-         qok_ref, shared_ref, p_ref, cr_ref, np_ref, part_ref) = refs
-        cr = cr_ref[...]
-    else:
-        (vote_scal_ref, coin_scal_ref, rk_ref, c0_ref, c1_ref, cq_ref,
-         qok_ref, shared_ref, p_ref, np_ref, part_ref) = refs
-        cr = None
+    has_eq = fault_model == "equivocate"
+    refs = list(refs)
+    vote_scal_ref = refs.pop(0)
+    vote_scal2_ref = refs.pop(0) if has_eq else None
+    coin_scal_ref, rk_ref, c0_ref, c1_ref, cq_ref = refs[:5]
+    refs = refs[5:]
+    ne_ref = refs.pop(0) if has_eq else None
+    qok_ref, shared_ref, p_ref = refs[:3]
+    refs = refs[3:]
+    cr = refs.pop(0)[...] if has_cr else None
+    np_ref, part_ref = refs
     p = p_ref[...]
     rr = rk_ref[0] - 1
     x, decided, killed, faulty, k, alive, frozen = _fields(
         p, rr, cr, fault_model, freeze)
 
-    # --- the sampler body, verbatim from pallas_hist._cf_kernel ---------
+    # --- the sampler body, verbatim from pallas_hist._cf_kernel (or
+    # _equiv_kernel in the equivocate regime) ----------------------------
     node, trial = _lane_ids(vote_scal_ref, p.shape)
-    b0, b1 = _threefry2x32(vote_scal_ref[0], vote_scal_ref[1], node, trial)
-    u0 = _bits_to_uniform(b0)
-    u1 = _bits_to_uniform(b1)
     c0 = c0_ref[...]
     c1 = c1_ref[...]
     cq = cq_ref[...]
-    total = c0 + c1 + cq
-    mf = jnp.float32(m)
-    v0 = _cf_draw(u0, total, c0, mf)
-    v1 = _cf_draw(u1, jnp.maximum(total - c0, 0.0), c1,
-                  jnp.maximum(mf - v0, 0.0))
+    if has_eq:
+        v0, v1 = _mixed_draws(m, vote_scal_ref, vote_scal2_ref, c0, c1,
+                              cq, ne_ref[...], p.shape)
+    else:
+        b0, b1 = _threefry2x32(vote_scal_ref[0], vote_scal_ref[1],
+                               node, trial)
+        u0 = _bits_to_uniform(b0)
+        u1 = _bits_to_uniform(b1)
+        total = c0 + c1 + cq
+        mf = jnp.float32(m)
+        v0 = _cf_draw(u0, total, c0, mf)
+        v1 = _cf_draw(u1, jnp.maximum(total - c0, 0.0), c1,
+                      jnp.maximum(mf - v0, 0.0))
 
     # --- the coin, verbatim from _coin_kernel / _weak_coin_kernel -------
     pbits, dbits = _threefry2x32(coin_scal_ref[0], coin_scal_ref[1],
@@ -240,9 +304,10 @@ def _vote_commit_kernel(m, n_faulty, rule, coin_mode, eps, freeze,
 
     sent_next = _sent(fault_model, new_x, faulty)
     settled = (new_dec == 1) | (killed == 1)
+    hon = _honest(fault_model, alive, faulty)
     t = p.shape[0]
     part_ref[...] = _partial_cols(t, [
-        jnp.sum((sent_next == v) & alive, axis=1, dtype=jnp.int32)
+        jnp.sum((sent_next == v) & hon, axis=1, dtype=jnp.int32)
         for v in (VAL0, VAL1, VALQ)
     ] + [jnp.sum(settled, axis=1, dtype=jnp.int32),
          jnp.sum(~settled, axis=1, dtype=jnp.int32)])
@@ -271,16 +336,18 @@ def _part(t):
 def proposal_hist_pallas(base_key, r, phase, hist, pack, crash_round,
                          m: int, fault_model: str, freeze: bool,
                          interpret: bool = False, node_offset=0,
-                         trial_offset=0):
+                         trial_offset=0, n_equiv=None):
     """Fused proposal phase over the packed state -> partials int32
     [T, 128]: cols 0-2 this shard's LOCAL vote histogram, col 3 its alive
     count (callers psum both over the nodes axis under a mesh).
 
-    hist: int32 [T, 3] global PROPOSAL class counts; pack: padded packed
-    state [T, Np]; crash_round: int32 [T, Np-padded] (crash_at_round
-    only, else None).  Uses the PHASE_PROPOSAL stream of cf_counts_pallas
-    verbatim, so the implied per-lane x1 — and hence the histogram — is
-    bit-identical to the unfused pallas path.
+    hist: int32 [T, 3] global PROPOSAL class counts (HONEST senders only
+    under 'equivocate'); pack: padded packed state [T, Np]; crash_round:
+    int32 [T, Np-padded] (crash_at_round only, else None); n_equiv: int32
+    [T] global live-equivocator count ('equivocate' only, else None).
+    Uses the PHASE_PROPOSAL stream of cf_counts_pallas (equiv_counts_pallas
+    in the equivocate regime) verbatim, so the implied per-lane x1 — and
+    hence the histogram — is bit-identical to the unfused pallas path.
     """
     T, np_total = pack.shape
     r = jnp.asarray(r, jnp.int32)
@@ -288,9 +355,17 @@ def proposal_hist_pallas(base_key, r, phase, hist, pack, crash_round,
     cls = hist.astype(jnp.float32)[..., None]
     c0, c1, cq = cls[:, 0], cls[:, 1], cls[:, 2]
     has_cr = fault_model == "crash_at_round"
+    has_eq = fault_model == "equivocate"
 
     args = [scal, r.reshape(1), c0, c1, cq, pack]
     specs = [_smem(), _smem(), _vec(T), _vec(T), _vec(T), _lane(T)]
+    if has_eq:
+        scal2 = _stream_scal(base_key, r, phase + _EQUIV_SALT_OFFSET,
+                             node_offset, trial_offset)
+        args.insert(1, scal2)
+        specs.insert(1, _smem())
+        args.insert(6, n_equiv.astype(jnp.float32)[:, None])
+        specs.insert(6, _vec(T))
     if has_cr:
         args.append(crash_round)
         specs.append(_lane(T))
@@ -314,14 +389,16 @@ def vote_commit_pallas(base_key, r, phase, hist, pack, crash_round,
                        quorum_ok, shared, m: int, n_faulty: int, rule: str,
                        coin_mode: str, eps: float, freeze: bool,
                        fault_model: str, interpret: bool = False,
-                       node_offset=0, trial_offset=0):
+                       node_offset=0, trial_offset=0, n_equiv=None):
     """Fused vote phase + commit -> (new_pack [T, Np], partials [T, 128]).
 
     Partials: cols 0-2 the next round's LOCAL proposal histogram (valid
-    for static-killed fault models), col 3 settled count, col 4 unsettled
-    count.  hist: int32 [T, 3] global VOTE class counts (psum'd under a
-    mesh); quorum_ok: bool [T]; shared: int32-able [T] per-trial shared
-    coin bit (ignored for coin_mode='private').
+    for static-killed fault models; honest senders only under
+    'equivocate'), col 3 settled count, col 4 unsettled count.  hist:
+    int32 [T, 3] global VOTE class counts (psum'd under a mesh);
+    quorum_ok: bool [T]; shared: int32-able [T] per-trial shared coin bit
+    (ignored for coin_mode='private'); n_equiv: int32 [T] global
+    live-equivocator count ('equivocate' only, else None).
     """
     T, np_total = pack.shape
     r = jnp.asarray(r, jnp.int32)
@@ -334,10 +411,19 @@ def vote_commit_pallas(base_key, r, phase, hist, pack, crash_round,
     qok = quorum_ok.astype(jnp.int32)[:, None]
     sh = shared.astype(jnp.int32)[:, None]
     has_cr = fault_model == "crash_at_round"
+    has_eq = fault_model == "equivocate"
 
     args = [vote_scal, coin_scal, rk, c0, c1, cq, qok, sh, pack]
     specs = [_smem(), _smem(), _smem(), _vec(T), _vec(T), _vec(T),
              _vec(T), _vec(T), _lane(T)]
+    if has_eq:
+        vote_scal2 = _stream_scal(base_key, r,
+                                  phase + _EQUIV_SALT_OFFSET,
+                                  node_offset, trial_offset)
+        args.insert(1, vote_scal2)
+        specs.insert(1, _smem())
+        args.insert(7, n_equiv.astype(jnp.float32)[:, None])
+        specs.insert(7, _vec(T))
     if has_cr:
         args.append(crash_round)
         specs.append(_lane(T))
@@ -366,7 +452,9 @@ def _pad_cr(faults, np_total):
 def sent_hist_from_pack(cfg, pack, crash_round, r, ctx):
     """XLA fallback for the proposal histogram (round 1 of every run, and
     every round under crash_at_round, whose future crashes invalidate the
-    vote kernel's emitted next-round partials)."""
+    vote kernel's emitted next-round partials).  Under 'equivocate' the
+    histogram spans HONEST live senders only (equivocator values are
+    drawn receiver-side)."""
     p = pack
     x = p & 3
     killed = (p >> _KILL) & 1
@@ -377,17 +465,34 @@ def sent_hist_from_pack(cfg, pack, crash_round, r, ctx):
         killed = jnp.where(crashing, 1, killed)
     alive = killed == 0
     sent = _sent(cfg.fault_model, x, faulty)
-    cnt = [jnp.sum((sent == v) & alive, axis=-1, dtype=jnp.int32)
+    hon = _honest(cfg.fault_model, alive, faulty)
+    cnt = [jnp.sum((sent == v) & hon, axis=-1, dtype=jnp.int32)
            for v in (VAL0, VAL1, VALQ)]
     return ctx.psum_nodes(jnp.stack(cnt, axis=-1))
 
 
-def packed_round(cfg, pack, faults, base_key, r, hist1, ctx, n_local):
+def n_equiv_from_pack(cfg, pack, ctx):
+    """Global live-equivocator count int32 [T] (RUN-constant under
+    'equivocate': the killed and faulty bits are static for this fault
+    model, so run_packed hoists this out of the while-loop); None for
+    every other fault model."""
+    if cfg.fault_model != "equivocate":
+        return None
+    alive = ((pack >> _KILL) & 1) == 0
+    eqv = ((pack >> _FAULT) & 1) == 1
+    return ctx.psum_nodes(jnp.sum(eqv & alive, axis=-1, dtype=jnp.int32))
+
+
+def packed_round(cfg, pack, faults, base_key, r, hist1, ctx, n_local,
+                 n_equiv=None):
     """One fused round over the packed state.
 
     ``n_local`` is this shard's TRUE (unpadded) node count — the global-id
     base derivation needs it.  ``hist1`` is this round's global proposal
-    histogram.  Returns (new_pack, hist1_next or None, unsettled [T]);
+    histogram.  ``n_equiv`` is the global live-equivocator count [T]
+    ('equivocate' only; derived from the pack when not supplied —
+    run_packed precomputes it so the loop stays free of per-lane XLA
+    ops).  Returns (new_pack, hist1_next or None, unsettled [T]);
     hist1_next is None under crash_at_round (recompute via
     sent_hist_from_pack).
     """
@@ -398,13 +503,15 @@ def packed_round(cfg, pack, faults, base_key, r, hist1, ctx, n_local):
     m = cfg.quorum
     cr = (_pad_cr(faults, np_total)
           if cfg.fault_model == "crash_at_round" else None)
+    if n_equiv is None:
+        n_equiv = n_equiv_from_pack(cfg, pack, ctx)
     node_off = ctx.node_ids(n_local)[0]
     trial_off = ctx.trial_ids(T)[0]
 
     partsA = proposal_hist_pallas(
         base_key, r, rng.PHASE_PROPOSAL, hist1, pack, cr, m,
         cfg.fault_model, bool(cfg.freeze_decided), interpret=interp,
-        node_offset=node_off, trial_offset=trial_off)
+        node_offset=node_off, trial_offset=trial_off, n_equiv=n_equiv)
     hist2 = ctx.psum_nodes(partsA[:, :3])
     n_alive = ctx.psum_nodes(partsA[:, 3])
     quorum_ok = n_alive >= m
@@ -419,7 +526,7 @@ def packed_round(cfg, pack, faults, base_key, r, hist1, ctx, n_local):
         base_key, r, rng.PHASE_VOTE, hist2, pack, cr, quorum_ok, shared,
         m, cfg.n_faulty, cfg.rule, cfg.coin_mode, float(cfg.coin_eps),
         bool(cfg.freeze_decided), cfg.fault_model, interpret=interp,
-        node_offset=node_off, trial_offset=trial_off)
+        node_offset=node_off, trial_offset=trial_off, n_equiv=n_equiv)
     hist1_next = (None if cfg.fault_model == "crash_at_round"
                   else ctx.psum_nodes(partsB[:, :3]))
     unsettled = ctx.psum_nodes(partsB[:, 4])
@@ -439,6 +546,7 @@ def run_packed(cfg, state, faults, base_key):
         cfg, pack, _pad_cr(faults, pack.shape[1])
         if cfg.fault_model == "crash_at_round" else None,
         jnp.int32(1), SINGLE)
+    n_equiv = n_equiv_from_pack(cfg, pack, SINGLE)   # run-constant, hoisted
     unsettled0 = jnp.sum(
         ~(((pack >> _DEC) & 1) | ((pack >> _KILL) & 1)).astype(bool),
         dtype=jnp.int32)
@@ -453,7 +561,8 @@ def run_packed(cfg, state, faults, base_key):
             hist1 = sent_hist_from_pack(
                 cfg, pack, _pad_cr(faults, pack.shape[1]), r, SINGLE)
         new_pack, hist1_next, unsettled = packed_round(
-            cfg, pack, faults, base_key, r, hist1, SINGLE, cfg.n_nodes)
+            cfg, pack, faults, base_key, r, hist1, SINGLE, cfg.n_nodes,
+            n_equiv=n_equiv)
         if hist1_next is None:
             hist1_next = hist1              # recomputed next iteration
         return (r + 1, new_pack, hist1_next, jnp.sum(unsettled))

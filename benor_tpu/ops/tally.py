@@ -50,13 +50,16 @@ def pallas_equiv_active(cfg: SimConfig) -> bool:
 
 def pallas_round_active(cfg: SimConfig) -> bool:
     """True iff the fully-fused round kernels (ops/pallas_round.py) serve
-    this config: the pallas-hist CF regime, any fault model except
-    equivocate (crash and crash_at_round feed the kernels a per-round
-    killed mask; byzantine rides the vote-source flip sentinel), and a
-    coin the kernel can produce in-VMEM (private / common / weak with
-    0 < eps < 1 — the weak endpoints short-circuit to plain streams on
-    the XLA side, mirroring the unfused dispatch in models/benor.py)."""
-    if not (cfg.use_pallas_round and pallas_hist_active(cfg)):
+    this config: the pallas-hist CF regime, ANY fault model (crash and
+    crash_at_round feed the kernels a per-round killed mask; byzantine
+    rides the vote-source flip sentinel; equivocate runs the
+    mixed-population sampler in-kernel with honest-only histograms, r4
+    VERDICT task 6), and a coin the kernel can produce in-VMEM (private /
+    common / weak with 0 < eps < 1 — the weak endpoints short-circuit to
+    plain streams on the XLA side, mirroring the unfused dispatch in
+    models/benor.py)."""
+    if not (cfg.use_pallas_round
+            and (pallas_hist_active(cfg) or pallas_equiv_active(cfg))):
         return False
     if cfg.coin_mode == "weak_common":
         return 0.0 < cfg.coin_eps < 1.0

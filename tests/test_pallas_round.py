@@ -61,8 +61,14 @@ def _assert_same(a, b):
     dict(n_faulty=0, seed=13),                             # fault-free
     dict(n_faulty=20, seed=15, fault_model="byzantine"),
     dict(n_faulty=20, seed=17, fault_model="crash_at_round"),
+    dict(n_faulty=20, seed=19, fault_model="equivocate"),
+    dict(n_faulty=20, seed=21, fault_model="equivocate",
+         coin_mode="common"),
+    dict(n_faulty=20, seed=23, fault_model="equivocate",
+         coin_mode="weak_common", coin_eps=0.5),
 ], ids=["crash", "textbook", "common", "weak", "nofreeze", "faultfree",
-        "byzantine", "crash-at-round"])
+        "byzantine", "crash-at-round", "equivocate", "equiv-common",
+        "equiv-weak"])
 @pytest.mark.slow
 def test_fused_bit_identical_to_unfused_pallas(kw):
     _assert_same(_run(False, **kw), _run(True, **kw))
@@ -161,12 +167,12 @@ def test_gating():
     try:
         assert tally.pallas_round_active(SimConfig(**base))
         # byzantine / crash_at_round ride the flip sentinel + per-round
-        # killed mask; equivocate has its own (unfused) kernel
+        # killed mask; equivocate fuses the mixed-population sampler (r5)
         assert tally.pallas_round_active(
             SimConfig(**{**base, "fault_model": "byzantine"}))
         assert tally.pallas_round_active(
             SimConfig(**{**base, "fault_model": "crash_at_round"}))
-        assert not tally.pallas_round_active(
+        assert tally.pallas_round_active(
             SimConfig(**{**base, "fault_model": "equivocate"}))
         # off without the flag, the hist kernel, or the uniform scheduler
         assert not tally.pallas_round_active(
@@ -193,3 +199,53 @@ def test_packed_k_field_overflow_rejected():
         SimConfig(n_nodes=4, n_faulty=0, use_pallas_round=True,
                   max_rounds=(1 << 26) - 1)
     SimConfig(n_nodes=4, n_faulty=0, max_rounds=1 << 26)  # unfused: fine
+
+
+@pytest.mark.slow
+def test_fused_equivocate_multiround():
+    """Equivocators (alive, per-receiver random values) + balanced honest
+    inputs: a genuinely multi-round equivocate run, fused == unfused
+    bit-for-bit — including the fused next-round histogram partials the
+    loop carries (valid because killed/faulty are static under this fault
+    model)."""
+    outs = {}
+    for use_round in (False, True):
+        r, fin = _run(use_round, n_faulty=30, seed=25,
+                      fault_model="equivocate")
+        outs[use_round] = (r, fin)
+    _assert_same(outs[False], outs[True])
+    assert outs[True][0] > 1, "scenario decided too fast to exercise the loop"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mesh_shape", [(2, 4), (4, 2)])
+def test_fused_equivocate_sharded_bit_identical(mesh_shape):
+    """The fused equivocate round under a mesh: the honest-histogram and
+    n_equiv psums + global-id streams keep any mesh shape bit-identical
+    to the single device (equivocators stay ALIVE, so the draws are not
+    clamped — the identity is not vacuous)."""
+    from benor_tpu.parallel import make_mesh, run_consensus_sharded
+
+    old = sampling.EXACT_TABLE_MAX
+    sampling.EXACT_TABLE_MAX = 4
+    try:
+        cfg = SimConfig(n_nodes=32, n_faulty=10, trials=8,
+                        delivery="quorum", scheduler="uniform",
+                        path="histogram", fault_model="equivocate",
+                        use_pallas_hist=True, use_pallas_round=True,
+                        max_rounds=16, seed=8)
+        assert tally.pallas_round_active(cfg)
+        faults = FaultSpec.first_f(cfg)
+        state = init_state(cfg, balanced_inputs(cfg.trials, cfg.n_nodes),
+                           faults)
+        key = jax.random.key(cfg.seed)
+        r1, f1 = run_consensus(cfg, state, faults, key)
+        r2, f2 = run_consensus_sharded(cfg, state, faults, key,
+                                       make_mesh(*mesh_shape))
+        assert int(r1) == int(r2)
+        np.testing.assert_array_equal(np.asarray(f1.x), np.asarray(f2.x))
+        np.testing.assert_array_equal(np.asarray(f1.decided),
+                                      np.asarray(f2.decided))
+        np.testing.assert_array_equal(np.asarray(f1.k), np.asarray(f2.k))
+    finally:
+        sampling.EXACT_TABLE_MAX = old
