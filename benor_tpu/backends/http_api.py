@@ -167,16 +167,15 @@ class _Handler(BaseHTTPRequestHandler):
         """
         net, nid = self.network, self.node_id
         if not hasattr(net, "inject_message"):
-            # tpu backend: messages are on-device data movement under the
-            # seeded N9 scheduler.  native oracle: the batched C++ engine
-            # runs whole trials in one library call, so there is no
-            # Python-visible queue to inject into between deliveries.
+            # tpu backend only: messages are on-device data movement under
+            # the seeded N9 scheduler — both oracles serve injection.
             self._send(405, {
                 "error": "message injection not supported on this backend",
-                "detail": "injection is served on the Python event-loop "
-                          "oracle (backend='express'), where the forged "
-                          "message joins the seeded drain queue; this "
-                          "backend serves /status /start /stop /getState "
+                "detail": "injection is served on the event-loop oracles "
+                          "(backend='express' any time; backend='native' "
+                          "pre-start), where the forged message joins the "
+                          "seeded drain queue; this backend serves "
+                          "/status /start /stop /getState "
                           "(see PARITY.md, 'Deliberate non-parities')",
             }, as_json=True, extra_headers=(("Allow", "GET"),))
             return
@@ -199,8 +198,16 @@ class _Handler(BaseHTTPRequestHandler):
             return
         # injections serialize with /start (and each other) exactly like
         # the reference's single-threaded event loop
-        with self.start_lock:
-            delivered = net.inject_message(nid, k, x, mtype)
+        try:
+            with self.start_lock:
+                delivered = net.inject_message(nid, k, x, mtype)
+        except ValueError as e:       # e.g. native's k-range contract
+            self._send(400, {"error": str(e)}, as_json=True)
+            return
+        except NotImplementedError as e:   # native post-start injection
+            self._send(405, {"error": str(e)}, as_json=True,
+                       extra_headers=(("Allow", "GET"),))
+            return
         if delivered:
             self._send(200, {"message": "Message received"}, as_json=True)
         else:

@@ -61,6 +61,23 @@ def load_library() -> ctypes.CDLL:
             np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
             np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),  # in/out
         ]
+        lib.benor_express_run_inj.restype = ctypes.c_int64
+        lib.benor_express_run_inj.argtypes = [
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,   # n, f, max_r
+            ctypes.c_uint32, ctypes.c_int64,                  # seed, cap
+            ctypes.c_uint8,                                   # order
+            np.ctypeslib.ndpointer(np.int8, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+            ctypes.c_int64,                                   # n_inj
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int8, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int8, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),  # in/out
+        ]
         lib.benor_express_run_batch.restype = ctypes.c_int64
         lib.benor_express_run_batch.argtypes = [
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,   # n, f, max_r
@@ -187,10 +204,56 @@ class NativeExpressNetwork:
         self._k = np.zeros(n, np.int32)
         self._killed = self._faulty.copy()
         self._started = False
+        self._inj: list = []          # pre-start POST /message buffer
 
     def status(self, node_id: int, trial: int = 0):
         self._check_trial(trial)
         return ("faulty", 500) if self._killed[node_id] else ("live", 200)
+
+    def inject_message(self, node_id: int, k, x, message_type) -> bool:
+        """External message injection — the reference's POST /message
+        surface (node.ts:43-163), PRE-START only on this backend.
+
+        Buffered here and handed to ``benor_express_run_inj``, which
+        pushes the messages into the delivery queue ahead of the /start
+        fan-out — exactly where the Python oracle's pre-start
+        inject_message puts them, so injected traces stay BIT-EQUAL
+        across the two oracles for either delivery order
+        (tests/test_native_oracle.py pins this).
+
+        Returns False iff the target is killed at injection time (the
+        reference's 200 sits inside its ``!killed`` guard — callers send
+        no response).  Raises NotImplementedError once started: the C++
+        engine runs whole trials in one library call, so a mid/post-run
+        queue does not exist here — the Python express oracle serves
+        that case.
+        """
+        if self._started:
+            raise NotImplementedError(
+                "post-start injection is not supported on the batched "
+                "native oracle; use backend='express'")
+        if self._killed[node_id]:
+            return False
+        if not isinstance(k, int) or isinstance(k, bool) or \
+                not (0 <= k <= self.cfg.max_rounds + 1):
+            # the C++ tally buffers are sized max_rounds + 2; the Python
+            # oracle's dict buffers accept any k, so an out-of-range k
+            # would silently diverge between the oracles — reject it
+            raise ValueError(
+                "native oracle injection requires 0 <= k <= "
+                f"max_rounds + 1 (= {self.cfg.max_rounds + 1}); got {k!r}")
+        # Unknown types are delivered as no-ops (phase 2): they must still
+        # occupy a queue slot, or the shuffle delivery permutation would
+        # diverge from the Python oracle's.  x is canonicalized with
+        # Python ``==`` semantics — exactly what the express oracle's
+        # list.count tallying applies — so non-canonical wire values
+        # (0.5, "1", True) class identically on both engines: 0-equal,
+        # 1-equal, or the neither class (counts toward the quorum
+        # length, quirk 4, like "?").
+        phase = {"proposal phase": 0, "voting phase": 1}.get(message_type, 2)
+        xv = 0 if x == 0 else (1 if x == 1 else 2)
+        self._inj.append((node_id, k, xv, phase))
+        return True
 
     def start(self) -> None:
         if self._started:
@@ -200,11 +263,23 @@ class NativeExpressNetwork:
         # _killed is an in/out buffer: pre-start stop()/stop_node() calls
         # are honored as the initial killed mask (parity with the Python
         # oracle, where a pre-start stop changes the consensus outcome).
-        steps = lib.benor_express_run(
-            self.n, self.f, self.cfg.max_rounds, self.cfg.seed,
-            self._step_cap, 1 if self.cfg.oracle_order == "shuffle" else 0,
-            self._vals, self._faulty, self._x,
-            self._decided, self._k, self._killed)
+        order = 1 if self.cfg.oracle_order == "shuffle" else 0
+        if self._inj:
+            inj = np.asarray(self._inj, np.int64).reshape(-1, 4)
+            steps = lib.benor_express_run_inj(
+                self.n, self.f, self.cfg.max_rounds, self.cfg.seed,
+                self._step_cap, order, self._vals, self._faulty,
+                len(self._inj),
+                np.ascontiguousarray(inj[:, 0], np.int32),
+                np.ascontiguousarray(inj[:, 1], np.int32),
+                np.ascontiguousarray(inj[:, 2], np.int8),
+                np.ascontiguousarray(inj[:, 3], np.uint8),
+                self._x, self._decided, self._k, self._killed)
+        else:
+            steps = lib.benor_express_run(
+                self.n, self.f, self.cfg.max_rounds, self.cfg.seed,
+                self._step_cap, order, self._vals, self._faulty, self._x,
+                self._decided, self._k, self._killed)
         if steps < 0:
             raise RuntimeError(
                 f"native oracle exceeded its step cap ({self._step_cap} "

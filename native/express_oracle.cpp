@@ -187,13 +187,14 @@ struct Oracle {
     }
   }
 
+  void push(const Message &m) {
+    if (shuffle) bag.push_back(m);
+    else queue.push_back(m);
+  }
+
   void broadcast(int32_t round, int8_t val, uint8_t phase) {
     if (round > max_rounds) return;  // round cap bounds livelock configs
-    if (shuffle) {
-      for (int32_t i = 0; i < n; i++) bag.push_back({i, round, val, phase});
-    } else {
-      for (int32_t i = 0; i < n; i++) queue.push_back({i, round, val, phase});
-    }
+    for (int32_t i = 0; i < n; i++) push({i, round, val, phase});
   }
 
   static void bump(Tally &t, int8_t v) {
@@ -205,7 +206,11 @@ struct Oracle {
   void on_message(const Message &m) {
     int32_t i = m.dest;
     if (killed[i]) return;             // quirk 3: silent drop
-    if (m.k > max_rounds + 1) return;
+    // protocol broadcasts keep 1 <= k <= max_rounds + 1 by construction;
+    // INJECTED messages are range-checked by the Python wrapper, and this
+    // guard keeps an out-of-range k memory-safe regardless (the tally
+    // vectors are sized max_rounds + 2)
+    if (m.k < 0 || m.k > max_rounds + 1) return;
     if (m.phase == 0) {                // proposal phase (node.ts:46-82)
       Tally &t = proposals[i][m.k];
       bump(t, m.x);
@@ -213,7 +218,7 @@ struct Oracle {
         int8_t nx = t.c0 > t.c1 ? 0 : (t.c1 > t.c0 ? 1 : VALQ);
         broadcast(m.k, nx, 1);
       }
-    } else {                           // voting phase (node.ts:83-158)
+    } else if (m.phase == 1) {         // voting phase (node.ts:83-158)
       Tally &t = votes[i][m.k];
       bump(t, m.x);
       if (t.len() >= n - f) {
@@ -235,6 +240,12 @@ struct Oracle {
         broadcast(k[i], x[i], 0);
       }
     }
+    // phase >= 2: an injected unknown messageType — delivered as a no-op
+    // (the reference handler's if/else-if chain ignores it).  It must
+    // still occupy a queue slot: under shuffle delivery every pending
+    // message perturbs the seeded randbelow draws, so dropping it at
+    // enqueue time would shift the whole delivery permutation away from
+    // the Python oracle's.
   }
 
   void run_halt_probe() {
@@ -296,6 +307,38 @@ int64_t benor_express_run(int32_t n, int32_t f, int32_t max_rounds,
                           uint8_t *killed_io) {
   Oracle o(n, f, max_rounds, seed, step_cap, order, initial_values, faulty,
            killed_io);
+  int64_t steps = o.start();
+  std::memcpy(out_x, o.x.data(), n);
+  std::memcpy(out_decided, o.decided.data(), n);
+  std::memcpy(out_k, o.k.data(), n * sizeof(int32_t));
+  std::memcpy(killed_io, o.killed.data(), n);
+  return steps;
+}
+
+// Injection variant (r5): benor_express_run plus n_inj externally injected
+// messages (the reference's POST /message surface, node.ts:43-163) pushed
+// into the delivery queue BEFORE the /start fan-out — exactly where the
+// Python oracle's pre-start ExpressNetwork.inject_message puts them, so
+// injected traces stay bit-equal across languages for either order.
+// Killed-at-injection-time targets are skipped (the reference's handler
+// body sits inside !killed; the wrapper mirrors the no-response wire
+// behavior).  inj_phase: 0 = proposal, 1 = voting.
+int64_t benor_express_run_inj(int32_t n, int32_t f, int32_t max_rounds,
+                              uint32_t seed, int64_t step_cap, uint8_t order,
+                              const int8_t *initial_values,
+                              const uint8_t *faulty,
+                              int64_t n_inj, const int32_t *inj_dest,
+                              const int32_t *inj_k, const int8_t *inj_x,
+                              const uint8_t *inj_phase, int8_t *out_x,
+                              uint8_t *out_decided, int32_t *out_k,
+                              uint8_t *killed_io) {
+  Oracle o(n, f, max_rounds, seed, step_cap, order, initial_values, faulty,
+           killed_io);
+  for (int64_t j = 0; j < n_inj; j++) {
+    if (inj_dest[j] < 0 || inj_dest[j] >= n) continue;
+    if (o.killed[inj_dest[j]]) continue;
+    o.push({inj_dest[j], inj_k[j], inj_x[j], inj_phase[j]});
+  }
   int64_t steps = o.start();
   std::memcpy(out_x, o.x.data(), n);
   std::memcpy(out_decided, o.decided.data(), n);

@@ -173,3 +173,89 @@ def test_run_batch_surfaces_tripped_count():
     with pytest.raises(RuntimeError, match="step cap"):
         native_oracle.run_batch(cfg, vals, faulty, seeds, step_cap=3,
                                 raise_on_cap=True)
+
+
+# --- POST /message injection parity (r5) --------------------------------
+
+INJ = ([(0, 1, 1, "proposal phase")] * 3 + [(1, 1, 1, "proposal phase")] * 3
+       + [(2, 1, 1, "proposal phase")] * 3 + [(1, 2, "?", "voting phase")]
+       # hostile wire values: an unknown type still occupies a queue slot
+       # (shuffle permutation parity) and a non-canonical x classes by
+       # Python == semantics on BOTH engines (0.5 -> the neither class)
+       + [(2, 1, 1, "gossip"), (0, 2, 0.5, "voting phase"),
+          (1, 1, True, "proposal phase")])
+
+
+@pytest.mark.parametrize("order", ["fifo", "shuffle"])
+def test_injected_runs_bit_equal_across_oracles(order):
+    """Pre-start injections land ahead of the /start fan-out in BOTH
+    engines, so injected traces are bit-equal across languages — the
+    cross-language differential contract now covers the injection
+    surface too."""
+    states = {}
+    for backend in ("express", "native"):
+        net = launch_network(4, 1, [0, 0, 0, 0],
+                             [False, False, False, True], backend=backend,
+                             seed=7, max_rounds=12, oracle_order=order)
+        for nid, k, x, mt in INJ:
+            assert net.inject_message(nid, k, x, mt) is True
+        # killed target: no enqueue, reference's no-response contract
+        assert net.inject_message(3, 1, 1, "proposal phase") is False
+        net.start()
+        states[backend] = net.get_states()
+    assert states["express"] == states["native"]
+    # the forged all-1 proposals flip the unanimous-0 network (efficacy)
+    healthy = states["native"][:3]
+    assert all(s["decided"] for s in healthy)
+
+
+def test_native_injection_contracts():
+    net = launch_network(3, 0, [1, 1, 1], [False] * 3, backend="native",
+                         seed=0, max_rounds=12)
+    # out-of-range k would silently diverge from the Python oracle's
+    # dict-keyed buffers (C++ sizes its tallies max_rounds + 2)
+    with pytest.raises(ValueError, match="max_rounds"):
+        net.inject_message(0, 13 + 1, 1, "proposal phase")
+    with pytest.raises(ValueError, match="max_rounds"):
+        net.inject_message(0, -1, 1, "proposal phase")
+    # unknown message types are silent no-ops in the reference handler:
+    # accepted, delivered, ignored
+    assert net.inject_message(0, 1, 1, "gossip") is True
+    net.start()
+    assert all(s["decided"] for s in net.get_states())
+    # post-start: the batched C++ engine has no live queue
+    with pytest.raises(NotImplementedError, match="express"):
+        net.inject_message(0, 1, 1, "proposal phase")
+
+
+def test_native_injection_over_http():
+    """The wire surface: POST /message on a native-backed listener
+    delivers (200), and the injected run matches the express-backed run
+    driven through the same HTTP flow."""
+    import json
+    import urllib.request
+    from benor_tpu.backends.http_api import NodeHttpCluster
+
+    finals = {}
+    for backend, base in (("express", 3250), ("native", 3260)):
+        net = launch_network(4, 1, [0, 0, 0, 0],
+                             [False, False, False, True], backend=backend,
+                             seed=7, max_rounds=12)
+        with NodeHttpCluster(net, base):
+            for nid in range(3):
+                for _ in range(3):
+                    req = urllib.request.Request(
+                        f"http://127.0.0.1:{base + nid}/message",
+                        method="POST",
+                        data=json.dumps({"k": 1, "x": 1, "messageType":
+                                         "proposal phase"}).encode())
+                    with urllib.request.urlopen(req, timeout=10) as r:
+                        assert r.status == 200
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{base}/start", timeout=30).read()
+            finals[backend] = [json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{base + i}/getState", timeout=10).read())
+                for i in range(4)]
+        net.close()
+    assert finals["express"] == finals["native"]
+    assert all(s["x"] == 1 for s in finals["native"][:3])
