@@ -4,7 +4,8 @@ from .mesh import (AXIS_NODES, AXIS_TRIALS, STATE_SPEC, make_mesh,
                    state_sharding)
 from .multihost import (global_mesh, init_multihost, local_block,
                         make_global, resume_consensus_multihost,
-                        run_consensus_multihost, to_global)
+                        run_consensus_multihost,
+                        run_consensus_slice_multihost, to_global)
 from .sharded import (MESH_CTX, resume_consensus_sharded,
                       run_consensus_sharded, run_consensus_slice_sharded,
                       shard_inputs)
@@ -15,4 +16,5 @@ __all__ = [
     "run_consensus_slice_sharded", "shard_inputs",
     "init_multihost", "global_mesh", "local_block", "to_global",
     "make_global", "run_consensus_multihost", "resume_consensus_multihost",
+    "run_consensus_slice_multihost",
 ]

@@ -167,6 +167,26 @@ def run_consensus_multihost(cfg: SimConfig, state: NetState,
                                         jnp.int32(1))
 
 
+def run_consensus_slice_multihost(cfg: SimConfig, state: NetState,
+                                  faults: FaultSpec, base_key: jax.Array,
+                                  mesh: Mesh, from_round,
+                                  until_round) -> Tuple[jax.Array, NetState]:
+    """Mid-run observability (cfg.poll_rounds) on a process-spanning mesh.
+
+    Counterpart of sharded.run_consensus_slice_sharded with global inputs
+    (the caller applies sim.start_state once, then steps in slices): every
+    process calls this SPMD-style with the same round bounds and observes
+    the same replicated next_round, so all hosts stay in lockstep while a
+    poller on any host watches its local slab's k grow.  A sliced
+    multi-host run is bit-identical to the uninterrupted one — randomness
+    keys on (base_key, round, phase, global ids), never loop entry."""
+    meshlib.check_divisible(cfg.trials, cfg.n_nodes, mesh)
+    _check_global(state, faults, (cfg.trials, cfg.n_nodes))
+    return sharded._compiled_slice(cfg, mesh)(
+        state, faults, base_key, jnp.int32(from_round),
+        jnp.int32(until_round))
+
+
 def resume_consensus_multihost(cfg: SimConfig, state: NetState,
                                faults: FaultSpec, base_key: jax.Array,
                                mesh: Mesh,

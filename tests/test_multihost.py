@@ -79,3 +79,4 @@ def test_two_process_cluster_bit_identity():
                 "bit-identical vs single-process OK" in out, out
         assert f"worker{pid}[resume]" in out, out
         assert f"worker{pid}[xhost-nodes]" in out, out
+        assert f"worker{pid}[sliced]" in out, out
