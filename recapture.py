@@ -152,8 +152,9 @@ def run_bench(sha: str) -> bool:
             f"error={out.get('error')!r}); not promoting")
         return False
     out["capture"] = {"sha": sha,
-                      "utc": datetime.datetime.utcnow().isoformat(
-                          timespec="seconds") + "Z"}
+                      "utc": datetime.datetime.now(datetime.timezone.utc)
+                      .isoformat(timespec="seconds")
+                      .replace("+00:00", "Z")}
     with open(os.path.join(HERE, "BENCH_TPU.json"), "w") as fh:
         json.dump(out, fh, indent=1)
     detail = os.path.join(WT, "BENCH_DETAIL.json")

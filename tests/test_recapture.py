@@ -1,0 +1,121 @@
+"""Promotion guards of the on-chip recapture daemon (recapture.py).
+
+The daemon's whole value is unattended honesty: it must promote
+BENCH_TPU.json / RESULTS/ ONLY for genuine on-chip runs and never let a
+CPU fallback or a garbled bench overwrite captured artifacts (two such
+bugs were caught in review — these are their regression pins).  The
+subprocess layer is stubbed; the worktree/probe plumbing is driven for
+real by the round workflow itself.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def recap(tmp_path, monkeypatch):
+    spec = importlib.util.spec_from_file_location(
+        "recap_under_test", os.path.join(ROOT, "recapture.py"))
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    here = tmp_path / "repo"
+    cap = here / ".capture"
+    wt = cap / "wt"
+    for d in (here, cap, wt):
+        d.mkdir(parents=True)
+    monkeypatch.setattr(m, "HERE", str(here))
+    monkeypatch.setattr(m, "CAP", str(cap))
+    monkeypatch.setattr(m, "WT", str(wt))
+    monkeypatch.setattr(m, "STATE", str(cap / "state.json"))
+    monkeypatch.setattr(m, "LOGF", str(cap / "recapture.log"))
+    return m
+
+
+def _stub_run(monkeypatch, m, stdout="", rc=0, detail=None, results_meta=...):
+    """Swap the MODULE's subprocess binding for a canned-run namespace.
+
+    Patching ``m.subprocess.run`` directly would stub the stdlib
+    singleton for every subprocess user (git helpers included); replacing
+    the module attribute confines the stub to recapture.py."""
+    from types import SimpleNamespace
+
+    def fake_run(cmd, **kw):
+        if detail is not None:
+            with open(os.path.join(m.WT, "BENCH_DETAIL.json"), "w") as fh:
+                json.dump(detail, fh)
+        if results_meta is not ...:
+            out_dir = [c for c in cmd if "RESULTS" in str(c)][-1]
+            os.makedirs(out_dir, exist_ok=True)
+            with open(os.path.join(out_dir, "results.json"), "w") as fh:
+                json.dump({"meta": results_meta}, fh)
+        return subprocess.CompletedProcess(cmd, rc, stdout=stdout, stderr="")
+
+    monkeypatch.setattr(m, "subprocess", SimpleNamespace(
+        run=fake_run, CompletedProcess=subprocess.CompletedProcess,
+        TimeoutExpired=subprocess.TimeoutExpired,
+        CalledProcessError=subprocess.CalledProcessError))
+
+
+GOOD = {"metric": "mc_trials_per_sec_n1e6", "value": 950.0,
+        "unit": "trials/s", "vs_baseline": 63.2, "platform": "tpu",
+        "fallback_cpu": False}
+
+
+def test_bench_promotes_genuine_on_chip_run(recap, monkeypatch):
+    _stub_run(monkeypatch, recap, stdout=json.dumps(GOOD) + "\n",
+              detail={"curve": []})
+    assert recap.run_bench("abc123def") is True
+    out = json.load(open(os.path.join(recap.HERE, "BENCH_TPU.json")))
+    assert out["platform"] == "tpu" and out["capture"]["sha"] == "abc123def"
+    assert os.path.exists(os.path.join(recap.HERE, "BENCH_DETAIL.json"))
+
+
+@pytest.mark.parametrize("stdout", [
+    "",                                         # no JSON line at all
+    "bench: something went sideways\n",         # non-JSON final line
+    json.dumps({"capture": "no-metric"}),       # JSON but not emit()'s
+    json.dumps({**GOOD, "platform": "cpu"}),    # ran on CPU
+    json.dumps({**GOOD, "fallback_cpu": True}),  # mid-run fallback
+    json.dumps({**GOOD, "error": "boom"}),      # bench-internal error
+], ids=["empty", "nonjson", "not-emit", "cpu", "fallback", "error"])
+def test_bench_never_promotes_dishonest_runs(recap, monkeypatch, stdout):
+    _stub_run(monkeypatch, recap, stdout=stdout)
+    assert recap.run_bench("abc") is False
+    assert not os.path.exists(os.path.join(recap.HERE, "BENCH_TPU.json"))
+
+
+def test_bench_rc_failure_not_promoted(recap, monkeypatch):
+    _stub_run(monkeypatch, recap, stdout=json.dumps(GOOD), rc=3)
+    assert recap.run_bench("abc") is False
+
+
+def test_results_promotes_only_on_chip_and_stages_first(recap, monkeypatch):
+    # CPU-fallback artifact: staged, checked, NOT promoted — the main
+    # repo's RESULTS/ (here: pre-existing on-chip capture) must survive
+    out_dir = os.path.join(recap.HERE, "RESULTS")
+    os.makedirs(out_dir)
+    with open(os.path.join(out_dir, "results.json"), "w") as fh:
+        json.dump({"meta": {"platform": "tpu", "n_large": 1_000_000}}, fh)
+    _stub_run(monkeypatch, recap, results_meta={"platform": "cpu"})
+    assert recap.run_results("abc") is False
+    kept = json.load(open(os.path.join(out_dir, "results.json")))
+    assert kept["meta"]["platform"] == "tpu"        # untouched
+
+    # genuine on-chip artifact: promoted atomically from the staging dir
+    _stub_run(monkeypatch, recap,
+              results_meta={"platform": "TPU v5 lite", "n_large": 1_000_000})
+    assert recap.run_results("abc") is True
+    got = json.load(open(os.path.join(out_dir, "results.json")))
+    assert got["meta"]["n_large"] == 1_000_000
+    assert not os.path.exists(os.path.join(recap.CAP, "RESULTS.stage"))
+
+
+def test_state_roundtrip(recap):
+    recap.save_state({"bench_sha": "x"})
+    assert recap.load_state() == {"bench_sha": "x"}
