@@ -366,3 +366,31 @@ def test_post_injection_after_termination_targets_killed_nodes():
                  for i in range(3)]
         assert before == after
     net.close()
+
+
+def test_post_message_body_cap_413():
+    """Bodies past the 1 MiB cap are drained and refused — buffered memory
+    is bounded no matter the declared Content-Length."""
+    net = launch_network(1, 0, [1], [False], backend="express", seed=0)
+    with NodeHttpCluster(net, BASE + 55):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{BASE + 55}/message", method="POST",
+            data=b"x" * ((1 << 20) + 100))
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 413
+    net.close()
+
+
+def test_post_unknown_route_404_with_body():
+    """A POST with a body to an unknown route drains and 404s (no
+    buffering: only /message keeps its body)."""
+    net = launch_network(1, 0, [1], [False], backend="express", seed=0)
+    with NodeHttpCluster(net, BASE + 56):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{BASE + 56}/elsewhere", method="POST",
+            data=b"y" * 4096)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 404
+    net.close()
