@@ -208,6 +208,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(405, {"error": str(e)}, as_json=True,
                        extra_headers=(("Allow", "GET"),))
             return
+        except RuntimeError as e:
+            # a post-start injection cascade can trip the oracle's step
+            # cap (ExpressNetwork._drain); answer 500 so the wire can
+            # tell it from the deliberate killed-target no-response
+            self._send(500, {"error": str(e)}, as_json=True)
+            return
         if delivered:
             self._send(200, {"message": "Message received"}, as_json=True)
         else:

@@ -232,6 +232,14 @@ class NativeExpressNetwork:
             raise NotImplementedError(
                 "post-start injection is not supported on the batched "
                 "native oracle; use backend='express'")
+        if not -self.n <= node_id < self.n:
+            raise IndexError("node_id out of range")   # list-index parity
+        if node_id < 0:
+            # the Python oracle's nodes[node_id] accepts negative indices
+            # (nodes[-1] == last node); normalize so a negative injection
+            # lands on the SAME node in both engines — the C++ side drops
+            # raw negatives, which would silently fork the traces
+            node_id += self.n
         if self._killed[node_id]:
             return False
         if not isinstance(k, int) or isinstance(k, bool) or \

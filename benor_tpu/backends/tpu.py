@@ -86,12 +86,19 @@ class TpuNetwork:
             import jax.numpy as jnp
             if self.cfg.mesh_shape is not None:
                 from ..parallel import (make_mesh,
-                                        run_consensus_slice_sharded)
+                                        run_consensus_slice_sharded,
+                                        shard_inputs)
                 mesh = make_mesh(*self.cfg.mesh_shape)
+                # shard ONCE, outside the slice loop: the slice's own
+                # device_put is then a no-op per iteration (re-passing
+                # the original host faults would re-transfer the [T, N]
+                # fault arrays every poll_rounds rounds)
+                self.state, faults_sh = shard_inputs(self.state,
+                                                     self.faults, mesh)
 
                 def slice_fn(st, r, until):
                     return run_consensus_slice_sharded(
-                        self.cfg, st, self.faults, base_key, mesh, r, until)
+                        self.cfg, st, faults_sh, base_key, mesh, r, until)
             else:
                 def slice_fn(st, r, until):
                     return run_consensus_slice(

@@ -58,8 +58,11 @@ def pallas_round_active(cfg: SimConfig) -> bool:
     common / weak with 0 < eps < 1 — the weak endpoints short-circuit to
     plain streams on the XLA side, mirroring the unfused dispatch in
     models/benor.py)."""
-    if not (cfg.use_pallas_round
-            and (pallas_hist_active(cfg) or pallas_equiv_active(cfg))):
+    if not (cfg.use_pallas_round and pallas_stream_active(cfg)):
+        # pallas_hist_active | pallas_equiv_active partition
+        # pallas_stream_active on fault_model, so the shared gate IS the
+        # condition — stated directly so future regime edits live in one
+        # place (the module comment's promise)
         return False
     if cfg.coin_mode == "weak_common":
         return 0.0 < cfg.coin_eps < 1.0

@@ -91,6 +91,13 @@ def refresh_worktree(sha: str) -> None:
     os.makedirs(CAP, exist_ok=True)
     if not os.path.isdir(os.path.join(WT, ".git")) and \
             not os.path.isfile(os.path.join(WT, ".git")):
+        if os.path.isdir(WT):
+            # half-created worktree (daemon killed mid-add): 'git worktree
+            # add' would refuse forever — clear the carcass and prune the
+            # stale registration first
+            shutil.rmtree(WT, ignore_errors=True)
+            subprocess.run(["git", "worktree", "prune"], cwd=HERE,
+                           capture_output=True)
         subprocess.run(["git", "worktree", "add", "--detach", WT, sha],
                        cwd=HERE, check=True, capture_output=True)
     else:
@@ -188,12 +195,18 @@ def run_results(sha: str) -> bool:
     try:
         with open(os.path.join(stage, "results.json")) as fh:
             meta = json.load(fh).get("meta", {})
-    except (OSError, ValueError):
+    except (OSError, ValueError, AttributeError):
         meta = {}
-    plat = str(meta.get("platform", "?"))
-    if "cpu" in plat.lower():
-        log(f"results: artifact platform={plat!r} — fell back, "
-            f"not counting as captured")
+    if not isinstance(meta, dict):       # {"meta": "tpu"}-style corruption
+        meta = {}
+    # FAIL CLOSED: promotion requires a parseable artifact that
+    # affirmatively claims an accelerator — a missing/corrupt
+    # results.json (meta == {}) or an absent platform string must never
+    # overwrite a previously captured on-chip RESULTS/
+    plat = str(meta.get("platform") or "")
+    if not plat or "cpu" in plat.lower():
+        log(f"results: artifact platform={plat!r} — not a verifiable "
+            f"on-chip run, not promoting")
         return False
     out_dir = os.path.join(HERE, "RESULTS")
     shutil.rmtree(out_dir, ignore_errors=True)

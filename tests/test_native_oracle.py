@@ -259,3 +259,25 @@ def test_native_injection_over_http():
         net.close()
     assert finals["express"] == finals["native"]
     assert all(s["x"] == 1 for s in finals["native"][:3])
+
+
+def test_negative_node_id_normalizes_like_python_lists():
+    """The Python oracle's nodes[node_id] accepts negative indices; the
+    native wrapper normalizes them so the SAME node receives the
+    injection in both engines (raw negatives would be dropped C++-side,
+    silently forking the traces)."""
+    states = {}
+    for backend in ("express", "native"):
+        net = launch_network(3, 0, [0, 0, 0], [False] * 3, backend=backend,
+                             seed=1, max_rounds=12)
+        for _ in range(3):
+            assert net.inject_message(-1, 1, 1, "proposal phase") is True
+        net.start()
+        states[backend] = net.get_states()
+        net.close()
+    assert states["express"] == states["native"]
+    net = launch_network(3, 0, [0, 0, 0], [False] * 3, backend="native",
+                         seed=1)
+    with pytest.raises(IndexError):
+        net.inject_message(3, 1, 1, "proposal phase")
+    net.close()

@@ -119,3 +119,40 @@ def test_results_promotes_only_on_chip_and_stages_first(recap, monkeypatch):
 def test_state_roundtrip(recap):
     recap.save_state({"bench_sha": "x"})
     assert recap.load_state() == {"bench_sha": "x"}
+
+
+@pytest.mark.parametrize("meta", [{}, {"platform": ""}, {"n_large": 5}],
+                         ids=["empty-meta", "empty-platform", "no-platform"])
+def test_results_fails_closed_on_unverifiable_artifact(recap, monkeypatch,
+                                                       meta):
+    """An artifact that cannot AFFIRM an accelerator (corrupt/missing
+    meta.platform) must not be promoted — absence of 'cpu' is not
+    evidence of 'tpu'."""
+    out_dir = os.path.join(recap.HERE, "RESULTS")
+    os.makedirs(out_dir)
+    with open(os.path.join(out_dir, "results.json"), "w") as fh:
+        json.dump({"meta": {"platform": "tpu"}}, fh)
+    _stub_run(monkeypatch, recap, results_meta=meta)
+    assert recap.run_results("abc") is False
+    kept = json.load(open(os.path.join(out_dir, "results.json")))
+    assert kept["meta"]["platform"] == "tpu"
+
+
+@pytest.mark.parametrize("raw", ['[1, 2]', '{"meta": "tpu"}', '{corrupt'],
+                         ids=["list-top", "string-meta", "invalid-json"])
+def test_results_fails_closed_on_structurally_corrupt_artifact(
+        recap, monkeypatch, raw):
+    """Corruption that isn't even a meta-dict must log-and-return-False,
+    not kill the retry-forever daemon with an AttributeError."""
+    from types import SimpleNamespace
+
+    def fake_run(cmd, **kw):
+        out_dir = [c for c in cmd if "RESULTS" in str(c)][-1]
+        os.makedirs(out_dir, exist_ok=True)
+        with open(os.path.join(out_dir, "results.json"), "w") as fh:
+            fh.write(raw)
+        return subprocess.CompletedProcess(cmd, 0, stdout="", stderr="")
+
+    monkeypatch.setattr(recap, "subprocess", SimpleNamespace(
+        run=fake_run, TimeoutExpired=subprocess.TimeoutExpired))
+    assert recap.run_results("abc") is False
