@@ -32,4 +32,4 @@ __all__ = [
     "get_nodes_state", "reached_finality",
 ]
 
-__version__ = "0.4.0"  # kept in sync with pyproject.toml
+__version__ = "0.5.0"  # kept in sync with pyproject.toml
