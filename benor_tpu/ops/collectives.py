@@ -74,6 +74,18 @@ class ShardCtx:
             axis = x.ndim + axis
         return lax.all_gather(x, self.node_axis, axis=axis, tiled=True)
 
+    def psum_trials(self, x: jax.Array) -> jax.Array:
+        """Sum partial reductions over the trial axis (DCN all-reduce).
+
+        Pairs with psum_nodes for values that are already node-global —
+        e.g. the packed loop's per-trial unsettled counts, whose scalar
+        sum must be replicated across TRIAL shards for the while-loop
+        predicate (summing over both axes again would double-count the
+        node reduction)."""
+        if self.trial_axis is None:
+            return x
+        return lax.psum(x, self.trial_axis)
+
     def psum_all(self, x: jax.Array) -> jax.Array:
         """Sum over every mesh axis (global scalar reductions)."""
         axes: Tuple[str, ...] = tuple(

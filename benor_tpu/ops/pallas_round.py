@@ -533,40 +533,64 @@ def packed_round(cfg, pack, faults, base_key, r, hist1, ctx, n_local,
     return new_pack, hist1_next, unsettled
 
 
-def run_packed(cfg, state, faults, base_key):
-    """Single-device fast path for sim.run_consensus: the packed state is
-    the while-loop carry, so pack/unpack (and every per-lane XLA op) run
-    once per RUN, not per round.  Bit-identical to the generic loop."""
-    from ..ops.collectives import SINGLE
-    from ..sim import start_state
+def run_packed_slice(cfg, state, faults, base_key, from_round, until_round,
+                     ctx=None):
+    """The packed while-loop, generalized over (mesh ctx, round bounds).
 
-    state = start_state(cfg, state)
+    At most ``until_round - from_round`` rounds from ``from_round`` (both
+    TRACED), carrying the packed per-lane word: pack/unpack and every
+    per-lane XLA op run once per CALL, not per round.  Under a mesh
+    ``ctx`` the loop predicate reads the globally psum'd unsettled count
+    (node-axis psum from the vote kernel's partials, trial-axis psum
+    here), so all shards take identical trip counts.  The caller applies
+    the /start transition; returns (next_round, NetState) — the
+    run_consensus_slice contract.  ONE definition serves the
+    single-device runner (run_packed) and the shard_map'd runner
+    (parallel/sharded.py:_local_slice), so the fused loop cannot drift
+    between them.
+    """
+    from ..ops.collectives import SINGLE
+
+    ctx = SINGLE if ctx is None else ctx
+    n_local = state.x.shape[-1]
     pack = pack_state(state, faults.faulty)
-    hist1 = sent_hist_from_pack(
-        cfg, pack, _pad_cr(faults, pack.shape[1])
-        if cfg.fault_model == "crash_at_round" else None,
-        jnp.int32(1), SINGLE)
-    n_equiv = n_equiv_from_pack(cfg, pack, SINGLE)   # run-constant, hoisted
-    unsettled0 = jnp.sum(
+    cr = (_pad_cr(faults, pack.shape[1])
+          if cfg.fault_model == "crash_at_round" else None)
+    n_equiv = n_equiv_from_pack(cfg, pack, ctx)      # run-constant, hoisted
+    hist1 = sent_hist_from_pack(cfg, pack, cr, from_round, ctx)
+    unsettled0 = ctx.psum_all(jnp.sum(
         ~(((pack >> _DEC) & 1) | ((pack >> _KILL) & 1)).astype(bool),
-        dtype=jnp.int32)
+        dtype=jnp.int32))
 
     def cond(carry):
-        r, pack, hist1, unsettled = carry
-        return (r <= cfg.max_rounds) & (unsettled > 0)
+        r, _, _, unsettled = carry
+        return (r <= cfg.max_rounds) & (unsettled > 0) & (r < until_round)
 
     def body(carry):
         r, pack, hist1, _ = carry
         if cfg.fault_model == "crash_at_round":
-            hist1 = sent_hist_from_pack(
-                cfg, pack, _pad_cr(faults, pack.shape[1]), r, SINGLE)
+            hist1 = sent_hist_from_pack(cfg, pack, cr, r, ctx)
         new_pack, hist1_next, unsettled = packed_round(
-            cfg, pack, faults, base_key, r, hist1, SINGLE, cfg.n_nodes,
+            cfg, pack, faults, base_key, r, hist1, ctx, n_local,
             n_equiv=n_equiv)
         if hist1_next is None:
             hist1_next = hist1              # recomputed next iteration
-        return (r + 1, new_pack, hist1_next, jnp.sum(unsettled))
+        return (r + 1, new_pack, hist1_next,
+                ctx.psum_trials(jnp.sum(unsettled)))
 
     r, pack, _, _ = jax.lax.while_loop(
-        cond, body, (jnp.int32(1), pack, hist1, unsettled0))
-    return r - 1, unpack_state(pack, cfg.n_nodes)
+        cond, body,
+        (jnp.asarray(from_round, jnp.int32), pack, hist1, unsettled0))
+    return r, unpack_state(pack, n_local)
+
+
+def run_packed(cfg, state, faults, base_key):
+    """Single-device fast path for sim.run_consensus: run_packed_slice
+    from /start with an unbounded slice.  Bit-identical to the generic
+    loop."""
+    from ..sim import start_state
+
+    state = start_state(cfg, state)
+    r, fin = run_packed_slice(cfg, state, faults, base_key,
+                              jnp.int32(1), jnp.int32(cfg.max_rounds + 2))
+    return r - 1, fin

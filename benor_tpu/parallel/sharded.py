@@ -103,6 +103,27 @@ def run_consensus_sharded(cfg: SimConfig, state: NetState, faults: FaultSpec,
     return _compiled(cfg, mesh)(state, faults, base_key, jnp.int32(1))
 
 
+def _local_slice_packed(cfg: SimConfig, state: NetState, faults: FaultSpec,
+                        base_key: jax.Array, from_round: jax.Array,
+                        until_round: jax.Array
+                        ) -> Tuple[jax.Array, NetState]:
+    """The fused-round fast path of _local_slice: the PACKED per-lane
+    word is the while-loop carry (the sharded counterpart of
+    pallas_round.run_packed).
+
+    Per shard, pack/unpack and every per-lane XLA op run once per SLICE
+    instead of once per round — between rounds only the kernels' psum'd
+    partials move.  One shared loop definition (run_packed_slice) serves
+    this runner and the single-device run_packed; bit-identity with the
+    unfused path is pinned by tests/test_pallas_round.py's sharded
+    one-shot/slice/resume cases and the dryrun legs.
+    """
+    from ..ops.pallas_round import run_packed_slice
+
+    return run_packed_slice(cfg, state, faults, base_key, from_round,
+                            until_round, MESH_CTX)
+
+
 def _local_slice(cfg: SimConfig, state: NetState, faults: FaultSpec,
                  base_key: jax.Array, from_round: jax.Array,
                  until_round: jax.Array) -> Tuple[jax.Array, NetState]:
@@ -114,7 +135,17 @@ def _local_slice(cfg: SimConfig, state: NetState, faults: FaultSpec,
     slice of every chunk size reuses one compiled executable per
     (config, mesh) — the same trick _local_run plays for resume.  The
     replicated ``settled`` psum keeps trip counts identical across shards.
+
+    In the fused-round regime (tally.pallas_round_active) the loop
+    carries the packed state word instead of NetState — see
+    _local_slice_packed — matching sim.run_consensus's run_packed
+    dispatch, with bit-identical results.
     """
+    from ..ops.tally import pallas_round_active
+
+    if pallas_round_active(cfg) and not cfg.debug:
+        return _local_slice_packed(cfg, state, faults, base_key,
+                                   from_round, until_round)
     ctx = MESH_CTX
 
     def body(carry):

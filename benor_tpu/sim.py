@@ -74,6 +74,16 @@ def run_consensus(cfg: SimConfig, state: NetState, faults: FaultSpec,
 def resume_consensus(cfg: SimConfig, state: NetState, faults: FaultSpec,
                      base_key: jax.Array, from_round: int):
     """Re-enter the round loop from a checkpointed round index (SURVEY §5.4)."""
+    from .ops.tally import pallas_round_active
+
+    if pallas_round_active(cfg) and not cfg.debug:
+        # same fused dispatch as run_consensus: the packed loop serves
+        # resume too (randomness keys on (key, round), never loop entry)
+        from .ops.pallas_round import run_packed_slice
+        r, state = run_packed_slice(cfg, state, faults, base_key,
+                                    jnp.int32(from_round),
+                                    jnp.int32(cfg.max_rounds + 2))
+        return r - 1, state
     carry = (jnp.int32(from_round), state)
     r, state = jax.lax.while_loop(
         functools.partial(_run_cond, cfg),
@@ -98,7 +108,17 @@ def run_consensus_slice(cfg: SimConfig, state: NetState, faults: FaultSpec,
 
     Returns (next_round, state); ``next_round == from_round`` means no
     progress was possible (already settled or past the round cap).
+
+    In the fused-round regime the slice runs the packed loop
+    (run_packed_slice — the same dispatch run_consensus and the sharded
+    runner make), with bit-identical results.
     """
+    from .ops.tally import pallas_round_active
+
+    if pallas_round_active(cfg) and not cfg.debug:
+        from .ops.pallas_round import run_packed_slice
+        return run_packed_slice(cfg, state, faults, base_key,
+                                from_round, until_round)
     carry = (jnp.int32(from_round), state)
 
     def cond(carry):

@@ -249,3 +249,103 @@ def test_fused_equivocate_sharded_bit_identical(mesh_shape):
         np.testing.assert_array_equal(np.asarray(f1.k), np.asarray(f2.k))
     finally:
         sampling.EXACT_TABLE_MAX = old
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("fault_model", ["crash", "equivocate",
+                                         "crash_at_round"])
+def test_fused_sharded_slice_and_resume_bit_identical(fault_model):
+    """The fused packed loop under a mesh with NON-trivial round bounds:
+    2-round slices (the poll_rounds path) and a cut@2 + resume must both
+    equal the uninterrupted single-device fused run — including
+    crash_at_round, whose hist1 must be recomputed at the re-entry
+    round."""
+    from benor_tpu.parallel import (make_mesh, resume_consensus_sharded,
+                                    run_consensus_slice_sharded)
+    from benor_tpu.sim import start_state
+
+    old = sampling.EXACT_TABLE_MAX
+    sampling.EXACT_TABLE_MAX = 4
+    try:
+        n, f, T = 32, 10, 8
+        cfg = SimConfig(n_nodes=n, n_faulty=f, trials=T, delivery="quorum",
+                        scheduler="uniform", path="histogram",
+                        fault_model=fault_model, use_pallas_hist=True,
+                        use_pallas_round=True, max_rounds=16, seed=12)
+        assert tally.pallas_round_active(cfg)
+        if fault_model == "crash":
+            faults = FaultSpec.none(T, n)          # draws not clamped
+        else:
+            cr = (np.where(np.arange(n) < f, 3, 0)
+                  if fault_model == "crash_at_round" else None)
+            faults = FaultSpec.first_f(cfg, crash_rounds=cr)
+        state = init_state(cfg, balanced_inputs(T, n), faults)
+        key = jax.random.key(cfg.seed)
+        r1, f1 = run_consensus(cfg, state, faults, key)
+        assert int(r1) > 1, "need a multi-round scenario"
+        mesh = make_mesh(2, 4)
+
+        # 2-round slices to termination
+        st, r = start_state(cfg, state), 1
+        while True:
+            r_next, st = run_consensus_slice_sharded(
+                cfg, st, faults, key, mesh, r, r + 2)
+            rn = int(r_next)
+            if rn == r or rn > cfg.max_rounds or bool(np.asarray(
+                    (st.decided | st.killed).all())):
+                break
+            r = rn
+        assert rn - 1 == int(r1)
+        _assert_same((int(r1), f1), (rn - 1, st))
+
+        # cut@2 + resume
+        rc, fc = run_consensus(cfg.replace(max_rounds=2), state, faults, key)
+        rr, fr = resume_consensus_sharded(cfg, fc, faults, key, mesh,
+                                          from_round=int(rc) + 1)
+        assert int(rr) == int(r1)
+        _assert_same((int(r1), f1), (int(rr), fr))
+    finally:
+        sampling.EXACT_TABLE_MAX = old
+
+
+@pytest.mark.slow
+def test_fused_single_device_slice_and_resume_bit_identical():
+    """The single-device poll (run_consensus_slice) and checkpoint
+    (resume_consensus) paths dispatch to the SAME packed loop as
+    run_consensus — sliced / cut-and-resumed fused runs equal the
+    uninterrupted one bitwise."""
+    from benor_tpu.sim import (resume_consensus, run_consensus_slice,
+                               start_state)
+
+    old = sampling.EXACT_TABLE_MAX
+    sampling.EXACT_TABLE_MAX = 4
+    try:
+        n, f, T = 32, 10, 8
+        cfg = SimConfig(n_nodes=n, n_faulty=f, trials=T, delivery="quorum",
+                        scheduler="uniform", path="histogram",
+                        use_pallas_hist=True, use_pallas_round=True,
+                        max_rounds=16, seed=12)
+        faults = FaultSpec.none(T, n)
+        state = init_state(cfg, balanced_inputs(T, n), faults)
+        key = jax.random.key(cfg.seed)
+        r1, f1 = run_consensus(cfg, state, faults, key)
+        assert int(r1) > 1
+
+        st, r = start_state(cfg, state), 1
+        while True:
+            r_next, st = run_consensus_slice(cfg, st, faults, key,
+                                             jax.numpy.int32(r),
+                                             jax.numpy.int32(r + 2))
+            rn = int(r_next)
+            if rn == r or rn > cfg.max_rounds or bool(np.asarray(
+                    (st.decided | st.killed).all())):
+                break
+            r = rn
+        _assert_same((int(r1), f1), (rn - 1, st))
+
+        rc, fc = run_consensus(cfg.replace(max_rounds=2), state, faults, key)
+        rr, fr = resume_consensus(cfg, fc, faults, key,
+                                  from_round=int(rc) + 1)
+        _assert_same((int(r1), f1), (int(rr), fr))
+    finally:
+        sampling.EXACT_TABLE_MAX = old
