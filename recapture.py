@@ -45,10 +45,15 @@ WT = os.path.join(CAP, "wt")
 STATE = os.path.join(CAP, "state.json")
 LOGF = os.path.join(CAP, "recapture.log")
 
-#: Generous per-stage budgets: a cold N=1M bench is ~16 regimes + 5 kernel
-#: checks of ~10-40 s remote compiles each; results is ~8-10 min cold.
+#: Generous per-stage budgets: a cold N=1M bench is ~17 regimes + 5 kernel
+#: checks of ~10-40 s remote compiles each (measured 9 min cold on v5
+#: lite).  Results is the long pole: ~45 study configs at ~60-90 s of
+#: REMOTE compile each when the cache is cold — the 2026-07-31 attempt
+#: was still compiling at 57 min when the tunnel wedged — so its budget
+#: is 2 h; the persistent cache makes any retry resume roughly where the
+#: last attempt died.
 BENCH_TIMEOUT = 4200
-RESULTS_TIMEOUT = 4200
+RESULTS_TIMEOUT = 7200
 
 
 def log(msg: str) -> None:
