@@ -209,9 +209,19 @@ def _regimes(n, trials, fracs, max_rounds, seed, use_pallas_hist=False):
     # flagship path) ~5x faster; engaged on TPU only — its interpret-mode
     # fallback would dominate the CPU smoke run.  Statistically identical
     # stream (tests/test_pallas_hist.py), so the curve is the same science.
+    # The fully-fused round kernels ride on top for every regime except
+    # the biased scheduler (no closed form): the uniform regimes sample
+    # tallies in-kernel; the adversarial/targeted regimes feed their
+    # closed-form counts in as broadcast scalars (counts_mode
+    # delivered/camps).  Adjudicated ON-CHIP at N=1M x 32 on v5 lite —
+    # 1.174x (crash) / 1.076x (equivocate) vs the unfused pallas path,
+    # bit-identical (BENCH_TPU.json pallas_round_check, 2026-07-31; the
+    # r4 interpret-mode 0.478x was interpreter overhead, not kernel
+    # truth).
     base = dict(n_nodes=n, trials=trials, max_rounds=max_rounds,
                 delivery="quorum", path="histogram", fault_model="crash",
-                seed=seed, use_pallas_hist=use_pallas_hist)
+                seed=seed, use_pallas_hist=use_pallas_hist,
+                use_pallas_round=use_pallas_hist)
     # zero-margin inputs (the round-2 degenerate curve came from iid
     # inputs whose sqrt(N) margin drowned the sampling noise)
     bal = balanced_inputs(trials, n)
@@ -272,6 +282,10 @@ def _regimes(n, trials, fracs, max_rounds, seed, use_pallas_hist=False):
     f_tg += (n - f_tg) % 2    # even quorum: the "?"-manufacturing needs it
     for name, f, cap in (("targeted_f0.25", f_tg, 16),
                          ("targeted_f0.50", n // 2 + 1, 12)):
+        # use_pallas_hist off: no sampler exists for this scheduler.  The
+        # fused ROUND kernels still serve it (counts_mode='camps' — the
+        # closed-form camp triples broadcast in-VMEM), riding base's
+        # use_pallas_round.
         cfg = SimConfig(scheduler="targeted",
                         **{**base, "max_rounds": min(cap, max_rounds),
                            "n_faulty": f, "use_pallas_hist": False})
@@ -285,6 +299,9 @@ def _regimes(n, trials, fracs, max_rounds, seed, use_pallas_hist=False):
     for name, f, cap in (("equiv_3f_sub", f_sub, max_rounds),
                          ("equiv_3f_super", n // 3 + 1,
                           min(12, max_rounds))):
+        # like the targeted regimes: no sampler (counts are closed-form
+        # under the count adversary), but the fused round kernels engage
+        # via base's use_pallas_round (counts_mode='delivered')
         cfg = SimConfig(scheduler="adversarial", coin_mode="common",
                         **{**base, "fault_model": "equivocate",
                            "max_rounds": cap, "n_faulty": f,
@@ -299,8 +316,7 @@ def _regimes(n, trials, fracs, max_rounds, seed, use_pallas_hist=False):
     f_eq = int(0.2 * n)
     cfg = SimConfig(scheduler="uniform",
                     **{**base, "fault_model": "equivocate",
-                       "n_faulty": f_eq,
-                       "use_pallas_round": use_pallas_hist})
+                       "n_faulty": f_eq})
     fl = FaultSpec.first_f(cfg)
     regs.append(("equiv_uniform_f0.20", cfg, init_state(cfg, bal, fl), fl))
     return regs
@@ -582,15 +598,20 @@ def _pallas_round_check(n: int, trials: int, seed: int) -> dict:
         n = min(n, 2 * sampling.EXACT_TABLE_MAX)
         trials = min(trials, 4)
 
-    def pair(fault_model, f_frac):
+    def pair(fault_model, f_frac, scheduler="uniform", coin_mode="private",
+             max_rounds=64):
         f = int(f_frac * n)
+        if scheduler == "adversarial":
+            f += (n - f) % 2          # even quorum: the tie needs it
         outs, times = [], []
         for use_round in (False, True):
             cfg = SimConfig(n_nodes=n, n_faulty=f, trials=trials,
-                            delivery="quorum", scheduler="uniform",
+                            delivery="quorum", scheduler=scheduler,
+                            coin_mode=coin_mode,
                             path="histogram", fault_model=fault_model,
-                            use_pallas_hist=True,
-                            use_pallas_round=use_round, max_rounds=64,
+                            use_pallas_hist=scheduler == "uniform",
+                            use_pallas_round=use_round,
+                            max_rounds=max_rounds,
                             seed=seed)
             # zero crashes on the flagship regime (crash faults clamp the
             # draws); equivocators stay ALIVE, so first_f is non-vacuous
@@ -627,6 +648,12 @@ def _pallas_round_check(n: int, trials: int, seed: int) -> dict:
     # the equivocate regime's fused mixed-population kernels (r4 VERDICT
     # task 6): same bit-identity contract, separate timing
     res["equiv"] = pair("equivocate", 0.20)
+    # the fused ADVERSARIAL round (counts_mode='delivered'): vs the plain
+    # XLA path — with the common coin both share every random bit, so
+    # this bit-equality is exact, and the timing covers the regimes that
+    # dominate the sweep's rounds (the livelock-capped adversarial set)
+    res["adv"] = pair("crash", 0.20, scheduler="adversarial",
+                      coin_mode="common", max_rounds=16)
     return res
 
 
@@ -822,6 +849,13 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
         "n": n, "trials": trials, "elapsed_s": round(elapsed, 3),
         "compile_s": round(compile_s, 1),
         "device_kind": dev.device_kind,
+        # total protocol rounds executed across the regime set — the
+        # workload size behind value/node_rounds_per_sec.  trials/s is NOT
+        # comparable across rounds whose regime sets differ (r3's 10
+        # regimes ran 25 rounds; the 17-regime set runs ~82, most of them
+        # livelock-capped adversarial regimes) — node_rounds_per_sec is
+        # the workload-invariant throughput number.
+        "total_rounds": sum(r["rounds_executed"] for r in curve),
         "node_rounds_per_sec": round(total_node_rounds / elapsed, 1),
         "hbm_gbps_est": round(hbm_gbps, 1) if hbm_gbps else None,
         "hbm_util_est": hbm_util,

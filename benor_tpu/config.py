@@ -139,6 +139,13 @@ class SimConfig:
     # task 6) with coin_mode private/common/weak_common (0 < eps < 1);
     # silently ignored elsewhere, like use_pallas_hist.  BIT-identical to
     # the unfused pallas path (same streams; tests/test_pallas_round.py).
+    # ADJUDICATED ON-CHIP (r4 VERDICT item 2): at N=1M x 32 trials on
+    # TPU v5 lite the fused round beats the unfused pallas path 1.174x
+    # (crash flagship regime) / 1.076x (equivocate), bit-equal —
+    # BENCH_TPU.json pallas_round_check, 2026-07-31, interpret=false.
+    # PROMOTED: bench.py engages it on every uniform-scheduler N=1M
+    # regime.  (The r4 interpret-mode 0.478x "regression" was
+    # interpreter overhead; on-chip evidence reversed it.)
     use_pallas_round: bool = False
 
     # --- Monte-Carlo ----------------------------------------------------

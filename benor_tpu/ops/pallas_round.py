@@ -168,22 +168,44 @@ def _partial_cols(t, cols):
     return out
 
 
-def _prop_hist_kernel(m, fault_model, freeze, has_cr, *refs):
+def _camp_select(scal_ref, shape, camp_b0, camp_b1, vecs):
+    """counts_mode='camps': pick each lane's camp triple by GLOBAL lane id
+    (targeted adversary camp layout — value camps at the top of the id
+    range, tally.targeted_counts).  ``vecs`` = six [T, 1] refs, the
+    (h0, h1) pair per camp in (0-camp, 1-camp, "?"-camp) order; pad lanes
+    land in camp 1 (ids past N), harmlessly — they are killed, so neither
+    their commit nor the histogram partials see them."""
+    c0h0, c0h1, c1h0, c1h1, qh0, qh1 = [v[...] for v in vecs]
+    node, _ = _lane_ids(scal_ref, shape)
+    in1 = node >= jnp.uint32(camp_b1)
+    in0 = (node >= jnp.uint32(camp_b0)) & ~in1
+    p0 = jnp.where(in1, c1h0, jnp.where(in0, c0h0, qh0))
+    p1 = jnp.where(in1, c1h1, jnp.where(in0, c0h1, qh1))
+    return p0, p1
+
+
+def _prop_hist_kernel(m, fault_model, freeze, has_cr, counts_mode,
+                      camp_b0, camp_b1, *refs):
     """One lane-tile of the fused PROPOSAL phase.
 
-    Per-lane CF tallies from the global proposal histogram (the
-    mixed-population sampler under 'equivocate') -> phase-1 majority/tie
-    (node.ts:63-69) -> each lane's (byzantine-flipped) vote value ->
-    per-tile partials: cols 0-2 vote-class histogram over HONEST live
-    lanes, col 3 the tile's alive count (feeding n_alive / the quorum
-    gate — equivocators count as live senders).
+    Per-lane tallies -> phase-1 majority/tie (node.ts:63-69) -> each
+    lane's (byzantine-flipped) vote value -> per-tile partials: cols 0-2
+    vote-class histogram over HONEST live lanes, col 3 the tile's alive
+    count (feeding n_alive / the quorum gate — equivocators count as live
+    senders).  Tallies by counts_mode: 'sampled' draws them in-kernel
+    from the global histogram (CF sampler; mixed-population under
+    'equivocate'); 'delivered' broadcasts the adversary's per-trial
+    closed-form counts; 'camps' selects the targeted adversary's per-camp
+    triple by global lane id — the latter two run no sampler at all.
     """
-    has_eq = fault_model == "equivocate"
+    has_eq = fault_model == "equivocate" and counts_mode == "sampled"
     refs = list(refs)
     scal_ref = refs.pop(0)
     scal2_ref = refs.pop(0) if has_eq else None
-    rr_ref, c0_ref, c1_ref, cq_ref = refs[:4]
-    refs = refs[4:]
+    rr_ref = refs.pop(0)
+    n_cvec = {"sampled": 3, "delivered": 2, "camps": 6}[counts_mode]
+    cvecs = refs[:n_cvec]
+    refs = refs[n_cvec:]
     ne_ref = refs.pop(0) if has_eq else None
     p_ref = refs.pop(0)
     cr = refs.pop(0)[...] if has_cr else None
@@ -192,13 +214,16 @@ def _prop_hist_kernel(m, fault_model, freeze, has_cr, *refs):
     x, decided, killed, faulty, k, alive, frozen = _fields(
         p, rr_ref[0], cr, fault_model, freeze)
 
-    c0 = c0_ref[...]
-    c1 = c1_ref[...]
-    cq = cq_ref[...]
-    if has_eq:
+    if counts_mode == "delivered":
+        p0, p1 = cvecs[0][...], cvecs[1][...]
+    elif counts_mode == "camps":
+        p0, p1 = _camp_select(scal_ref, p.shape, camp_b0, camp_b1, cvecs)
+    elif has_eq:
+        c0, c1, cq = (v[...] for v in cvecs)
         p0, p1 = _mixed_draws(m, scal_ref, scal2_ref, c0, c1, cq,
                               ne_ref[...], p.shape)
     else:
+        c0, c1, cq = (v[...] for v in cvecs)
         node, trial = _lane_ids(scal_ref, p.shape)
         b0, b1 = _threefry2x32(scal_ref[0], scal_ref[1], node, trial)
         u0 = _bits_to_uniform(b0)
@@ -220,22 +245,27 @@ def _prop_hist_kernel(m, fault_model, freeze, has_cr, *refs):
 
 
 def _vote_commit_kernel(m, n_faulty, rule, coin_mode, eps, freeze,
-                        fault_model, has_cr, *refs):
+                        fault_model, has_cr, counts_mode, camp_b0,
+                        camp_b1, *refs):
     """One lane-tile of the fused VOTE phase + commit.
 
-    CF vote draws (mixed-population under 'equivocate') -> decide/adopt/
-    coin (node.ts:99-112) -> the new packed state word, plus per-tile
-    partials: cols 0-2 the NEXT round's proposal histogram (of the new
-    sent values over HONEST live lanes; exact for static-killed fault
-    models — the crash_at_round caller recomputes it in XLA instead),
-    col 3 settled count, col 4 unsettled count (the loop predicate).
+    Per-lane vote tallies (by counts_mode, as in _prop_hist_kernel) ->
+    decide/adopt/coin (node.ts:99-112) -> the new packed state word, plus
+    per-tile partials: cols 0-2 the NEXT round's proposal histogram (of
+    the new sent values over HONEST live lanes; exact for static-killed
+    fault models — the crash_at_round caller recomputes it in XLA
+    instead), col 3 settled count, col 4 unsettled count (the loop
+    predicate).
     """
-    has_eq = fault_model == "equivocate"
+    has_eq = fault_model == "equivocate" and counts_mode == "sampled"
     refs = list(refs)
     vote_scal_ref = refs.pop(0)
     vote_scal2_ref = refs.pop(0) if has_eq else None
-    coin_scal_ref, rk_ref, c0_ref, c1_ref, cq_ref = refs[:5]
-    refs = refs[5:]
+    coin_scal_ref, rk_ref = refs[:2]
+    refs = refs[2:]
+    n_cvec = {"sampled": 3, "delivered": 2, "camps": 6}[counts_mode]
+    cvecs = refs[:n_cvec]
+    refs = refs[n_cvec:]
     ne_ref = refs.pop(0) if has_eq else None
     qok_ref, shared_ref, p_ref = refs[:3]
     refs = refs[3:]
@@ -246,16 +276,22 @@ def _vote_commit_kernel(m, n_faulty, rule, coin_mode, eps, freeze,
     x, decided, killed, faulty, k, alive, frozen = _fields(
         p, rr, cr, fault_model, freeze)
 
-    # --- the sampler body, verbatim from pallas_hist._cf_kernel (or
-    # _equiv_kernel in the equivocate regime) ----------------------------
+    # --- the vote tallies ------------------------------------------------
+    # 'sampled': verbatim from pallas_hist._cf_kernel (or _equiv_kernel in
+    # the equivocate regime); 'delivered'/'camps': the adversary's
+    # closed-form counts, broadcast / camp-selected — no draws.
     node, trial = _lane_ids(vote_scal_ref, p.shape)
-    c0 = c0_ref[...]
-    c1 = c1_ref[...]
-    cq = cq_ref[...]
-    if has_eq:
+    if counts_mode == "delivered":
+        v0, v1 = cvecs[0][...], cvecs[1][...]
+    elif counts_mode == "camps":
+        v0, v1 = _camp_select(vote_scal_ref, p.shape, camp_b0, camp_b1,
+                              cvecs)
+    elif has_eq:
+        c0, c1, cq = (v[...] for v in cvecs)
         v0, v1 = _mixed_draws(m, vote_scal_ref, vote_scal2_ref, c0, c1,
                               cq, ne_ref[...], p.shape)
     else:
+        c0, c1, cq = (v[...] for v in cvecs)
         b0, b1 = _threefry2x32(vote_scal_ref[0], vote_scal_ref[1],
                                node, trial)
         u0 = _bits_to_uniform(b0)
@@ -331,34 +367,56 @@ def _part(t):
                         memory_space=pltpu.VMEM)
 
 
+def _count_vecs(hist, counts_mode):
+    """The kernels' count operands as [T, 1] f32 vecs, by counts_mode:
+    'sampled' -> the [T, 3] global histogram's three classes; 'delivered'
+    -> the adversary's [T, 3] delivered counts' two value classes ("?"
+    never enters majority/decide math); 'camps' -> the [T, 3, 3] camp
+    triples' six value-class entries, camp-major."""
+    f = hist.astype(jnp.float32)
+    if counts_mode == "sampled":
+        return [f[:, i:i + 1] for i in range(3)]
+    if counts_mode == "delivered":
+        return [f[:, i:i + 1] for i in range(2)]
+    return [f[:, c, i:i + 1] for c in range(3) for i in range(2)]
+
+
 @functools.partial(jax.jit, static_argnames=(
-    "m", "fault_model", "freeze", "interpret"))
+    "m", "fault_model", "freeze", "interpret", "counts_mode", "camp_b0",
+    "camp_b1"))
 def proposal_hist_pallas(base_key, r, phase, hist, pack, crash_round,
                          m: int, fault_model: str, freeze: bool,
                          interpret: bool = False, node_offset=0,
-                         trial_offset=0, n_equiv=None):
+                         trial_offset=0, n_equiv=None,
+                         counts_mode: str = "sampled", camp_b0: int = 0,
+                         camp_b1: int = 0):
     """Fused proposal phase over the packed state -> partials int32
     [T, 128]: cols 0-2 this shard's LOCAL vote histogram, col 3 its alive
     count (callers psum both over the nodes axis under a mesh).
 
-    hist: int32 [T, 3] global PROPOSAL class counts (HONEST senders only
-    under 'equivocate'); pack: padded packed state [T, Np]; crash_round:
-    int32 [T, Np-padded] (crash_at_round only, else None); n_equiv: int32
-    [T] global live-equivocator count ('equivocate' only, else None).
-    Uses the PHASE_PROPOSAL stream of cf_counts_pallas (equiv_counts_pallas
-    in the equivocate regime) verbatim, so the implied per-lane x1 — and
-    hence the histogram — is bit-identical to the unfused pallas path.
+    hist: by counts_mode — 'sampled': int32 [T, 3] global PROPOSAL class
+    counts (HONEST senders only under 'equivocate'), drawn from in-kernel
+    with the PHASE_PROPOSAL stream of cf_counts_pallas
+    (equiv_counts_pallas in the equivocate regime) verbatim, so the
+    implied per-lane x1 — and hence the histogram — is bit-identical to
+    the unfused pallas path; 'delivered': int32 [T, 3] closed-form
+    delivered counts (tally.adversarial_counts — identical per receiver,
+    so the kernel is deterministic given them); 'camps': int32 [T, 3, 3]
+    per-camp triples (tally.targeted_camp_triples), selected per lane by
+    global id against the static camp boundaries camp_b0/camp_b1.
+    pack: padded packed state [T, Np]; crash_round: int32 [T, Np-padded]
+    (crash_at_round only, else None); n_equiv: int32 [T] global
+    live-equivocator count ('equivocate' + 'sampled' only, else None).
     """
     T, np_total = pack.shape
     r = jnp.asarray(r, jnp.int32)
     scal = _stream_scal(base_key, r, phase, node_offset, trial_offset)
-    cls = hist.astype(jnp.float32)[..., None]
-    c0, c1, cq = cls[:, 0], cls[:, 1], cls[:, 2]
+    cvecs = _count_vecs(hist, counts_mode)
     has_cr = fault_model == "crash_at_round"
-    has_eq = fault_model == "equivocate"
+    has_eq = fault_model == "equivocate" and counts_mode == "sampled"
 
-    args = [scal, r.reshape(1), c0, c1, cq, pack]
-    specs = [_smem(), _smem(), _vec(T), _vec(T), _vec(T), _lane(T)]
+    args = [scal, r.reshape(1), *cvecs, pack]
+    specs = [_smem(), _smem(), *[_vec(T)] * len(cvecs), _lane(T)]
     if has_eq:
         scal2 = _stream_scal(base_key, r, phase + _EQUIV_SALT_OFFSET,
                              node_offset, trial_offset)
@@ -371,7 +429,7 @@ def proposal_hist_pallas(base_key, r, phase, hist, pack, crash_round,
         specs.append(_lane(T))
     parts = pl.pallas_call(
         functools.partial(_prop_hist_kernel, m, fault_model, freeze,
-                          has_cr),
+                          has_cr, counts_mode, camp_b0, camp_b1),
         out_shape=jax.ShapeDtypeStruct((np_total // TILE_N, T, 128),
                                        jnp.int32),
         grid=(np_total // TILE_N,),
@@ -384,21 +442,26 @@ def proposal_hist_pallas(base_key, r, phase, hist, pack, crash_round,
 
 @functools.partial(jax.jit, static_argnames=(
     "m", "n_faulty", "rule", "coin_mode", "eps", "freeze", "fault_model",
-    "interpret"))
+    "interpret", "counts_mode", "camp_b0", "camp_b1"))
 def vote_commit_pallas(base_key, r, phase, hist, pack, crash_round,
                        quorum_ok, shared, m: int, n_faulty: int, rule: str,
                        coin_mode: str, eps: float, freeze: bool,
                        fault_model: str, interpret: bool = False,
-                       node_offset=0, trial_offset=0, n_equiv=None):
+                       node_offset=0, trial_offset=0, n_equiv=None,
+                       counts_mode: str = "sampled", camp_b0: int = 0,
+                       camp_b1: int = 0):
     """Fused vote phase + commit -> (new_pack [T, Np], partials [T, 128]).
 
     Partials: cols 0-2 the next round's LOCAL proposal histogram (valid
     for static-killed fault models; honest senders only under
-    'equivocate'), col 3 settled count, col 4 unsettled count.  hist:
-    int32 [T, 3] global VOTE class counts (psum'd under a mesh);
-    quorum_ok: bool [T]; shared: int32-able [T] per-trial shared coin bit
-    (ignored for coin_mode='private'); n_equiv: int32 [T] global
-    live-equivocator count ('equivocate' only, else None).
+    'equivocate'), col 3 settled count, col 4 unsettled count.  hist: the
+    VOTE-phase counts in the counts_mode layout of proposal_hist_pallas
+    ('sampled': [T, 3] global class counts, psum'd under a mesh;
+    'delivered': [T, 3] closed-form counts; 'camps': [T, 3, 3] camp
+    triples); quorum_ok: bool [T]; shared: int32-able [T] per-trial
+    shared coin bit (ignored for coin_mode='private'); n_equiv: int32 [T]
+    global live-equivocator count ('equivocate' + 'sampled' only, else
+    None).
     """
     T, np_total = pack.shape
     r = jnp.asarray(r, jnp.int32)
@@ -406,15 +469,14 @@ def vote_commit_pallas(base_key, r, phase, hist, pack, crash_round,
     coin_scal = _stream_scal(base_key, r, _COIN_SALT, node_offset,
                              trial_offset)
     rk = (r + 1).reshape(1)
-    cls = hist.astype(jnp.float32)[..., None]
-    c0, c1, cq = cls[:, 0], cls[:, 1], cls[:, 2]
+    cvecs = _count_vecs(hist, counts_mode)
     qok = quorum_ok.astype(jnp.int32)[:, None]
     sh = shared.astype(jnp.int32)[:, None]
     has_cr = fault_model == "crash_at_round"
-    has_eq = fault_model == "equivocate"
+    has_eq = fault_model == "equivocate" and counts_mode == "sampled"
 
-    args = [vote_scal, coin_scal, rk, c0, c1, cq, qok, sh, pack]
-    specs = [_smem(), _smem(), _smem(), _vec(T), _vec(T), _vec(T),
+    args = [vote_scal, coin_scal, rk, *cvecs, qok, sh, pack]
+    specs = [_smem(), _smem(), _smem(), *[_vec(T)] * len(cvecs),
              _vec(T), _vec(T), _lane(T)]
     if has_eq:
         vote_scal2 = _stream_scal(base_key, r,
@@ -429,7 +491,8 @@ def vote_commit_pallas(base_key, r, phase, hist, pack, crash_round,
         specs.append(_lane(T))
     new_pack, parts = pl.pallas_call(
         functools.partial(_vote_commit_kernel, m, n_faulty, rule,
-                          coin_mode, eps, freeze, fault_model, has_cr),
+                          coin_mode, eps, freeze, fault_model, has_cr,
+                          counts_mode, camp_b0, camp_b1),
         out_shape=[jax.ShapeDtypeStruct((T, np_total), jnp.int32),
                    jax.ShapeDtypeStruct((np_total // TILE_N, T, 128),
                                         jnp.int32)],
@@ -496,7 +559,7 @@ def packed_round(cfg, pack, faults, base_key, r, hist1, ctx, n_local,
     hist1_next is None under crash_at_round (recompute via
     sent_hist_from_pack).
     """
-    from . import rng
+    from . import rng, tally
 
     T, np_total = pack.shape
     interp = jax.default_backend() == "cpu"
@@ -508,10 +571,31 @@ def packed_round(cfg, pack, faults, base_key, r, hist1, ctx, n_local,
     node_off = ctx.node_ids(n_local)[0]
     trial_off = ctx.trial_ids(T)[0]
 
+    # Counts source (tally.pallas_round_counts_mode): the uniform CF
+    # regime samples tallies in-kernel from the phase histogram; the
+    # count-controlling adversaries turn the histogram into CLOSED-FORM
+    # delivered counts here — [T, 3]-sized XLA math, mirroring the
+    # unfused receiver_counts dispatch exactly — and the kernels
+    # broadcast/camp-select them with no sampler at all.
+    mode = tally.pallas_round_counts_mode(cfg)
+    camp_b0 = camp_b1 = 0
+    if mode == "camps":
+        size_v, _ = tally.targeted_camp_sizes(cfg)
+        camp_b1 = max(cfg.n_nodes - size_v, 0)
+        camp_b0 = max(cfg.n_nodes - 2 * size_v, 0)
+
+    def kernel_counts(hist):
+        if mode == "delivered":
+            return tally.adversarial_counts(hist, m, n_free=n_equiv)
+        if mode == "camps":
+            return tally.targeted_camp_triples(cfg, hist, n_free=n_equiv)
+        return hist
+
     partsA = proposal_hist_pallas(
-        base_key, r, rng.PHASE_PROPOSAL, hist1, pack, cr, m,
+        base_key, r, rng.PHASE_PROPOSAL, kernel_counts(hist1), pack, cr, m,
         cfg.fault_model, bool(cfg.freeze_decided), interpret=interp,
-        node_offset=node_off, trial_offset=trial_off, n_equiv=n_equiv)
+        node_offset=node_off, trial_offset=trial_off, n_equiv=n_equiv,
+        counts_mode=mode, camp_b0=camp_b0, camp_b1=camp_b1)
     hist2 = ctx.psum_nodes(partsA[:, :3])
     n_alive = ctx.psum_nodes(partsA[:, 3])
     quorum_ok = n_alive >= m
@@ -523,10 +607,12 @@ def packed_round(cfg, pack, faults, base_key, r, hist1, ctx, n_local,
                                 rng.ids(1), common=True)[:, 0]
 
     new_pack, partsB = vote_commit_pallas(
-        base_key, r, rng.PHASE_VOTE, hist2, pack, cr, quorum_ok, shared,
-        m, cfg.n_faulty, cfg.rule, cfg.coin_mode, float(cfg.coin_eps),
-        bool(cfg.freeze_decided), cfg.fault_model, interpret=interp,
-        node_offset=node_off, trial_offset=trial_off, n_equiv=n_equiv)
+        base_key, r, rng.PHASE_VOTE, kernel_counts(hist2), pack, cr,
+        quorum_ok, shared, m, cfg.n_faulty, cfg.rule, cfg.coin_mode,
+        float(cfg.coin_eps), bool(cfg.freeze_decided), cfg.fault_model,
+        interpret=interp, node_offset=node_off, trial_offset=trial_off,
+        n_equiv=n_equiv, counts_mode=mode, camp_b0=camp_b0,
+        camp_b1=camp_b1)
     hist1_next = (None if cfg.fault_model == "crash_at_round"
                   else ctx.psum_nodes(partsB[:, :3]))
     unsettled = ctx.psum_nodes(partsB[:, 4])

@@ -50,23 +50,49 @@ def pallas_equiv_active(cfg: SimConfig) -> bool:
 
 def pallas_round_active(cfg: SimConfig) -> bool:
     """True iff the fully-fused round kernels (ops/pallas_round.py) serve
-    this config: the pallas-hist CF regime, ANY fault model (crash and
-    crash_at_round feed the kernels a per-round killed mask; byzantine
-    rides the vote-source flip sentinel; equivocate runs the
-    mixed-population sampler in-kernel with honest-only histograms, r4
-    VERDICT task 6), and a coin the kernel can produce in-VMEM (private /
-    common / weak with 0 < eps < 1 — the weak endpoints short-circuit to
-    plain streams on the XLA side, mirroring the unfused dispatch in
-    models/benor.py)."""
-    if not (cfg.use_pallas_round and pallas_stream_active(cfg)):
+    this config: ANY fault model (crash and crash_at_round feed the
+    kernels a per-round killed mask; byzantine rides the vote-source flip
+    sentinel; equivocate runs the mixed-population sampler in-kernel with
+    honest-only histograms, r4 VERDICT task 6), a coin the kernel can
+    produce in-VMEM (private / common / weak with 0 < eps < 1 — the weak
+    endpoints short-circuit to plain streams on the XLA side, mirroring
+    the unfused dispatch in models/benor.py), and a counts source the
+    kernel implements:
+
+      * the pallas-hist CF regime (uniform scheduler) — per-lane sampled
+        tallies, drawn in-kernel (counts_mode='sampled');
+      * the count-controlling adversaries — delivered counts are
+        CLOSED-FORM per-trial (scheduler='adversarial') or per-camp
+        (scheduler='targeted') scalars computed in XLA on [T, 3]-sized
+        data; the kernels broadcast them per lane with no sampler at all
+        (counts_mode='delivered' / 'camps').  No use_pallas_hist or
+        CF-regime gate applies: there is nothing to sample.
+    """
+    if not cfg.use_pallas_round:
+        return False
+    if cfg.coin_mode == "weak_common":
+        if not (0.0 < cfg.coin_eps < 1.0):
+            return False
+    elif cfg.coin_mode not in ("private", "common"):
+        return False
+    if pallas_stream_active(cfg):
         # pallas_hist_active | pallas_equiv_active partition
         # pallas_stream_active on fault_model, so the shared gate IS the
         # condition — stated directly so future regime edits live in one
         # place (the module comment's promise)
-        return False
-    if cfg.coin_mode == "weak_common":
-        return 0.0 < cfg.coin_eps < 1.0
-    return cfg.coin_mode in ("private", "common")
+        return True
+    return (cfg.scheduler in ("adversarial", "targeted")
+            and cfg.delivery == "quorum")
+
+
+def pallas_round_counts_mode(cfg: SimConfig) -> str:
+    """Which counts source the fused round kernels run for this config —
+    keep in sync with the dispatch in receiver_counts below."""
+    if cfg.scheduler == "adversarial":
+        return "delivered"
+    if cfg.scheduler == "targeted":
+        return "camps"
+    return "sampled"
 
 
 def dense_gather_needed(cfg: SimConfig) -> bool:
@@ -410,24 +436,40 @@ def targeted_counts(cfg: SimConfig, hist: jax.Array, node_ids: jax.Array,
     population covers the quorum.  Realizable as an explicit delivery
     schedule: scheduler.realize_counts_mask + tests/test_targeted.py.
     """
-    m = cfg.quorum
+    trip = targeted_camp_triples(cfg, hist, n_free=n_free)  # [T, 3, 3]
     size_v, _ = targeted_camp_sizes(cfg)
-    c0, c1, cq = hist[:, 0:1], hist[:, 1:2], hist[:, 2:3]   # [T, 1]
-    free = jnp.zeros_like(c0) if n_free is None else n_free[:, None]
-    camp1 = (node_ids >= cfg.n_nodes - size_v)[None, :]     # [1, N]
-    camp0 = (node_ids >= cfg.n_nodes - 2 * size_v)[None, :] & ~camp1
-    in_value_camp = camp0 | camp1
+    camp1 = node_ids >= cfg.n_nodes - size_v                # [N]
+    camp0 = (node_ids >= cfg.n_nodes - 2 * size_v) & ~camp1
+    idx = jnp.where(camp1, 1, jnp.where(camp0, 0, 2))       # [N]
+    return trip[:, idx, :]
+
+
+def targeted_camp_triples(cfg: SimConfig, hist: jax.Array,
+                          n_free: jax.Array | None = None) -> jax.Array:
+    """The targeted adversary's three camp multisets as per-TRIAL scalars:
+    int32 [T, 3 camps, 3 classes], camps ordered (0-camp, 1-camp, "?"-camp).
+
+    This is targeted_counts' entire closed form — the per-lane [T, N, 3]
+    array is just a camp-id gather of these triples (targeted_counts
+    above), and the fused round kernels select the triple in-VMEM by
+    global lane id instead of ever materializing per-lane counts
+    (ops/pallas_round.py counts_mode='camps').
+    """
+    m = cfg.quorum
+    c0, c1, cq = hist[:, 0], hist[:, 1], hist[:, 2]         # [T]
+    free = jnp.zeros_like(c0) if n_free is None else n_free
 
     # value camps: preferred class first (honest + all free), "?" second,
     # the starved class last.  free is exhausted whenever h_pref < m, so
     # no leftover-free case exists.
-    want = jnp.where(camp0, c0, c1)
-    other = jnp.where(camp0, c1, c0)
-    v_pref = jnp.minimum(want + free, m)
-    v_q = jnp.minimum(cq, m - v_pref)
-    v_oth = jnp.minimum(other, m - v_pref - v_q)
-    v0 = jnp.where(camp0, v_pref, v_oth)
-    v1 = jnp.where(camp0, v_oth, v_pref)
+    def value_camp(want, other):
+        pref = jnp.minimum(want + free, m)
+        q = jnp.minimum(cq, m - pref)
+        oth = jnp.minimum(other, m - pref - q)
+        return pref, oth, q
+
+    p0, o0, vq0 = value_camp(c0, c1)
+    p1, o1, vq1 = value_camp(c1, c0)
 
     # "?" camp: every "?" available (honest + free-as-"?"), remainder
     # filled evenly from the value classes.  An even remainder is a
@@ -450,10 +492,10 @@ def targeted_counts(cfg: SimConfig, hist: jax.Array, node_ids: jax.Array,
     # if the classes could not absorb the parity drop, restore it
     q_q = q_q + jnp.clip(left - e1, 0, drop.astype(jnp.int32))
 
-    h0 = jnp.where(in_value_camp, v0, q0)
-    h1 = jnp.where(in_value_camp, v1, q1)
-    hq = jnp.where(in_value_camp, v_q, q_q)
-    return jnp.stack([h0, h1, hq], axis=-1)
+    camp0 = jnp.stack([p0, o0, vq0], axis=-1)
+    camp1 = jnp.stack([o1, p1, vq1], axis=-1)
+    campq = jnp.stack([q0, q1, q_q], axis=-1)
+    return jnp.stack([camp0, camp1, campq], axis=1)
 
 
 def adversarial_counts(hist: jax.Array, m: int,

@@ -86,13 +86,34 @@ def _balanced(trials: int, n: int, extra_ones: int = 0) -> np.ndarray:
     return np.tile(row, (trials, 1))
 
 
+def _flagship_flags() -> Dict[str, bool]:
+    """The fused pallas flagship path for the accelerator-scale studies.
+
+    On-chip the hist sampler kernels run ~5.3x the XLA pipeline and the
+    fully-fused round a further 1.17x on top, bit-identical to the
+    unfused pallas path (BENCH_TPU.json kernel checks, N=1M x 32 on
+    v5 lite, 2026-07-31) — so the committed N=1M artifact should measure
+    the path users actually get.  The pallas stream is statistically
+    identical to the XLA stream (KS-gated, tests/test_pallas_hist.py):
+    same science, different bits.  Off on CPU (interpret-mode pallas
+    would dominate the smoke runs); silently ignored by configs the
+    kernels don't serve (non-uniform schedulers, quorum below the CF
+    regime) — see ops/tally.py:pallas_round_active."""
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return {}
+    return {"use_pallas_hist": True, "use_pallas_round": True}
+
+
 def balanced_curve(n: int, trials: int, seed: int = 0,
                    fracs=CURVE_FRACS, verbose=True) -> List[SweepPoint]:
     pts = []
     for frac in fracs:
         cfg = SimConfig(n_nodes=n, n_faulty=int(frac * n), trials=trials,
                         max_rounds=64, delivery="quorum",
-                        scheduler="uniform", path="histogram", seed=seed)
+                        scheduler="uniform", path="histogram", seed=seed,
+                        **_flagship_flags())
         pt = run_point(cfg, initial_values=_balanced(trials, n),
                        faults=FaultSpec.none(trials, n))
         pts.append(pt)
@@ -110,7 +131,8 @@ def margin_sweep(n: int, trials: int, seed: int = 0, f_frac: float = 0.40,
         extra = int(round(delta * np.sqrt(n) / 2))  # 1-count - N/2
         cfg = SimConfig(n_nodes=n, n_faulty=int(f_frac * n), trials=trials,
                         max_rounds=64, delivery="quorum",
-                        scheduler="uniform", path="histogram", seed=seed)
+                        scheduler="uniform", path="histogram", seed=seed,
+                        **_flagship_flags())
         pt = run_point(cfg, initial_values=_balanced(trials, n, extra),
                        faults=FaultSpec.none(trials, n))
         rows.append({"delta": delta, "extra_ones": extra, **pt.to_dict()})
@@ -141,7 +163,8 @@ def disagreement_sweep(n: int, trials: int, seed: int = 0,
         cfg = SimConfig(n_nodes=n, n_faulty=int(f_frac * n), trials=trials,
                         max_rounds=64, delivery="quorum",
                         scheduler="biased" if s > 0 else "uniform",
-                        adversary_strength=s, path="histogram", seed=seed)
+                        adversary_strength=s, path="histogram", seed=seed,
+                        **_flagship_flags())
         pt = run_point(cfg, initial_values=_balanced(trials, n),
                        faults=FaultSpec.none(trials, n))
         rows.append({"strength": s, **pt.to_dict()})
@@ -381,7 +404,7 @@ def rule_comparison(n: int, trials: int, seed: int = 0,
         cfg = SimConfig(n_nodes=n, n_faulty=int(f_frac * n), trials=trials,
                         max_rounds=64, delivery="quorum",
                         scheduler="uniform", path="histogram", rule=rule,
-                        seed=seed)
+                        seed=seed, **_flagship_flags())
         pt = run_point(cfg, initial_values=_balanced(trials, n),
                        faults=FaultSpec.none(trials, n))
         rows.append({"rule": rule, **pt.to_dict()})
@@ -414,7 +437,8 @@ def scaling_study(n_large: int, trials: int, seed: int = 0,
     for n in ns:
         cfg = SimConfig(n_nodes=n, n_faulty=int(f_frac * n), trials=trials,
                         max_rounds=64, delivery="quorum",
-                        scheduler="uniform", path="histogram", seed=seed)
+                        scheduler="uniform", path="histogram", seed=seed,
+                        **_flagship_flags())
         pt = run_point(cfg, initial_values=_balanced(trials, n),
                        faults=FaultSpec.none(trials, n))
         rows.append({"n": n, **pt.to_dict()})
@@ -436,7 +460,7 @@ def trajectory_study(n: int, trials: int, seed: int = 0,
 
     cfg = SimConfig(n_nodes=n, n_faulty=int(f_frac * n), trials=trials,
                     max_rounds=64, delivery="quorum", scheduler="uniform",
-                    path="histogram", seed=seed)
+                    path="histogram", seed=seed, **_flagship_flags())
     faults = FaultSpec.none(trials, n)
     state = init_state(cfg, _balanced(trials, n), faults)
     _, traj = record_trajectory(cfg, state, faults, jax.random.key(seed),

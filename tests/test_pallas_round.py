@@ -174,13 +174,19 @@ def test_gating():
             SimConfig(**{**base, "fault_model": "crash_at_round"}))
         assert tally.pallas_round_active(
             SimConfig(**{**base, "fault_model": "equivocate"}))
-        # off without the flag, the hist kernel, or the uniform scheduler
+        # off without the flag, or (in the uniform regime) the hist kernel
         assert not tally.pallas_round_active(
             SimConfig(**{**base, "use_pallas_round": False}))
         assert not tally.pallas_round_active(
             SimConfig(**{**base, "use_pallas_hist": False}))
-        assert not tally.pallas_round_active(
+        # the count-controlling adversaries ARE served (closed-form
+        # delivered counts, counts_mode='delivered'/'camps' — full
+        # battery in tests/test_pallas_round_adv.py); biased has no
+        # closed form and stays unfused
+        assert tally.pallas_round_active(
             SimConfig(**{**base, "scheduler": "adversarial"}))
+        assert not tally.pallas_round_active(
+            SimConfig(**{**base, "scheduler": "biased"}))
         # weak-coin endpoints short-circuit to plain streams (XLA side)
         assert not tally.pallas_round_active(SimConfig(
             **{**base, "coin_mode": "weak_common", "coin_eps": 0.0}))

@@ -1,0 +1,256 @@
+"""Fused round kernels under the count-controlling adversaries.
+
+ops/pallas_round.py counts_mode='delivered' (scheduler='adversarial') and
+'camps' (scheduler='targeted'): the adversary's per-receiver counts are
+CLOSED FORMS of the per-trial class histogram (tally.adversarial_counts /
+targeted_camp_triples), so the fused kernels consume them as broadcast
+scalars — no sampler runs at all.  That makes the fused path exactly as
+deterministic as the XLA path given the same coin bits:
+
+  * coin_mode='common' draws ONE shared bit per (trial, round) from the
+    SAME XLA-side stream on both paths (rng.coin_flips keys on trial ids
+    only), so a fused adversarial run is BIT-IDENTICAL to the unfused XLA
+    run — these tests are exact pins, not statistical gates.
+  * coin_mode='private'/'weak_common' use the in-kernel threefry streams
+    (the pallas path's documented coherent alternative stream, as for the
+    uniform regime) — per-lane coin bits differ from the XLA streams, so
+    the pins cover the coin-free parts (decided/k/camp values) and the
+    science-level behavior (termination transition), not raw x bits.
+
+Reference for the adversary semantics: tally.adversarial_counts /
+targeted_counts docstrings; the attack itself realizes the reference's
+"first N-F arrivals win" nondeterminism (node.ts:52,88) worst case.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from benor_tpu.config import SimConfig
+from benor_tpu.ops import tally
+from benor_tpu.sim import resume_consensus, run_consensus
+from benor_tpu.state import FaultSpec, init_state
+from benor_tpu.sweep import balanced_inputs
+from benor_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+N, T = 96, 8
+
+
+def _cfg(use_round, **kw):
+    base = dict(n_nodes=N, n_faulty=24, trials=T, delivery="quorum",
+                scheduler="adversarial", coin_mode="common",
+                path="histogram", max_rounds=12, seed=3,
+                use_pallas_round=use_round)
+    base.update(kw)
+    return SimConfig(**base)
+
+
+def _run(cfg, crash_rounds=None, seed=None):
+    if cfg.fault_model in ("equivocate", "byzantine", "crash_at_round"):
+        faults = FaultSpec.first_f(cfg, crash_rounds=crash_rounds)
+    else:
+        faults = FaultSpec.none(cfg.trials, cfg.n_nodes)
+    state = init_state(cfg, balanced_inputs(cfg.trials, cfg.n_nodes), faults)
+    r, fin = run_consensus(cfg, state, faults,
+                           jax.random.key(cfg.seed if seed is None else seed))
+    return int(r), fin, faults
+
+
+def _assert_bit_identical(a, b):
+    (ra, fa), (rb, fb) = a, b
+    assert ra == rb
+    np.testing.assert_array_equal(np.asarray(fa.x), np.asarray(fb.x))
+    np.testing.assert_array_equal(np.asarray(fa.decided),
+                                  np.asarray(fb.decided))
+    np.testing.assert_array_equal(np.asarray(fa.k), np.asarray(fb.k))
+
+
+@pytest.mark.parametrize("kw", [
+    dict(),                                                # crash, tie camp
+    dict(rule="textbook"),
+    dict(freeze_decided=False),
+    dict(fault_model="byzantine", n_faulty=20),
+    dict(fault_model="equivocate", n_faulty=20),
+    dict(fault_model="equivocate", n_faulty=33),           # 3F > N livelock
+    dict(scheduler="targeted"),
+    dict(scheduler="targeted", n_faulty=47),               # f just under N/2
+    dict(scheduler="targeted", fault_model="equivocate", n_faulty=1),
+], ids=["adv-crash", "adv-textbook", "adv-nofreeze", "adv-byzantine",
+        "adv-equiv-sub3f", "adv-equiv-super3f", "targeted", "targeted-wide",
+        "targeted-one-equivocator"])
+@pytest.mark.slow
+def test_fused_adv_bit_identical_common_coin(kw):
+    """Common coin => both paths share every random bit => exact equality."""
+    cfg0, cfg1 = _cfg(False, **kw), _cfg(True, **kw)
+    assert not tally.pallas_round_active(cfg0)
+    assert tally.pallas_round_active(cfg1)
+    r0, f0, _ = _run(cfg0)
+    r1, f1, _ = _run(cfg1)
+    _assert_bit_identical((r0, f0), (r1, f1))
+
+
+@pytest.mark.slow
+def test_fused_adv_crash_at_round_bit_identical():
+    cr = np.where(np.arange(N) < 20, 3, 0)
+    kw = dict(fault_model="crash_at_round", n_faulty=20)
+    r0, f0, _ = _run(_cfg(False, **kw), crash_rounds=cr)
+    r1, f1, _ = _run(_cfg(True, **kw), crash_rounds=cr)
+    _assert_bit_identical((r0, f0), (r1, f1))
+
+
+@pytest.mark.slow
+def test_fused_targeted_private_coin_decisions_exact():
+    """The targeted camps' decisions are coin-free (their counts clear the
+    bar before any coin fires), so decided/k — and the camp lanes' values —
+    must match the XLA path exactly even though the private-coin streams
+    differ; only the "?"-camp lanes' never-deciding x bits may diverge."""
+    kw = dict(scheduler="targeted", coin_mode="private")
+    r0, f0, _ = _run(_cfg(False, **kw))
+    r1, f1, _ = _run(_cfg(True, **kw))
+    assert r0 == r1
+    np.testing.assert_array_equal(np.asarray(f0.decided),
+                                  np.asarray(f1.decided))
+    np.testing.assert_array_equal(np.asarray(f0.k), np.asarray(f1.k))
+    size_v, _ = tally.targeted_camp_sizes(_cfg(True, **kw))
+    camp = np.arange(N) >= N - 2 * size_v
+    np.testing.assert_array_equal(np.asarray(f0.x)[:, camp],
+                                  np.asarray(f1.x)[:, camp])
+    # the attack itself: both camps decide, opposite values
+    assert np.asarray(f1.decided)[:, camp].all()
+
+
+@pytest.mark.slow
+def test_fused_adv_weak_coin_transition_preserved():
+    """The weak-coin termination transition (eps* = 1 - f) is a law of the
+    DELIVERED COUNTS, not of the coin stream — the fused path's alternative
+    deviator stream must reproduce it: decisive below eps*, livelocked
+    above (f = 0.375 => eps* = 0.625; margins wide enough for N = 96)."""
+    for eps, want in ((0.3, 1.0), (0.95, 0.0)):
+        cfg = _cfg(True, coin_mode="weak_common", coin_eps=eps, n_faulty=36)
+        assert tally.pallas_round_active(cfg)
+        _, fin, faults = _run(cfg)
+        healthy = ~np.asarray(faults.faulty)[0]
+        frac = np.asarray(fin.decided)[:, healthy].mean()
+        assert frac == want, (eps, frac)
+
+
+@pytest.mark.slow
+def test_fused_adv_private_livelock_aggregates():
+    """Private coins against the count adversary: at a tie-proof scale the
+    run must livelock on BOTH paths (decided stays 0 for every stream) —
+    the classic Ben-Or contrast the bench's adv_private regime pins at
+    N=1M.  N=512 keeps min(c0, c1) >= m/2 overwhelmingly likely per round
+    (P(fail) ~ 1e-12), so 8 trials x 8 rounds are deterministic in
+    practice."""
+    kw = dict(coin_mode="private", n_nodes=512, n_faulty=128, max_rounds=8)
+    r0, f0, _ = _run(_cfg(False, **kw))
+    r1, f1, _ = _run(_cfg(True, **kw))
+    assert r0 == r1 == 8
+    assert not np.asarray(f0.decided).any()
+    assert not np.asarray(f1.decided).any()
+    np.testing.assert_array_equal(np.asarray(f0.k), np.asarray(f1.k))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kw", [
+    dict(),
+    dict(scheduler="targeted"),
+    dict(fault_model="equivocate", n_faulty=20),
+], ids=["adversarial", "targeted", "adv-equivocate"])
+def test_fused_adv_mesh_bit_identical(kw):
+    """The delivered/camps closed forms psum per-trial histograms and key
+    every in-kernel stream on GLOBAL lane ids, so the fused adversarial
+    run must be bit-identical across mesh shapes (the same contract the
+    sampled mode pins in tests/test_pallas_round.py)."""
+    from benor_tpu.parallel import make_mesh, run_consensus_sharded
+
+    cfg = _cfg(True, **kw)
+    r_ref, fin_ref, faults = _run(cfg)
+    for mesh_shape in ((2, 2), (4, 1)):
+        cfg_m = cfg.replace(mesh_shape=mesh_shape)
+        state = init_state(cfg_m,
+                           balanced_inputs(cfg.trials, cfg.n_nodes), faults)
+        r, fin = run_consensus_sharded(cfg_m, state, faults,
+                                       jax.random.key(cfg.seed),
+                                       make_mesh(*mesh_shape))
+        assert int(r) == r_ref, mesh_shape
+        np.testing.assert_array_equal(np.asarray(fin.x),
+                                      np.asarray(fin_ref.x))
+        np.testing.assert_array_equal(np.asarray(fin.decided),
+                                      np.asarray(fin_ref.decided))
+        np.testing.assert_array_equal(np.asarray(fin.k),
+                                      np.asarray(fin_ref.k))
+
+
+@pytest.mark.slow
+def test_fused_adv_checkpoint_resume_bit_identical(tmp_path):
+    """Cut the fused adversarial run mid-flight, restore, resume: the
+    spliced run must equal the uncut one bit-for-bit (the packed slice
+    path's contract, now covering counts_mode='delivered')."""
+    cfg = _cfg(True, fault_model="equivocate", n_faulty=20)
+    faults = FaultSpec.first_f(cfg)
+    state = init_state(cfg, balanced_inputs(T, N), faults)
+    key = jax.random.key(cfg.seed)
+    r_full, fin_full = run_consensus(cfg, state, faults, key)
+
+    cut = cfg.replace(max_rounds=1)
+    r_cut, fin_cut = run_consensus(cut, state, faults, key)
+    path = str(tmp_path / "adv.npz")
+    save_checkpoint(path, cfg, fin_cut, faults,
+                    next_round=int(r_cut) + 1)
+    cfg_r, st_r, faults_r, nxt, key_r = load_checkpoint(path)
+    r_res, fin_res = resume_consensus(cfg_r, st_r, faults_r, key_r, nxt)
+    assert int(r_full) == int(r_res)
+    np.testing.assert_array_equal(np.asarray(fin_full.x),
+                                  np.asarray(fin_res.x))
+    np.testing.assert_array_equal(np.asarray(fin_full.decided),
+                                  np.asarray(fin_res.decided))
+    np.testing.assert_array_equal(np.asarray(fin_full.k),
+                                  np.asarray(fin_res.k))
+
+
+def test_targeted_counts_refactor_matches_triples():
+    """targeted_counts == a camp-id gather of targeted_camp_triples (the
+    refactor that lets the kernel select triples in-VMEM must not have
+    changed the closed form)."""
+    cfg = SimConfig(n_nodes=50, n_faulty=12, trials=4, delivery="quorum",
+                    scheduler="targeted", path="histogram")
+    hist = np.array([[20, 18, 0], [10, 10, 18], [38, 0, 0], [0, 0, 38]],
+                    np.int32)
+    node_ids = np.arange(50)
+    full = np.asarray(tally.targeted_counts(cfg, hist, node_ids))
+    trip = np.asarray(tally.targeted_camp_triples(cfg, hist))
+    size_v, _ = tally.targeted_camp_sizes(cfg)
+    camp = np.where(node_ids >= 50 - size_v, 1,
+                    np.where(node_ids >= 50 - 2 * size_v, 0, 2))
+    np.testing.assert_array_equal(full, trip[:, camp, :])
+    assert (full.sum(-1) == cfg.quorum).all()
+
+
+def test_pallas_round_active_adv_gating():
+    """The fused-round predicate: engages for the count adversaries only
+    with use_pallas_round + quorum delivery + a kernel-supported coin;
+    never for biased (no closed form) or the weak endpoints."""
+    base = dict(n_nodes=64, n_faulty=16, delivery="quorum",
+                path="histogram", use_pallas_round=True)
+    assert tally.pallas_round_active(SimConfig(scheduler="adversarial",
+                                               **base))
+    assert tally.pallas_round_active(SimConfig(scheduler="targeted",
+                                               **base))
+    assert tally.pallas_round_counts_mode(
+        SimConfig(scheduler="adversarial", **base)) == "delivered"
+    assert tally.pallas_round_counts_mode(
+        SimConfig(scheduler="targeted", **base)) == "camps"
+    assert not tally.pallas_round_active(SimConfig(scheduler="biased",
+                                                   **base))
+    assert not tally.pallas_round_active(
+        SimConfig(scheduler="adversarial", **{**base,
+                                              "use_pallas_round": False}))
+    assert not tally.pallas_round_active(
+        SimConfig(scheduler="adversarial", coin_mode="weak_common",
+                  coin_eps=1.0, **base))
+    # adversarial + delivery='all' can't even be constructed (the config
+    # layer rejects powerless scheduler/delivery combos), so the
+    # predicate's quorum-delivery clause is belt-and-braces
+    with pytest.raises(ValueError, match="has no effect"):
+        SimConfig(scheduler="adversarial", **{**base, "delivery": "all"})
