@@ -1,28 +1,44 @@
-"""Persistent XLA compilation cache (best-effort).
+"""Persistent XLA compilation cache (accelerator backends only).
 
 Under the axon tunnel every distinct SimConfig costs an ~8-40 s remote
 compile; the persistent cache cuts repeat invocations (bench reps, results
 regeneration, driver re-runs) to seconds — measured 52.7 s -> 12.7 s for
 the bench's 10-regime warm-up.  Failures are logged and ignored: a cache
 problem must never take down a run.
+
+The CPU backend is EXCLUDED.  XLA:CPU entries are AOT artifacts tied to
+the exact machine profile of the writer, the cache key does not include
+that profile, and the (de)serializer is not crash-safe: on 2026-07-31
+three consecutive full-suite runs on a migrated workspace segfaulted
+inside compilation_cache.get_executable_and_time (loading an entry
+written by an earlier-round host — the "Machine type used for XLA:CPU
+compilation doesn't match" warning path) and put_executable_and_time
+(serializing a fresh entry), while the identical tests pass with the
+cache off.  CPU compiles are local and comparatively cheap; the cache's
+real value is the REMOTE accelerator compiles — so the CPU lane simply
+runs uncached.
 """
 
 from __future__ import annotations
 
-import os
 import sys
 
 
 def enable_compile_cache(cache_dir: str | None = None) -> None:
     """Point jax at a persistent compilation cache directory.
 
-    Default location: `.jax_cache/` next to the repository root (one level
-    above this package) — kept inside the workspace so it survives across
-    driver invocations and is .gitignore'd.
+    Default location: `.jax_cache/` next to the repository root (one
+    level above this package) — kept inside the workspace so it survives
+    across driver invocations, .gitignore'd.  No-op on the CPU backend
+    (see module docstring) unless an explicit ``cache_dir`` is passed.
     """
     try:
+        import os
+
         import jax
         if cache_dir is None:
+            if jax.default_backend() == "cpu":
+                return
             pkg_root = os.path.dirname(os.path.dirname(
                 os.path.dirname(os.path.abspath(__file__))))
             cache_dir = os.path.join(pkg_root, ".jax_cache")

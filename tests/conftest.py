@@ -22,11 +22,10 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-# Persistent compile cache (repo-root .jax_cache, shared with bench/CLI):
-# the suite's wall-clock is compile-dominated — every distinct SimConfig
-# re-jits its while-loop — so a warm cache cuts the `-m "not slow"`
-# iteration lane by several-fold on repeat runs.  Results are unaffected
-# (the cache stores XLA executables keyed on HLO + platform).
-from benor_tpu.utils.cache import enable_compile_cache  # noqa: E402
-
-enable_compile_cache()
+# NOTE: the persistent compile cache is deliberately NOT enabled here.
+# XLA:CPU cache entries are machine-profile AOT artifacts and their
+# (de)serializer segfaulted three consecutive full-suite runs on a
+# migrated workspace (2026-07-31) — benor_tpu/utils/cache.py no-ops on
+# the CPU backend for exactly this reason, and calling it here would
+# just document a false dependency.  The accelerator paths (bench,
+# recapture, CLI on TPU) still use .jax_cache/.
