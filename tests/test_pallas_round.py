@@ -355,3 +355,56 @@ def test_fused_single_device_slice_and_resume_bit_identical():
         _assert_same((int(r1), f1), (int(rr), fr))
     finally:
         sampling.EXACT_TABLE_MAX = old
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kw", [
+    dict(scheduler="uniform", use_pallas_hist=True, table_max=4),
+    dict(scheduler="adversarial", coin_mode="common", table_max=None),
+], ids=["sampled", "delivered"])
+def test_record_trajectory_fused_matches_endpoint(kw):
+    """results.trajectory_study runs record_trajectory with the flagship
+    flags on the accelerator — the per-round benor_round wrapper
+    (packed_round: pack/unpack at the round boundary) must agree with
+    the packed while-loop's endpoint for BOTH counts sources.  Under
+    the common-coin delivered mode the fused scan additionally equals
+    the unfused XLA scan bit-for-bit (shared streams)."""
+    from benor_tpu.sweep import record_trajectory
+
+    kw = dict(kw)                      # parametrize dicts must stay pristine
+    table_max = kw.pop("table_max")
+    old = sampling.EXACT_TABLE_MAX
+    if table_max is not None:
+        sampling.EXACT_TABLE_MAX = table_max
+    try:
+        def run(use_round):
+            cfg = SimConfig(n_nodes=N, n_faulty=24, trials=T,
+                            delivery="quorum", path="histogram",
+                            use_pallas_round=use_round, max_rounds=16,
+                            seed=8, **kw)
+            faults = FaultSpec.none(T, N)
+            state = init_state(cfg, balanced_inputs(T, N), faults)
+            key = jax.random.key(cfg.seed)
+            if use_round:
+                assert tally.pallas_round_active(cfg)
+            r_end, fin_end = run_consensus(cfg, state, faults, key)
+            fin_sc, traj = record_trajectory(cfg, state, faults, key,
+                                             n_rounds=int(r_end) + 1)
+            return fin_end, fin_sc, {k: np.asarray(v)
+                                     for k, v in traj.items()}
+
+        fin_end, fin_sc, traj = run(True)
+        # scan endpoint == while-loop endpoint (fused path vs itself)
+        np.testing.assert_array_equal(np.asarray(fin_sc.x),
+                                      np.asarray(fin_end.x))
+        np.testing.assert_array_equal(np.asarray(fin_sc.decided),
+                                      np.asarray(fin_end.decided))
+        assert traj["decided"][-1] == 1.0
+
+        if kw.get("scheduler") == "adversarial":
+            # common coin: fused trajectory == unfused XLA trajectory
+            _, _, traj_x = run(False)
+            for name in traj:
+                np.testing.assert_array_equal(traj[name], traj_x[name])
+    finally:
+        sampling.EXACT_TABLE_MAX = old
