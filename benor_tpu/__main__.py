@@ -90,19 +90,37 @@ def _demo(args) -> int:
     return 0
 
 
+def _pallas_flags(choice: str) -> dict:
+    """--pallas plumbing: 'auto' engages the fused flagship path exactly
+    when results.py's accelerator-scale studies do (on for accelerator
+    backends, off on CPU where interpret-mode pallas would dominate);
+    'on' forces it (CPU runs use the interpreter — correct, slow); 'off'
+    pins the plain XLA path.  Ineligible configs (biased scheduler, the
+    exact-table regime) ignore the flags silently, like everywhere else.
+    """
+    from .results import FLAGSHIP_FLAGS, _flagship_flags
+    if choice == "on":
+        return dict(FLAGSHIP_FLAGS)
+    if choice == "off":
+        return {}
+    return _flagship_flags()
+
+
 def _sweep(args) -> int:
     from .config import SimConfig
     from .sweep import rounds_vs_f, run_point, save_points
     f_values = [int(x) for x in args.f_values.split(",")]
+    flags = _pallas_flags(args.pallas)
     cfg = SimConfig(n_nodes=args.n, n_faulty=0, trials=args.trials,
                     max_rounds=args.max_rounds, delivery="quorum",
                     scheduler=args.scheduler, coin_mode=args.coin,
-                    fault_model=args.fault_model, seed=args.seed)
+                    fault_model=args.fault_model, seed=args.seed, **flags)
     mode = "balanced/no-crash" if args.balanced else "iid/crash"
     fb = " [cpu fallback]" if FELL_BACK else ""
     print(f"rounds-vs-f sweep: N={args.n}, trials={args.trials}, "
           f"scheduler={args.scheduler}, coin={args.coin}, "
-          f"faults={args.fault_model}, inputs={mode}{fb}")
+          f"faults={args.fault_model}, inputs={mode}"
+          f"{', pallas' if flags else ''}{fb}")
     if args.balanced:
         # the science regime: balanced inputs, F purely a protocol
         # parameter (crash-pinned faults make every tally the deterministic
@@ -141,7 +159,8 @@ def _coins(args) -> int:
     from .state import FaultSpec
     from .sweep import balanced_inputs, coin_comparison, run_point
     cfg = SimConfig(n_nodes=args.n, n_faulty=args.f, trials=args.trials,
-                    max_rounds=args.max_rounds, seed=args.seed)
+                    max_rounds=args.max_rounds, seed=args.seed,
+                    **_pallas_flags(args.pallas))
     res = coin_comparison(cfg)
     for mode, pts in res.items():
         p = pts[0]
@@ -221,6 +240,10 @@ def main(argv=None) -> int:
                    choices=("crash", "byzantine", "equivocate"),
                    default="crash")
     s.add_argument("--seed", type=int, default=0)
+    s.add_argument("--pallas", choices=("auto", "on", "off"),
+                   default="auto",
+                   help="fused pallas flagship path (auto: on for "
+                        "accelerator backends, off on CPU)")
     s.add_argument("--balanced", action="store_true",
                    help="balanced inputs + zero crashes (the multi-round "
                         "science regime; default is the reference-style "
@@ -233,6 +256,10 @@ def main(argv=None) -> int:
     c.add_argument("--trials", type=int, default=128)
     c.add_argument("--max-rounds", type=int, default=48)
     c.add_argument("--seed", type=int, default=0)
+    c.add_argument("--pallas", choices=("auto", "on", "off"),
+                   default="auto",
+                   help="fused pallas flagship path (auto: on for "
+                        "accelerator backends, off on CPU)")
     c.add_argument("--eps", type=float, nargs="*",
                    help="also run weak_common coins at these deviation "
                         "probabilities (0 ~ common, 1 ~ private; the "

@@ -86,6 +86,12 @@ def _balanced(trials: int, n: int, extra_ones: int = 0) -> np.ndarray:
     return np.tile(row, (trials, 1))
 
 
+#: The fused flagship path's flag set — ONE definition, shared by the
+#: accelerator branch below and the CLI's `--pallas on` force
+#: (benor_tpu/__main__.py), so the two can never diverge.
+FLAGSHIP_FLAGS = {"use_pallas_hist": True, "use_pallas_round": True}
+
+
 def _flagship_flags() -> Dict[str, bool]:
     """The fused pallas flagship path for the accelerator-scale studies.
 
@@ -103,7 +109,7 @@ def _flagship_flags() -> Dict[str, bool]:
 
     if jax.default_backend() == "cpu":
         return {}
-    return {"use_pallas_hist": True, "use_pallas_round": True}
+    return dict(FLAGSHIP_FLAGS)
 
 
 def balanced_curve(n: int, trials: int, seed: int = 0,

@@ -398,3 +398,34 @@ class TestCli:
         pts = json.load(open(out))
         assert len(pts) == 2 and all("disagree_frac" in p for p in pts)
         assert "balanced/no-crash" in capsys.readouterr().out
+
+    @pytest.mark.slow
+    def test_sweep_cli_pallas_flag(self, tmp_path, capsys):
+        """--pallas on engages the fused flagship flags (adversarial =
+        counts_mode 'delivered', active at ANY quorum) and says so in the
+        header; --pallas auto on CPU stays off.  Same seed, same closed
+        forms + shared common-coin stream => identical points."""
+        from benor_tpu.__main__ import main
+        outs = {}
+        for choice in ("on", "auto"):
+            out = str(tmp_path / f"p_{choice}.json")
+            assert main(["sweep", "--n", "24", "--f-values", "6",
+                         "--trials", "8", "--balanced", "--scheduler",
+                         "adversarial", "--coin", "common",
+                         "--max-rounds", "8", "--pallas", choice,
+                         "--out", out]) == 0
+            header = capsys.readouterr().out
+            assert (", pallas" in header) == (choice == "on")
+            outs[choice] = [
+                {k: v for k, v in p.items()
+                 if k not in ("seconds", "trials_per_sec")}
+                for p in json.load(open(out))]
+        assert outs["on"] == outs["auto"]
+
+    @pytest.mark.slow
+    def test_coins_cli_pallas_flag(self, capsys):
+        from benor_tpu.__main__ import main
+        assert main(["coins", "--n", "20", "--f", "6", "--trials", "8",
+                     "--max-rounds", "8", "--pallas", "on"]) == 0
+        out = capsys.readouterr().out
+        assert "private:" in out and "common:" in out
