@@ -90,6 +90,16 @@ def _demo(args) -> int:
     return 0
 
 
+def _add_pallas_arg(sub) -> None:
+    """ONE definition of the --pallas option for every subparser that
+    runs the compute path (sweep, coins) — mirrors FLAGSHIP_FLAGS'
+    single-definition rationale."""
+    sub.add_argument("--pallas", choices=("auto", "on", "off"),
+                     default="auto",
+                     help="fused pallas flagship path (auto: on for "
+                          "accelerator backends, off on CPU)")
+
+
 def _pallas_flags(choice: str) -> dict:
     """--pallas plumbing: 'auto' engages the fused flagship path exactly
     when results.py's accelerator-scale studies do (on for accelerator
@@ -117,10 +127,15 @@ def _sweep(args) -> int:
                     fault_model=args.fault_model, seed=args.seed, **flags)
     mode = "balanced/no-crash" if args.balanced else "iid/crash"
     fb = " [cpu fallback]" if FELL_BACK else ""
+    # banner reports the compute path actually taken, not the request:
+    # ineligible configs (sub-CF-regime quorums, biased scheduler)
+    # silently ignore the flags
+    from .ops.tally import pallas_round_active, pallas_stream_active
+    engaged = pallas_round_active(cfg) or pallas_stream_active(cfg)
     print(f"rounds-vs-f sweep: N={args.n}, trials={args.trials}, "
           f"scheduler={args.scheduler}, coin={args.coin}, "
           f"faults={args.fault_model}, inputs={mode}"
-          f"{', pallas' if flags else ''}{fb}")
+          f"{', pallas' if engaged else ''}{fb}")
     if args.balanced:
         # the science regime: balanced inputs, F purely a protocol
         # parameter (crash-pinned faults make every tally the deterministic
@@ -240,10 +255,7 @@ def main(argv=None) -> int:
                    choices=("crash", "byzantine", "equivocate"),
                    default="crash")
     s.add_argument("--seed", type=int, default=0)
-    s.add_argument("--pallas", choices=("auto", "on", "off"),
-                   default="auto",
-                   help="fused pallas flagship path (auto: on for "
-                        "accelerator backends, off on CPU)")
+    _add_pallas_arg(s)
     s.add_argument("--balanced", action="store_true",
                    help="balanced inputs + zero crashes (the multi-round "
                         "science regime; default is the reference-style "
@@ -256,10 +268,7 @@ def main(argv=None) -> int:
     c.add_argument("--trials", type=int, default=128)
     c.add_argument("--max-rounds", type=int, default=48)
     c.add_argument("--seed", type=int, default=0)
-    c.add_argument("--pallas", choices=("auto", "on", "off"),
-                   default="auto",
-                   help="fused pallas flagship path (auto: on for "
-                        "accelerator backends, off on CPU)")
+    _add_pallas_arg(c)
     c.add_argument("--eps", type=float, nargs="*",
                    help="also run weak_common coins at these deviation "
                         "probabilities (0 ~ common, 1 ~ private; the "

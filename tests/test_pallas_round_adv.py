@@ -254,3 +254,30 @@ def test_pallas_round_active_adv_gating():
     # predicate's quorum-delivery clause is belt-and-braces
     with pytest.raises(ValueError, match="has no effect"):
         SimConfig(scheduler="adversarial", **{**base, "delivery": "all"})
+
+
+@pytest.mark.slow
+def test_fused_adv_poll_rounds_bit_identical():
+    """Mid-run observability over the fused adversarial loop: slicing the
+    packed while-loop (SimConfig.poll_rounds) must reproduce the one-shot
+    run bit-for-bit — the TpuNetwork polling contract, now covering
+    counts_mode='delivered'."""
+    from benor_tpu.api import launch_network
+
+    k_seen = []
+    nets = []
+    for poll in (0, 2):
+        net = launch_network(
+            N, 24, [i % 2 for i in range(N)],
+            [True] * 24 + [False] * (N - 24),
+            trials=1, delivery="quorum", scheduler="adversarial",
+            coin_mode="common", path="histogram", max_rounds=12,
+            use_pallas_round=True, poll_rounds=poll)
+        if poll:
+            net.start(on_slice=lambda n=net: k_seen.append(
+                max(s["k"] or 0 for s in n.get_states())))
+        else:
+            net.start()
+        nets.append(net)
+    assert nets[0].get_states() == nets[1].get_states()
+    assert k_seen, "poller must observe at least one mid-run snapshot"
