@@ -759,11 +759,18 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
     for name, cfg, rounds, final, faults in results:
         dec_frac, mean_k, ones_frac, _, disagree = summarize_final(
             final, faults.faulty, cfg.max_rounds)
+        # report the compute path actually TAKEN, not the flags requested:
+        # base sets both flags for every regime, but the kernels silently
+        # gate off where they don't serve the config (e.g. the biased
+        # scheduler has no closed form and no sampler kernel)
+        from benor_tpu.ops.tally import (pallas_equiv_active,
+                                         pallas_hist_active,
+                                         pallas_round_active)
         row = {
             "regime": name, "f_frac": round(cfg.n_faulty / n, 3),
             "scheduler": cfg.scheduler, "coin": cfg.coin_mode,
-            "pallas": cfg.use_pallas_hist,
-            "fused_round": cfg.use_pallas_round,
+            "pallas": pallas_hist_active(cfg) or pallas_equiv_active(cfg),
+            "fused_round": pallas_round_active(cfg),
             "rounds_executed": rounds,
             "decided": round(float(dec_frac), 4),
             "mean_k": round(float(mean_k), 3),
@@ -972,7 +979,10 @@ def main() -> None:
         }
     if any(k in out for k in _DETAIL_KEYS):
         headline, detail = _split_headline(out)
-        detail_path = os.path.join(HERE, "BENCH_DETAIL.json")
+        # BENCH_DETAIL_PATH: redirect the sidecar (ad-hoc smoke runs must
+        # not clobber a committed on-chip capture at the default path)
+        detail_path = os.environ.get(
+            "BENCH_DETAIL_PATH", os.path.join(HERE, "BENCH_DETAIL.json"))
         try:
             with open(detail_path, "w") as fh:
                 json.dump({**headline, **detail}, fh, indent=1)
