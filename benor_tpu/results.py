@@ -56,6 +56,7 @@ Studies beyond the presets:
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import time
@@ -109,7 +110,53 @@ def _flagship_flags() -> Dict[str, bool]:
 
     if jax.default_backend() == "cpu":
         return {}
+    if _PROBE_OK is False:
+        return {}
     return dict(FLAGSHIP_FLAGS)
+
+
+#: Set by generate() on accelerator backends before the studies run:
+#: False demotes every _flagship_flags() caller to the XLA path.  None
+#: (the default) trusts the flags without probing — short CLI runs
+#: surface a kernel failure through their own compile and lose seconds,
+#: not the 2 h capture the probe insures.
+_PROBE_OK: "bool | None" = None
+
+
+@functools.lru_cache(maxsize=None)
+def _flagship_probe(n: int) -> bool:
+    """One compile+run of the fused round AT THE STUDY SCALE (trials=1,
+    one round — compile-dominated, ~10-30 s on-chip) before generate()
+    commits to it: a kernel lowering regression on this chip generation
+    must demote the run to the XLA path, not kill a 2 h capture at
+    study #1.  Mirrors bench.py's demotion policy exactly: only
+    Mosaic/pallas lowering failures demote — anything else (a broken
+    probe, OOM) raises with correct attribution, because it would hit
+    the XLA path too.  Pallas failures are frequently shape-dependent
+    (tile/layout/VMEM scaling), hence probing at the real N."""
+    import jax
+
+    from .ops import sampling
+    from .sim import run_consensus
+
+    cfg = SimConfig(n_nodes=n, n_faulty=0, trials=1,
+                    delivery="quorum", scheduler="uniform",
+                    path="histogram", max_rounds=1, **FLAGSHIP_FLAGS)
+    if cfg.quorum <= sampling.EXACT_TABLE_MAX:
+        return True                 # flags are inert below the CF regime
+    faults = FaultSpec.none(1, n)
+    state = init_state(cfg, _balanced(1, n), faults)
+    try:
+        r, _ = run_consensus(cfg, state, faults, jax.random.key(0))
+        int(r)                                # force execution
+        return True
+    except Exception as e:  # noqa: BLE001 — filtered re-raise below
+        if not any(s in f"{type(e).__name__}: {e}"
+                   for s in ("Mosaic", "mosaic", "pallas", "Pallas")):
+            raise
+        print(f"  flagship pallas probe failed ({type(e).__name__}: {e}); "
+              f"studies run the XLA path", flush=True)
+        return False
 
 
 def balanced_curve(n: int, trials: int, seed: int = 0,
@@ -571,6 +618,17 @@ def generate(out_dir: str = "RESULTS", n_large: int = 1_000_000,
     out: Dict[str, object] = {"meta": meta}
 
     print(f"results: device={dev.device_kind} N={n_large}", flush=True)
+
+    # Whole-run insurance for the flagship path: probe the fused round
+    # once at the study scale; a kernel lowering regression demotes
+    # every _flagship_flags() study to the XLA path instead of killing
+    # a 2 h on-chip capture at study #1.
+    global _PROBE_OK
+    if dev.platform != "cpu":
+        _PROBE_OK = _flagship_probe(n_large)
+        meta["flagship_pallas"] = _PROBE_OK
+        print(f"  flagship pallas probe: "
+              f"{'ok' if _PROBE_OK else 'DEMOTED to XLA'}", flush=True)
 
     print("balanced rounds-vs-f curve:", flush=True)
     pts = balanced_curve(n_large, trials_large, seed)

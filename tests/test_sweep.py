@@ -429,3 +429,67 @@ class TestCli:
                      "--max-rounds", "8", "--pallas", "on"]) == 0
         out = capsys.readouterr().out
         assert "private:" in out and "common:" in out
+
+
+class TestFlagshipFlags:
+    def test_cpu_returns_empty(self, monkeypatch):
+        import jax
+
+        from benor_tpu import results
+        monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+        assert results._flagship_flags() == {}
+
+    def test_probe_outcome_gates_flags(self, monkeypatch):
+        """generate() records the probe outcome in _PROBE_OK; False must
+        demote every study's flags to the XLA path, None (no probe — the
+        CLI case) and True must return the flagship set."""
+        import jax
+
+        from benor_tpu import results
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        for ok, want in ((None, results.FLAGSHIP_FLAGS),
+                         (True, results.FLAGSHIP_FLAGS), (False, {})):
+            monkeypatch.setattr(results, "_PROBE_OK", ok)
+            assert results._flagship_flags() == want
+
+    def test_probe_demotes_only_on_kernel_errors(self, monkeypatch, capsys):
+        """Mirror of bench.py's demotion policy: a Mosaic/pallas failure
+        returns False (demote); anything else re-raises with correct
+        attribution (it would hit the XLA path too)."""
+        import benor_tpu.sim as sim
+        from benor_tpu import results
+
+        def boom_mosaic(*a, **kw):
+            raise RuntimeError("Mosaic lowering failed (simulated)")
+
+        def boom_other(*a, **kw):
+            raise RuntimeError("something unrelated")
+
+        n = 20000                      # quorum above the CF gate
+        monkeypatch.setattr(sim, "run_consensus", boom_mosaic)
+        results._flagship_probe.cache_clear()
+        try:
+            assert results._flagship_probe(n) is False
+            assert "probe failed" in capsys.readouterr().out
+            results._flagship_probe.cache_clear()
+            monkeypatch.setattr(sim, "run_consensus", boom_other)
+            with pytest.raises(RuntimeError, match="unrelated"):
+                results._flagship_probe(n)
+            # below the CF regime the flags are inert: no compile at all
+            results._flagship_probe.cache_clear()
+            assert results._flagship_probe(64) is True
+        finally:
+            results._flagship_probe.cache_clear()
+
+    @pytest.mark.slow
+    def test_probe_passes_in_interpret_mode(self):
+        """The probe itself runs the fused round (interpret mode on this
+        CPU suite) at a CF-regime N and succeeds."""
+        from benor_tpu import results
+        from benor_tpu.ops import sampling
+        results._flagship_probe.cache_clear()
+        try:
+            assert results._flagship_probe(
+                2 * sampling.EXACT_TABLE_MAX) is True
+        finally:
+            results._flagship_probe.cache_clear()
