@@ -163,6 +163,13 @@ def main() -> None:
         ("targeted", dict(scheduler="targeted"), None),
         ("fused-round", dict(use_pallas_hist=True, use_pallas_round=True),
          4),
+        # r5: the fused ADVERSARIAL round (counts_mode='delivered' — the
+        # closed-form tied tallies broadcast in-VMEM, no sampler): its
+        # per-trial histogram psum + shared-coin stream must survive the
+        # process-spanning mesh bit-for-bit
+        ("adv-fused-round", dict(scheduler="adversarial",
+                                 coin_mode="common",
+                                 use_pallas_round=True), None),
     ]
     for label, overrides, table_max in extra:
         old_tm = sampling.EXACT_TABLE_MAX
