@@ -21,13 +21,16 @@ Layout (SURVEY.md §7):
 from .api import (get_nodes_state, launch_network, reached_finality,
                   start_consensus, stop_consensus)
 from .config import BASE_NODE_PORT, SimConfig, VAL0, VAL1, VALQ
-from .state import FaultSpec, NetState, init_state, observable_state
-from .sim import run_consensus, resume_consensus, simulate, start_state
+from .state import DynParams, FaultSpec, NetState, init_state, \
+    observable_state
+from .sim import (run_consensus, run_consensus_traced, resume_consensus,
+                  simulate, start_state)
 
 __all__ = [
     "BASE_NODE_PORT", "SimConfig", "VAL0", "VAL1", "VALQ",
-    "FaultSpec", "NetState", "init_state", "observable_state",
-    "run_consensus", "resume_consensus", "simulate", "start_state",
+    "DynParams", "FaultSpec", "NetState", "init_state", "observable_state",
+    "run_consensus", "run_consensus_traced", "resume_consensus",
+    "simulate", "start_state",
     "launch_network", "start_consensus", "stop_consensus",
     "get_nodes_state", "reached_finality",
 ]

@@ -129,13 +129,22 @@ def _sweep(args) -> int:
     fb = " [cpu fallback]" if FELL_BACK else ""
     # banner reports the compute path actually taken, not the request:
     # ineligible configs (sub-CF-regime quorums, biased scheduler)
-    # silently ignore the flags
+    # silently ignore the flags.  Evaluated PER f VALUE — the pallas
+    # predicates gate on the quorum N - f, so a sweep can cross the
+    # CF-regime boundary mid-curve (larger f => smaller quorum) and a
+    # single n_faulty=0 probe would over-claim for those points.
     from .ops.tally import pallas_round_active, pallas_stream_active
-    engaged = pallas_round_active(cfg) or pallas_stream_active(cfg)
+
+    def _engaged(c):
+        return pallas_round_active(c) or pallas_stream_active(c)
+
+    eng = [_engaged(cfg.replace(n_faulty=int(f))) for f in f_values]
+    pallas_note = (", pallas" if eng and all(eng)
+                   else ", pallas (where eligible)" if any(eng) else "")
     print(f"rounds-vs-f sweep: N={args.n}, trials={args.trials}, "
           f"scheduler={args.scheduler}, coin={args.coin}, "
           f"faults={args.fault_model}, inputs={mode}"
-          f"{', pallas' if engaged else ''}{fb}")
+          f"{pallas_note}{fb}")
     if args.balanced:
         # the science regime: balanced inputs, F purely a protocol
         # parameter (crash-pinned faults make every tally the deterministic
@@ -143,7 +152,7 @@ def _sweep(args) -> int:
         # Under 'byzantine'/'equivocate' the F lanes are LIVE adversaries,
         # so they are marked (not crashed) rather than zeroed.
         from .state import FaultSpec
-        from .sweep import balanced_inputs
+        from .sweep import balanced_inputs, run_curve_batched
         bal = balanced_inputs(args.trials, args.n)
 
         def faults_for(c):
@@ -151,16 +160,24 @@ def _sweep(args) -> int:
                 return FaultSpec.first_f(c)
             return FaultSpec.none(args.trials, args.n)
 
-        points = []
-        for f in f_values:
-            cfg_f = cfg.replace(n_faulty=int(f))
-            pt = run_point(cfg_f, initial_values=bal,
-                           faults=faults_for(cfg_f))
-            points.append(pt)
-            print(f"  f={f}: mean_k={pt.mean_k:.2f} "
+        if args.batched:
+            cb = run_curve_batched(cfg, f_values, initial_values=bal,
+                                   faults_for=faults_for, verbose=True)
+            points = cb.points
+        else:
+            points = []
+            for f in f_values:
+                cfg_f = cfg.replace(n_faulty=int(f))
+                points.append(run_point(cfg_f, initial_values=bal,
+                                        faults=faults_for(cfg_f)))
+        for pt in points:
+            print(f"  f={pt.n_faulty}: mean_k={pt.mean_k:.2f} "
                   f"decided={pt.decided_frac:.3f} "
                   f"disagree={pt.disagree_frac:.3f} "
                   f"{pt.trials_per_sec:.1f} trials/s", flush=True)
+    elif args.batched:
+        from .sweep import rounds_vs_f_batched
+        points = rounds_vs_f_batched(cfg, f_values)
     else:
         points = rounds_vs_f(cfg, f_values)
     if args.out:
@@ -260,6 +277,11 @@ def main(argv=None) -> int:
                    help="balanced inputs + zero crashes (the multi-round "
                         "science regime; default is the reference-style "
                         "iid-inputs/crash-faults workload)")
+    s.add_argument("--batched", action="store_true",
+                   help="run the curve through the batched dynamic-F "
+                        "engine: one XLA compile per static-shape bucket "
+                        "instead of one per f value (bit-identical "
+                        "summaries; see sweep.run_curve_batched)")
     s.add_argument("--out", help="write points to this JSON file")
 
     c = sub.add_parser("coins", help="private vs common coin, adversarial")

@@ -18,7 +18,7 @@ control flow, fuses into a handful of XLA kernels per round.
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -26,7 +26,7 @@ import jax.numpy as jnp
 from ..config import SimConfig, VAL0, VAL1, VALQ
 from ..ops import rng, tally
 from ..ops.collectives import SINGLE, ShardCtx
-from ..state import FaultSpec, NetState
+from ..state import DynParams, FaultSpec, NetState
 
 
 def _flip(x: jax.Array) -> jax.Array:
@@ -44,7 +44,8 @@ def _sent_values(cfg: SimConfig, x: jax.Array, faults: FaultSpec) -> jax.Array:
 
 def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
                 base_key: jax.Array, r: jax.Array,
-                ctx: ShardCtx = SINGLE) -> NetState:
+                ctx: ShardCtx = SINGLE,
+                dyn: Optional[DynParams] = None) -> NetState:
     """Advance every lane by one full Ben-Or round (proposal + vote phase).
 
     ``r`` is the 1-based round index; matches the reference's message ``k``.
@@ -52,12 +53,27 @@ def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
     blocks and ``ctx`` names the mesh axes; tallies psum over ICI and RNG
     keys derive from global ids, so results are bit-identical to the
     single-device run regardless of mesh shape.
+
+    ``dyn`` (DynParams or None) supplies F and the quorum as TRACED
+    scalars for the batched dynamic-F sweep (sweep.run_curve_batched):
+    with it, one compiled round loop serves every fault count whose
+    static shape/mode matches ``cfg`` — the decide thresholds, quorum
+    gate, closed-form adversaries and CF samplers all take the traced
+    values.  ``dyn=None`` (every classic caller) is the unchanged static
+    path, bit-for-bit.  Quorum-specialized regimes (exact-table sampler,
+    dense top-k masks, pallas kernels — sweep.quorum_specialized) must
+    pass dyn=None.
     """
     T, N = state.x.shape
-    F = cfg.n_faulty
-    m = cfg.quorum
+    F = cfg.n_faulty if dyn is None else dyn.n_faulty
+    m = cfg.quorum if dyn is None else dyn.quorum
 
     if tally.pallas_round_active(cfg):
+        if dyn is not None:
+            raise ValueError(
+                "dynamic-F tracing cannot drive the fused pallas round "
+                "(kernels bake the quorum into their closures); bucket "
+                "such configs statically (sweep.quorum_specialized)")
         # Fully-fused round (r3 VERDICT item 2): BOTH phases run as pallas
         # kernels over the packed per-lane state word
         # (ops/pallas_round.py) with the decide/adopt/coin/commit chain
@@ -116,7 +132,7 @@ def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
     sent1 = _sent_values(cfg, state.x, faults)
     cnt1 = tally.receiver_counts(cfg, base_key, r, rng.PHASE_PROPOSAL,
                                  sent1, alive, ctx, alive_g,
-                                 equiv, equiv_g, n_equiv)     # [T, N, 3]
+                                 equiv, equiv_g, n_equiv, dyn)  # [T, N, 3]
     p0, p1 = cnt1[..., 0], cnt1[..., 1]
     # majority -> value, tie -> "?" (node.ts:63-69)
     x1 = jnp.where(p0 > p1, jnp.int8(VAL0),
@@ -131,7 +147,7 @@ def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
     sent2 = _sent_values(cfg, vote_val, faults)
     cnt2 = tally.receiver_counts(cfg, base_key, r, rng.PHASE_VOTE,
                                  sent2, alive, ctx, alive_g,
-                                 equiv, equiv_g, n_equiv)
+                                 equiv, equiv_g, n_equiv, dyn)
     v0, v1 = cnt2[..., 0], cnt2[..., 1]
 
     decide0 = v0 > F                                         # node.ts:99

@@ -172,9 +172,13 @@ def _camp_select(scal_ref, shape, camp_b0, camp_b1, vecs):
     """counts_mode='camps': pick each lane's camp triple by GLOBAL lane id
     (targeted adversary camp layout — value camps at the top of the id
     range, tally.targeted_counts).  ``vecs`` = six [T, 1] refs, the
-    (h0, h1) pair per camp in (0-camp, 1-camp, "?"-camp) order; pad lanes
-    land in camp 1 (ids past N), harmlessly — they are killed, so neither
-    their commit nor the histogram partials see them."""
+    (h0, h1) pair per camp in (0-camp, 1-camp, "?"-camp) order.  Pad
+    lanes may select ANY camp — on a node-sharded mesh a non-final
+    shard's pad ids overlap the next shard's real range, so no camp
+    assignment can be promised for them; the invariant that matters is
+    the killed-bit exclusion: pad lanes carry the killed bit, so neither
+    their commit nor the histogram partials ever see them, whichever
+    camp triple they happened to read."""
     c0h0, c0h1, c1h0, c1h1, qh0, qh1 = [v[...] for v in vecs]
     node, _ = _lane_ids(scal_ref, shape)
     in1 = node >= jnp.uint32(camp_b1)

@@ -35,12 +35,26 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.scipy.special import gammaln
 
 #: Largest quorum for which h0 uses the exact shared-CDF inverse sampler.
 #: Above this the table search's gather cost dominates the whole round and
 #: the normal limit is accurate to well under one standard deviation.
 EXACT_TABLE_MAX = 4096
+
+
+def static_m(m) -> int | None:
+    """The Python value of a draw count, or None when it is traced.
+
+    The batched dynamic-F sweep (sweep.run_curve_batched) threads the
+    quorum through these samplers as a TRACED int32 scalar; the exact
+    shared-CDF samplers build [T, m+1] tables and therefore need a static
+    m — callers use this to pick the CF branch under tracing.  The
+    engine's bucketing (sweep.quorum_specialized) guarantees a traced m
+    only ever reaches the CF regime, so the branch choice is unchanged.
+    """
+    return int(m) if isinstance(m, (int, np.integer)) else None
 
 
 def _log_comb(n, k):
@@ -143,7 +157,7 @@ def uniform_race_favored_count(u: jax.Array, nf: jax.Array, ns: jax.Array,
     """
     nf_f = nf.astype(jnp.float32)
     ns_f = ns.astype(jnp.float32)
-    m_f = jnp.float32(m)
+    m_f = jnp.asarray(m, jnp.float32)     # accepts a traced quorum too
     safe_nf = jnp.maximum(nf_f, 1e-6)
     safe_ns = jnp.maximum(ns_f, 1e-6)
     # threshold regimes (each guard also keeps the previous regime's tau)
@@ -245,19 +259,20 @@ def equivocate_hypergeom_counts(u_b: jax.Array, u0: jax.Array, u1: jax.Array,
     uniform-path sampler).  Statistically matched against the dense
     per-edge-bit path by tests/test_equivocate.py.
     """
+    ms = static_m(m)               # None = traced quorum (CF regime only)
     c0 = honest_counts[:, 0]
     c1 = honest_counts[:, 1]
     total_h = honest_counts.sum(axis=-1)                    # [T]
     total = total_h + n_equiv
-    if m <= EXACT_TABLE_MAX:
-        h_b = hypergeom_exact_shared(u_b, total, n_equiv, m)
+    if ms is not None and ms <= EXACT_TABLE_MAX:
+        h_b = hypergeom_exact_shared(u_b, total, n_equiv, ms)
     else:
         h_b = hypergeom_normal_approx(
             u_b, jnp.broadcast_to(total[:, None], u_b.shape),
             jnp.broadcast_to(n_equiv[:, None], u_b.shape),
             jnp.full(u_b.shape, m, jnp.int32), skew_correct=True)
     rem = jnp.maximum(m - h_b, 0)                           # honest draws
-    skew = m > EXACT_TABLE_MAX
+    skew = ms is None or ms > EXACT_TABLE_MAX
     h0 = hypergeom_normal_approx(
         u0, jnp.broadcast_to(total_h[:, None], u0.shape),
         jnp.broadcast_to(c0[:, None], u0.shape), rem, skew_correct=skew)
@@ -278,11 +293,12 @@ def multivariate_hypergeom_counts(u0: jax.Array, u1: jax.Array,
     m: static quorum size (N - F).  Returns int32 [T, N, 3] with rows summing
     to m (clamped into the feasible region).
     """
+    ms = static_m(m)               # None = traced quorum (CF regime only)
     c0 = class_counts[:, 0]
     c1 = class_counts[:, 1]
     total = class_counts.sum(axis=-1)                       # [T]
-    if m <= EXACT_TABLE_MAX:
-        h0 = hypergeom_exact_shared(u0, total, c0, m)       # [T, N] exact
+    if ms is not None and ms <= EXACT_TABLE_MAX:
+        h0 = hypergeom_exact_shared(u0, total, c0, ms)      # [T, N] exact
     else:
         h0 = hypergeom_normal_approx(
             u0, jnp.broadcast_to(total[:, None], u0.shape),
@@ -291,6 +307,7 @@ def multivariate_hypergeom_counts(u0: jax.Array, u1: jax.Array,
     rem_total = jnp.maximum(total[:, None] - c0[:, None], 0)
     rem_draw = jnp.maximum(m - h0, 0)
     h1 = hypergeom_normal_approx(u1, rem_total, c1[:, None], rem_draw,
-                                 skew_correct=(m > EXACT_TABLE_MAX))
+                                 skew_correct=(ms is None
+                                               or ms > EXACT_TABLE_MAX))
     hq = jnp.maximum(m - h0 - h1, 0)
     return jnp.stack([h0, h1, hq], axis=-1)

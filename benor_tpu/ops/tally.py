@@ -137,7 +137,8 @@ def receiver_counts(cfg: SimConfig, base_key: jax.Array, r: jax.Array,
                     alive_g: jax.Array | None = None,
                     equiv: jax.Array | None = None,
                     equiv_g: jax.Array | None = None,
-                    n_equiv: jax.Array | None = None) -> jax.Array:
+                    n_equiv: jax.Array | None = None,
+                    dyn=None) -> jax.Array:
     """Dispatch: per-receiver tallied class counts int32 [T, N, 3].
 
     This is the TPU-native replacement for the whole HTTP message plane
@@ -153,10 +154,19 @@ def receiver_counts(cfg: SimConfig, base_key: jax.Array, r: jax.Array,
     chooses (scheduler='adversarial').  ``equiv_g`` (dense path) and
     ``n_equiv`` (its global count, [T]) are round-constant — callers hoist
     them like alive_g so the psum runs once per round, not per phase.
+
+    ``dyn`` (state.DynParams or None): traced F/quorum for the batched
+    dynamic-F sweep.  The quorum flows into the closed-form adversaries
+    and the CF samplers as a traced scalar; branch DISPATCH stays keyed
+    on the static ``cfg`` (every point sharing a compiled bucket agrees
+    on it — sweep.quorum_specialized guarantees that).  Paths whose
+    compiled shape specializes on the quorum (dense top-k masks, exact
+    shared-CDF tables, pallas kernels) reject dyn.
     """
     T, N = sent.shape
     trial_ids = ctx.trial_ids(T)
     node_ids = ctx.node_ids(N)
+    m = cfg.quorum if dyn is None else dyn.quorum
 
     honest = alive if equiv is None else (alive & ~equiv)
     if equiv is not None and n_equiv is None:
@@ -194,7 +204,7 @@ def receiver_counts(cfg: SimConfig, base_key: jax.Array, r: jax.Array,
     # it chooses their per-receiver values outright (full Byzantine power).
     if cfg.scheduler == "adversarial":
         hist = class_histogram(sent, honest, ctx)
-        counts = adversarial_counts(hist, cfg.quorum, n_free=n_equiv)
+        counts = adversarial_counts(hist, m, n_free=n_equiv)
         return jnp.broadcast_to(counts[:, None, :], (T, N, 3))
 
     # Partitioned count-controlling adversary (agreement attack): closed
@@ -205,9 +215,14 @@ def receiver_counts(cfg: SimConfig, base_key: jax.Array, r: jax.Array,
     # tests/test_targeted.py pins dense_counts(mask) == this closed form.
     if cfg.scheduler == "targeted":
         hist = class_histogram(sent, honest, ctx)
-        return targeted_counts(cfg, hist, node_ids, n_free=n_equiv)
+        return targeted_counts(cfg, hist, node_ids, n_free=n_equiv, dyn=dyn)
 
     if cfg.resolved_path == "dense":
+        if dyn is not None:
+            raise ValueError(
+                "dynamic-F tracing cannot drive the dense delivery mask "
+                "(top-k specializes its shape on the quorum); bucket "
+                "dense-path configs statically (sweep.quorum_specialized)")
         # Dense path on a node-sharded mesh: receivers stay local, the
         # sender axis is all-gathered. ``alive`` doesn't change within a
         # round, so callers gather it once and pass it for both phases.
@@ -245,6 +260,11 @@ def receiver_counts(cfg: SimConfig, base_key: jax.Array, r: jax.Array,
 
     # histogram path
     hist = class_histogram(sent, honest, ctx)
+    if dyn is not None and pallas_stream_active(cfg):
+        raise ValueError(
+            "dynamic-F tracing cannot drive the fused pallas samplers "
+            "(the quorum is baked into the kernel closures); bucket such "
+            "configs statically (sweep.quorum_specialized)")
     if equiv is not None:
         if pallas_equiv_active(cfg):
             # fused mixed-population kernel (two threefry blocks -> four
@@ -262,7 +282,7 @@ def receiver_counts(cfg: SimConfig, base_key: jax.Array, r: jax.Array,
         u1 = rng.grid_uniforms(base_key, r, phase + 16, trial_ids, node_ids)
         u_s = rng.grid_uniforms(base_key, r, phase + 48, trial_ids, node_ids)
         return sampling.equivocate_hypergeom_counts(
-            u_b, u0, u1, u_s, hist, n_equiv, cfg.quorum)
+            u_b, u0, u1, u_s, hist, n_equiv, m)
     if pallas_hist_active(cfg):
         # Fused pallas sampler (the flagship-path kernel): bits + quantile +
         # CF draws in one VMEM pass.  Own stream keyed on base_key (NOT
@@ -281,12 +301,12 @@ def receiver_counts(cfg: SimConfig, base_key: jax.Array, r: jax.Array,
     u1 = rng.grid_uniforms(base_key, r, phase + 16, trial_ids, node_ids)
     if cfg.scheduler == "biased":
         if cfg.adversary_strength >= 1.0:
-            return biased_priority_counts(u0, hist, cfg.quorum, node_ids)
+            return biased_priority_counts(u0, hist, m, node_ids)
         if cfg.adversary_strength > 0.0:
             return biased_fractional_counts(
-                cfg.adversary_strength, u0, u1, hist, cfg.quorum, node_ids)
+                cfg.adversary_strength, u0, u1, hist, m, node_ids)
         # strength 0: the dense scheduler adds no delay — plain uniform
-    return sampling.multivariate_hypergeom_counts(u0, u1, hist, cfg.quorum)
+    return sampling.multivariate_hypergeom_counts(u0, u1, hist, m)
 
 
 def biased_priority_counts(u0: jax.Array, hist: jax.Array,
@@ -309,6 +329,7 @@ def biased_priority_counts(u0: jax.Array, hist: jax.Array,
     node_ids: global receiver ids [N] (parity decides the starved class).
     Returns int32 [T, N, 3] summing to m.
     """
+    ms = sampling.static_m(m)      # None = traced quorum (CF regime only)
     c0, c1, cq = hist[:, 0:1], hist[:, 1:2], hist[:, 2:3]   # [T, 1]
     even = (node_ids % 2 == 0)[None, :]                     # [1, N]
     starved_c = jnp.where(even, c1, c0)                     # [T, N]
@@ -321,11 +342,13 @@ def biased_priority_counts(u0: jax.Array, hist: jax.Array,
     # unbiased split of n_fav between the favored value-class and "?"
     h_favval = sampling.hypergeom_normal_approx(
         u0, fav_total, fav_val, n_fav,
-        skew_correct=(m > sampling.EXACT_TABLE_MAX))
+        skew_correct=(ms is None or ms > sampling.EXACT_TABLE_MAX))
     # exact regime: replace the approx with the shared-table sampler when
     # parameters are trial-global (they are: fav_total/fav_val depend only
-    # on (trial, parity)); two parity classes -> two exact tables.
-    if m <= sampling.EXACT_TABLE_MAX:
+    # on (trial, parity)); two parity classes -> two exact tables.  A
+    # traced m skips it — the [T, m+1] table shape needs a static m, and
+    # the dynamic-F engine only routes CF-regime quorums here.
+    if ms is not None and ms <= sampling.EXACT_TABLE_MAX:
         h_even = sampling.hypergeom_exact_shared(
             u0, (c0 + cq)[:, 0], c0[:, 0], m)   # capped below
         h_odd = sampling.hypergeom_exact_shared(
@@ -386,8 +409,18 @@ def targeted_camp_sizes(cfg: SimConfig) -> tuple:
     return max(cfg.n_faulty + 1 - free_static, 1), free_static
 
 
+def targeted_camp_sizes_dyn(cfg: SimConfig, dyn) -> jax.Array:
+    """Traced counterpart of ``targeted_camp_sizes``'s first element for
+    the dynamic-F sweep: the per-value-camp receiver count as an int32
+    scalar computed from ``dyn.n_faulty`` (same formula, jnp arithmetic —
+    the adversary's camp layout moves with the traced F)."""
+    free = dyn.n_faulty if cfg.fault_model == "equivocate" else jnp.int32(0)
+    return jnp.maximum(dyn.n_faulty + 1 - free, 1)
+
+
 def targeted_counts(cfg: SimConfig, hist: jax.Array, node_ids: jax.Array,
-                    n_free: jax.Array | None = None) -> jax.Array:
+                    n_free: jax.Array | None = None,
+                    dyn=None) -> jax.Array:
     """Partitioned count-controlling adversary: attack AGREEMENT directly.
 
     Where ``adversarial_counts`` ties every receiver identically (attacking
@@ -436,8 +469,10 @@ def targeted_counts(cfg: SimConfig, hist: jax.Array, node_ids: jax.Array,
     population covers the quorum.  Realizable as an explicit delivery
     schedule: scheduler.realize_counts_mask + tests/test_targeted.py.
     """
-    trip = targeted_camp_triples(cfg, hist, n_free=n_free)  # [T, 3, 3]
-    size_v, _ = targeted_camp_sizes(cfg)
+    trip = targeted_camp_triples(cfg, hist, n_free=n_free,
+                                 dyn=dyn)                   # [T, 3, 3]
+    size_v = (targeted_camp_sizes(cfg)[0] if dyn is None
+              else targeted_camp_sizes_dyn(cfg, dyn))
     camp1 = node_ids >= cfg.n_nodes - size_v                # [N]
     camp0 = (node_ids >= cfg.n_nodes - 2 * size_v) & ~camp1
     idx = jnp.where(camp1, 1, jnp.where(camp0, 0, 2))       # [N]
@@ -445,7 +480,8 @@ def targeted_counts(cfg: SimConfig, hist: jax.Array, node_ids: jax.Array,
 
 
 def targeted_camp_triples(cfg: SimConfig, hist: jax.Array,
-                          n_free: jax.Array | None = None) -> jax.Array:
+                          n_free: jax.Array | None = None,
+                          dyn=None) -> jax.Array:
     """The targeted adversary's three camp multisets as per-TRIAL scalars:
     int32 [T, 3 camps, 3 classes], camps ordered (0-camp, 1-camp, "?"-camp).
 
@@ -455,7 +491,7 @@ def targeted_camp_triples(cfg: SimConfig, hist: jax.Array,
     global lane id instead of ever materializing per-lane counts
     (ops/pallas_round.py counts_mode='camps').
     """
-    m = cfg.quorum
+    m = cfg.quorum if dyn is None else dyn.quorum
     c0, c1, cq = hist[:, 0], hist[:, 1], hist[:, 2]         # [T]
     free = jnp.zeros_like(c0) if n_free is None else n_free
 

@@ -24,7 +24,21 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+try:                                  # jax >= 0.5 exports it at top level
+    from jax import shard_map as _shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _shard_map  # 0.4.x
+
+import inspect as _inspect
+
+if "check_vma" in _inspect.signature(_shard_map).parameters:
+    shard_map = _shard_map
+else:
+    def shard_map(*args, check_vma=None, **kwargs):
+        """0.4.x compat: the replication check is spelled check_rep there."""
+        if check_vma is not None:
+            kwargs["check_rep"] = check_vma
+        return _shard_map(*args, **kwargs)
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..config import SimConfig

@@ -30,9 +30,10 @@ def start_state(cfg: SimConfig, state: NetState) -> NetState:
     return NetState(x=state.x, decided=state.decided, k=k, killed=state.killed)
 
 
-def _run_body(cfg: SimConfig, faults: FaultSpec, base_key: jax.Array, carry):
+def _run_body(cfg: SimConfig, faults: FaultSpec, base_key: jax.Array, carry,
+              dyn=None):
     r, state = carry
-    state = benor_round(cfg, state, faults, base_key, r)
+    state = benor_round(cfg, state, faults, base_key, r, dyn=dyn)
     if cfg.debug:  # per-round host callback (SURVEY §5.1); zero cost if off
         from .utils.tracing import emit_round_event
         emit_round_event(state)
@@ -62,11 +63,37 @@ def run_consensus(cfg: SimConfig, state: NetState, faults: FaultSpec,
     if pallas_round_active(cfg) and not cfg.debug:
         from .ops.pallas_round import run_packed
         return run_packed(cfg, state, faults, base_key)
+    return run_consensus_traced(cfg, state, faults, base_key, None)
+
+
+def run_consensus_traced(cfg: SimConfig, state: NetState, faults: FaultSpec,
+                         base_key: jax.Array,
+                         dyn=None) -> Tuple[jax.Array, NetState]:
+    """The round loop as a plain traceable function with a DYNAMIC fault
+    parameter — the building block of the batched dynamic-F sweep engine
+    (sweep.run_curve_batched), which vmaps it over a [B] batch of
+    per-point (state, faults, dyn) triples inside ONE jit so an entire
+    rounds-vs-f curve costs one XLA compile.
+
+    ``dyn`` (state.DynParams or None) carries F/quorum as traced scalars;
+    ``cfg`` keeps every static shape/mode decision and must agree with
+    dyn's values on all of them (sweep.quorum_specialized defines when it
+    can't — exact-table, dense and pallas regimes reject tracing).  With
+    dyn=None this IS run_consensus's XLA loop, bit-for-bit.  Not jitted:
+    callers embed it in their own jit (run_consensus above, or the
+    batched engine's bucket executable).
+    """
+    from .ops.tally import pallas_round_active
+
+    if dyn is not None and pallas_round_active(cfg):
+        raise ValueError(
+            "dynamic-F tracing cannot drive the fused pallas round; "
+            "bucket such configs statically (sweep.quorum_specialized)")
     state = start_state(cfg, state)
     carry = (jnp.int32(1), state)
     r, state = jax.lax.while_loop(
         functools.partial(_run_cond, cfg),
-        functools.partial(_run_body, cfg, faults, base_key),
+        functools.partial(_run_body, cfg, faults, base_key, dyn=dyn),
         carry)
     return r - 1, state
 

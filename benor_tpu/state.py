@@ -46,6 +46,46 @@ class NetState:
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
+class DynParams:
+    """TRACED dynamic protocol parameters — the f-axis of a batched sweep.
+
+    ``SimConfig`` is a static (hashable) jit argument, so every distinct
+    ``n_faulty`` historically cost a full XLA recompile of the round loop
+    — ~8-40 s per sweep point under remote-accelerator compiles
+    (utils/cache.py) for a curve whose points differ only in two scalars.
+    DynParams is the dynamic half of that split: the protocol fault
+    parameter F and the quorum N - F as int32 device scalars, threaded
+    through the round kernel (models/benor.py), the tally dispatch and
+    closed-form adversaries (ops/tally.py) and the Cornish-Fisher
+    samplers (ops/sampling.py) so one compiled executable serves every f
+    on the curve (sweep.run_curve_batched vmaps over a [B] batch of
+    these).
+
+    Only valid where the compiled code does NOT specialize shapes or
+    kernels on the quorum — no exact shared-CDF tables ([T, m+1]), no
+    dense top-k delivery masks, no pallas kernels (m is baked into their
+    closures).  sweep.quorum_specialized is the single predicate deciding
+    that; configs it flags keep the classic static path (dyn=None).
+    """
+
+    n_faulty: jax.Array  # int32 [] — F, the protocol fault parameter
+    quorum: jax.Array    # int32 [] — N - F (node.ts:52,88)
+
+    @classmethod
+    def from_config(cls, cfg: SimConfig) -> "DynParams":
+        return cls(n_faulty=jnp.int32(cfg.n_faulty),
+                   quorum=jnp.int32(cfg.quorum))
+
+    @classmethod
+    def stack(cls, cfgs) -> "DynParams":
+        """[B]-batched params from per-point configs (the vmap input)."""
+        f = np.asarray([c.n_faulty for c in cfgs], np.int32)
+        m = np.asarray([c.quorum for c in cfgs], np.int32)
+        return cls(n_faulty=jnp.asarray(f), quorum=jnp.asarray(m))
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
 class FaultSpec:
     """Fault-injection masks (SURVEY.md N5).
 

@@ -26,8 +26,9 @@ import numpy as np
 
 from .config import SimConfig, VAL0, VAL1, VALQ
 from .models.benor import benor_round
-from .sim import run_consensus, start_state
-from .state import FaultSpec, NetState, init_state
+from .ops import sampling, tally
+from .sim import run_consensus, run_consensus_traced, start_state
+from .state import DynParams, FaultSpec, NetState, init_state
 
 
 @dataclasses.dataclass
@@ -196,6 +197,284 @@ def rounds_vs_f(base_cfg: SimConfig, f_values: Sequence[int],
                   f"decided={pt.decided_frac:.3f} "
                   f"{pt.trials_per_sec:.1f} trials/s", flush=True)
     return points
+
+
+# --------------------------------------------------------------------------
+# Batched dynamic-F sweep engine: one compiled executable per static-shape
+# BUCKET instead of one per curve point.
+#
+# SimConfig is a static jit argument, so the classic per-point path
+# (run_point / rounds_vs_f above) recompiles the whole round loop for every
+# n_faulty value — the round-5 bench spent 43 s compiling vs 2.6 s
+# simulating (BENCH_r05.json), and each remote-accelerator compile costs
+# 8-40 s (utils/cache.py).  Here the f-axis is TRACED: n_faulty/quorum ride
+# a DynParams pytree through the round kernel and samplers
+# (sim.run_consensus_traced), the whole curve is vmapped over a [B] batch
+# of per-point (state, faults, dyn) triples inside ONE buffer-donated jit,
+# and per-f summaries reduce on device inside the same executable.
+#
+# Points whose compiled code genuinely specializes on the quorum — exact
+# shared-CDF tables ([T, m+1] shapes at quorum <= sampling.EXACT_TABLE_MAX),
+# dense top-k delivery masks, pallas kernels (m baked into closures) — are
+# grouped into their own static buckets and run the classic path, pallas
+# fast path preserved.  The per-point path stays as the parity oracle:
+# batched summaries are BIT-IDENTICAL to it (tests/test_batched_sweep.py).
+# --------------------------------------------------------------------------
+
+
+def quorum_specialized(cfg: SimConfig) -> bool:
+    """True iff this config's compiled code specializes shapes or kernels
+    on n_faulty — such points cannot share a dynamic-F executable and get
+    a static bucket each.  The single source of truth for the batched
+    engine's bucketing (state.DynParams documents the constraint)."""
+    if tally.pallas_stream_active(cfg) or tally.pallas_round_active(cfg):
+        return True                 # kernels bake m/F into their closures
+    if (cfg.delivery == "quorum" and cfg.resolved_path == "dense"
+            and cfg.scheduler not in ("adversarial", "targeted")):
+        return True                 # top-k delivery mask: static m shape
+    if (cfg.delivery == "quorum" and cfg.resolved_path == "histogram"
+            and cfg.scheduler in ("uniform", "biased")
+            and cfg.quorum <= sampling.EXACT_TABLE_MAX):
+        return True                 # exact shared-CDF table: [T, m+1]
+    if (cfg.fault_model == "equivocate" and cfg.delivery == "all"
+            and cfg.n_faulty <= sampling.EXACT_TABLE_MAX):
+        return True                 # exact binomial table: [T, F+1]
+    return False
+
+
+def sweep_bucket_key(cfg: SimConfig):
+    """Hashable bucket token: two sweep points share one compiled batched
+    executable iff their keys are equal.  Quorum-specialized points key on
+    the full config (a bucket of one); everything else keys on the config
+    with the f-axis erased."""
+    if quorum_specialized(cfg):
+        return ("static", cfg)
+    return ("dyn", cfg.replace(n_faulty=0))
+
+
+@dataclasses.dataclass
+class BatchedCurve:
+    """A batched curve run plus its compile-accounting evidence."""
+
+    points: List[SweepPoint]        # input order, same fields as run_point
+    n_buckets: int
+    bucket_sizes: List[int]         # per bucket, executable-build order
+    compile_count: int              # XLA backend compiles observed
+    compile_s: float                # wall-clock building the executables
+    run_s: float                    # wall-clock executing them (post-compile)
+
+
+def _summarize_inline(cfg: SimConfig, r, final: NetState, faults: FaultSpec):
+    """(rounds, decided, mean_k, ones, k_hist, disagree) for one point —
+    the same ``summarize_final`` reduction, fused INSIDE the bucket
+    executable so the whole batched sweep is one device dispatch."""
+    dec, mk, ones, khist, dis = summarize_final(
+        final, faults.faulty, cfg.max_rounds)
+    return r, dec, mk, ones, khist, dis
+
+
+def _stack_tree(items):
+    return jax.tree.map(lambda *leaves: jnp.stack(leaves), *items)
+
+
+def run_curve_batched(base_cfg: SimConfig, f_values: Sequence[int],
+                      initial_values=None, faults_for=None,
+                      verbose: bool = False) -> BatchedCurve:
+    """Run a rounds-vs-f curve with one XLA compile per static-shape bucket.
+
+    Semantics match the per-point loop exactly — same inputs, same
+    random streams, bit-identical per-f summaries:
+
+      * ``initial_values`` defaults to ``random_inputs(seed, T, N)``
+        (run_point's default, shared by every point);
+      * ``faults_for(cfg_f) -> FaultSpec`` builds each point's fault spec
+        (default: run_point's first-F-lanes-faulty crash mask);
+      * every point runs from ``jax.random.key(base_cfg.seed)``.
+
+    Dynamic buckets vmap ``run_consensus_traced`` over the stacked batch
+    with the state/fault buffers DONATED to the executable (the [B, T, N]
+    carry is the sweep's whole memory footprint — donation lets XLA alias
+    it instead of holding input and carry live together).  Static buckets
+    (quorum_specialized) run the classic dispatch — pallas fast path
+    preserved — also as one fused run+summarize executable.
+
+    Compile accounting: every invocation AOT-compiles each bucket
+    executable (``jit(...).lower(...).compile()``) inside a
+    ``count_backend_compiles`` scope, so ``compile_count`` is measured by
+    the jax.monitoring hook, not inferred — exactly ``n_buckets`` unless
+    XLA recompiled something behind our back (the property
+    tests/test_batched_sweep.py pins).
+
+    Timing fields on the returned points: ``seconds`` is the point's
+    amortized share of its bucket's post-compile execution wall-clock
+    (bucket run time / bucket size).
+    """
+    import warnings
+
+    from .utils.compile_counter import count_backend_compiles
+
+    T, N = base_cfg.trials, base_cfg.n_nodes
+    if initial_values is None:
+        initial_values = random_inputs(base_cfg.seed, T, N)
+
+    def default_faults(cfg_f: SimConfig) -> FaultSpec:
+        fl = np.zeros(cfg_f.n_nodes, bool)
+        fl[:cfg_f.n_faulty] = True
+        return FaultSpec.from_faulty_list(cfg_f, fl)
+
+    faults_fn = faults_for if faults_for is not None else default_faults
+
+    # ---- prepare (host side): bucket the points, build + stack inputs ----
+    cfgs = [base_cfg.replace(n_faulty=int(f)) for f in f_values]
+    buckets: Dict = {}
+    order: List = []
+    for i, cfg_f in enumerate(cfgs):
+        key = sweep_bucket_key(cfg_f)
+        if key not in buckets:
+            buckets[key] = {"idx": [], "cfgs": []}
+            order.append(key)
+        buckets[key]["idx"].append(i)
+        buckets[key]["cfgs"].append(cfg_f)
+    for key in order:
+        b = buckets[key]
+        faults = [faults_fn(c) for c in b["cfgs"]]
+        states = [init_state(c, initial_values, fl)
+                  for c, fl in zip(b["cfgs"], faults)]
+        if key[0] == "dyn":
+            b["states"] = _stack_tree(states)
+            b["faults"] = _stack_tree(faults)
+            b["dyn"] = DynParams.stack(b["cfgs"])
+        else:
+            # init_state aliases killed to faults.faulty under the crash
+            # model; the donated state must not share a buffer with the
+            # undonated faults argument ("donated buffer used twice")
+            st = states[0]
+            b["states"] = NetState(x=st.x, decided=st.decided, k=st.k,
+                                   killed=jnp.array(st.killed))
+            b["faults"] = faults[0]
+    base_key = jax.random.key(base_cfg.seed)
+
+    # ---- compile + run: ONE executable per bucket ------------------------
+    raw = [None] * len(cfgs)
+    secs = [0.0] * len(cfgs)       # per-point amortized bucket run time
+    compile_s = run_s = 0.0
+    bucket_sizes = []
+    with count_backend_compiles() as counter:
+        for key in order:
+            b = buckets[key]
+            rep = b["cfgs"][0]
+            bucket_sizes.append(len(b["idx"]))
+            # The executable returns the final states TOO (last position):
+            # the loop carry is the sweep's whole memory footprint, and
+            # donating the input states lets XLA alias them onto those
+            # state outputs — the carry lives in the donated buffers
+            # instead of input + carry both being live.  The states are
+            # never fetched; only the six summary outputs cross the wire.
+            if key[0] == "dyn":
+                def runner(states, faults, dyn, bk, _cfg=rep):
+                    def one(s, fl, d):
+                        r, fin = run_consensus_traced(_cfg, s, fl, bk, d)
+                        return _summarize_inline(_cfg, r, fin, fl) + (fin,)
+                    return jax.vmap(one, in_axes=(0, 0, 0))(
+                        states, faults, dyn)
+                args = (b["states"], b["faults"], b["dyn"], base_key)
+            else:
+                def runner(state, faults, bk, _cfg=rep):
+                    r, fin = run_consensus(_cfg, state, faults, bk)
+                    return _summarize_inline(_cfg, r, fin, faults) + (fin,)
+                args = (b["states"], b["faults"], base_key)
+            t0 = time.perf_counter()
+            with warnings.catch_warnings():
+                # backends without donation support (XLA:CPU) warn that
+                # the donated buffers went unused; that's the expected
+                # platform gap, not a bug in the sweep
+                warnings.filterwarnings(
+                    "ignore", message=".*donated buffers were not usable.*")
+                compiled = jax.jit(runner, donate_argnums=(0,)) \
+                    .lower(*args).compile()
+            compile_s += time.perf_counter() - t0
+            t0 = time.perf_counter()
+            *summ, _fin = compiled(*args)
+            out = [np.asarray(o) for o in summ]             # fetch = barrier
+            bucket_run = time.perf_counter() - t0
+            run_s += bucket_run
+            del _fin               # device-resident final states: not needed
+            for j, i in enumerate(b["idx"]):
+                raw[i] = ([o[j] for o in out] if key[0] == "dyn"
+                          else [o for o in out])
+                secs[i] = bucket_run / len(b["idx"])
+    del buckets  # the donated input buffers are dead; drop the refs
+
+    points = _assemble_points(cfgs, raw, secs)
+    cb = BatchedCurve(points=points, n_buckets=len(order),
+                      bucket_sizes=bucket_sizes,
+                      compile_count=counter.count,
+                      compile_s=compile_s, run_s=run_s)
+    if verbose:
+        print(f"  batched curve: {len(cfgs)} points / {cb.n_buckets} "
+              f"bucket(s), {cb.compile_count} compiles "
+              f"({cb.compile_s:.1f}s), run {cb.run_s:.2f}s", flush=True)
+    return cb
+
+
+def _assemble_points(cfgs, raw, secs) -> List[SweepPoint]:
+    points = []
+    for cfg_f, vals, s in zip(cfgs, raw, secs):
+        r, dec, mk, ones, khist, dis = vals
+        points.append(SweepPoint(
+            n_nodes=cfg_f.n_nodes, n_faulty=cfg_f.n_faulty,
+            trials=cfg_f.trials, coin_mode=cfg_f.coin_mode,
+            scheduler=cfg_f.scheduler, rounds_executed=int(r),
+            decided_frac=float(dec), mean_k=float(mk),
+            k_hist=np.asarray(khist).astype(np.int64),
+            ones_frac=float(ones), seconds=s,
+            trials_per_sec=(cfg_f.trials / s if s > 0 else float("inf")),
+            disagree_frac=float(dis)))
+    return points
+
+
+def rounds_vs_f_batched(base_cfg: SimConfig, f_values: Sequence[int],
+                        verbose: bool = True) -> List[SweepPoint]:
+    """The north-star curve via the batched engine — same defaults and
+    bit-identical summaries as ``rounds_vs_f``, O(buckets) compiles
+    instead of O(points)."""
+    cb = run_curve_batched(base_cfg, f_values, verbose=verbose)
+    if verbose:
+        for pt in cb.points:
+            print(f"  f={pt.n_faulty}: mean_k={pt.mean_k:.2f} "
+                  f"decided={pt.decided_frac:.3f} "
+                  f"{pt.trials_per_sec:.1f} trials/s", flush=True)
+    return cb.points
+
+
+def coin_comparison_batched(base_cfg: SimConfig, f_values: Sequence[int],
+                            verbose: bool = True
+                            ) -> Dict[str, List[SweepPoint]]:
+    """``coin_comparison``'s private/common contrast swept over an f-axis:
+    each coin mode's whole curve runs as one batched executable (the
+    count-controlling adversary's closed form has no quorum-specialized
+    shapes), so the pair costs TWO compiles at any number of f values.
+    Same adversary setup as coin_comparison: balanced inputs, zero
+    crashes, even quorum required per point."""
+    T, N = base_cfg.trials, base_cfg.n_nodes
+    for f in f_values:
+        if (N - int(f)) % 2:
+            raise ValueError(
+                f"coin_comparison needs an even quorum N-F for a "
+                f"perfect-tie adversary (got N-F={N - int(f)} at f={f}); "
+                f"adjust N or the f grid")
+    balanced = balanced_inputs(T, N)
+    out: Dict[str, List[SweepPoint]] = {}
+    for coin in ("private", "common"):
+        cfg = base_cfg.replace(coin_mode=coin, scheduler="adversarial",
+                               delivery="quorum")
+        if verbose:
+            print(f" coin_mode={coin}:", flush=True)
+        cb = run_curve_batched(
+            cfg, f_values, initial_values=balanced,
+            faults_for=lambda c: FaultSpec.none(T, N), verbose=verbose)
+        out[coin] = cb.points
+    return out
 
 
 def coin_comparison(base_cfg: SimConfig,

@@ -1,0 +1,85 @@
+"""XLA backend-compile accounting via the ``jax.monitoring`` hook.
+
+The batched dynamic-F sweep engine's whole claim is "one compile per
+static-shape bucket instead of one per point" — this module is how that
+claim is *measured* rather than asserted: jax records a
+``/jax/core/compile/backend_compile_duration`` event for every real
+backend compile (jax/_src/dispatch.py BACKEND_COMPILE_EVENT, emitted by
+the pjit lowering path on every platform), and ``count_backend_compiles``
+scopes a counter over any code region.  sweep.run_curve_batched wraps its
+compile+execute phase in one, bench.py wraps the regime warm-up, and
+tests/test_batched_sweep.py pins the one-compile-per-bucket contract.
+
+jax.monitoring has no per-listener deregistration (only a global
+``clear_event_listeners``), so ONE process-lifetime listener is installed
+lazily and fans out to whatever counters are currently in scope — zero
+listeners touched on exit, nested scopes both count.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+from typing import Iterator, List
+
+#: The event jax records around every backend (XLA) compile — one event
+#: per compiled executable, cache hits excluded.  Name pinned by
+#: jax/_src/dispatch.py:BACKEND_COMPILE_EVENT.
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_lock = threading.Lock()
+_installed = False
+_active: List["CompileCounter"] = []
+
+
+# eq=False: identity comparison — nested scopes hold counters that can be
+# value-equal mid-flight, and the teardown's list.remove must take out the
+# exact object, not the first look-alike.
+@dataclasses.dataclass(eq=False)
+class CompileCounter:
+    """Mutable tally handed out by ``count_backend_compiles``."""
+
+    count: int = 0
+    seconds: float = 0.0
+
+
+def _listener(event: str, duration: float, **kwargs) -> None:
+    if event != BACKEND_COMPILE_EVENT:
+        return
+    with _lock:
+        active = list(_active)
+    for c in active:
+        c.count += 1
+        c.seconds += duration
+
+
+def _ensure_installed() -> None:
+    global _installed
+    with _lock:
+        if _installed:
+            return
+        import jax.monitoring
+
+        jax.monitoring.register_event_duration_secs_listener(_listener)
+        _installed = True
+
+
+@contextlib.contextmanager
+def count_backend_compiles() -> Iterator[CompileCounter]:
+    """Count XLA backend compiles (and their total duration) in a scope.
+
+    Counts every backend compile issued process-wide while the scope is
+    open — including op-by-op dispatch compiles — so callers measuring a
+    specific code path should build inputs (device_put, key creation,
+    stacking) *before* entering the scope.
+    """
+    _ensure_installed()
+    counter = CompileCounter()
+    with _lock:
+        _active.append(counter)
+    try:
+        yield counter
+    finally:
+        with _lock:
+            _active.remove(counter)

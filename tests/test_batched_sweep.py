@@ -1,0 +1,222 @@
+"""Batched dynamic-F sweep engine (sweep.run_curve_batched).
+
+Pins the tentpole contract of the compile-amortized curve engine:
+
+  * bit-identical per-f summaries (decided_frac, mean_k, k_hist,
+    ones_frac, disagree_frac, rounds_executed) between the batched
+    executable and the per-point ``run_point`` oracle, across the uniform
+    and adversarial/targeted schedulers and both coin modes;
+  * exactly ONE XLA backend compile per static-shape bucket, measured by
+    the jax.monitoring hook (utils/compile_counter.py), for a >= 5-point
+    curve;
+  * bucketing: quorum-specialized regimes (exact-table quorums, dense
+    top-k masks, pallas kernels) are split into their own static buckets
+    while the CF regime shares one.
+"""
+
+import numpy as np
+import pytest
+
+from benor_tpu.config import SimConfig
+from benor_tpu.ops import sampling
+from benor_tpu.state import FaultSpec
+from benor_tpu.sweep import (balanced_inputs, coin_comparison,
+                             coin_comparison_batched, quorum_specialized,
+                             rounds_vs_f, rounds_vs_f_batched,
+                             run_curve_batched, run_point, sweep_bucket_key)
+
+#: Smallest CF-regime geometry that keeps every quorum above
+#: sampling.EXACT_TABLE_MAX (= 4096) for the f grid below.
+CF_N = 9000
+CF_FS = [600, 1200, 1800, 2400, 3000]
+
+
+def assert_points_bit_identical(a, b):
+    assert a.n_faulty == b.n_faulty and a.n_nodes == b.n_nodes
+    assert a.rounds_executed == b.rounds_executed, a.n_faulty
+    assert a.decided_frac == b.decided_frac, a.n_faulty
+    assert a.mean_k == b.mean_k, a.n_faulty
+    assert a.ones_frac == b.ones_frac, a.n_faulty
+    assert a.disagree_frac == b.disagree_frac, a.n_faulty
+    np.testing.assert_array_equal(a.k_hist, b.k_hist)
+
+
+def test_cf_uniform_bit_identity_and_one_compile():
+    """The north-star shape: >= 5 f values in the CF regime — one bucket,
+    one measured backend compile, summaries bit-equal to the per-point
+    oracle."""
+    cfg = SimConfig(n_nodes=CF_N, n_faulty=0, trials=4, delivery="quorum",
+                    scheduler="uniform", path="histogram", max_rounds=16,
+                    seed=3)
+    pp = rounds_vs_f(cfg, CF_FS, verbose=False)
+    cb = run_curve_batched(cfg, CF_FS)
+    assert cb.n_buckets == 1
+    assert cb.bucket_sizes == [len(CF_FS)]
+    # the acceptance gate: exactly 1 XLA compile per static-shape bucket,
+    # asserted via the jax.monitoring backend-compile hook the engine
+    # scopes over its compile+execute phase
+    assert cb.compile_count == cb.n_buckets == 1
+    for a, b in zip(pp, cb.points):
+        assert_points_bit_identical(a, b)
+
+
+def test_wrapper_matches_rounds_vs_f():
+    cfg = SimConfig(n_nodes=CF_N, n_faulty=0, trials=4, delivery="quorum",
+                    scheduler="uniform", path="histogram", max_rounds=16,
+                    seed=5)
+    pp = rounds_vs_f(cfg, CF_FS[:3], verbose=False)
+    bb = rounds_vs_f_batched(cfg, CF_FS[:3], verbose=False)
+    for a, b in zip(pp, bb):
+        assert_points_bit_identical(a, b)
+
+
+@pytest.mark.parametrize("scheduler,coin", [
+    ("adversarial", "private"),      # livelock regime (tie-forcing)
+    ("adversarial", "common"),       # O(1) escape
+    ("targeted", "private"),         # agreement attack (disagree > 0)
+])
+def test_adversarial_schedulers_bit_identity(scheduler, coin):
+    """The closed-form count adversaries have no quorum-specialized
+    shapes, so even small-N points batch dynamically — balanced inputs,
+    zero crashes (the adversary's strongest setting)."""
+    n, trials = 100, 8
+    cfg = SimConfig(n_nodes=n, n_faulty=0, trials=trials, delivery="quorum",
+                    scheduler=scheduler, coin_mode=coin, path="histogram",
+                    max_rounds=8, seed=7)
+    fs = [20, 30, 40]
+    bal = balanced_inputs(trials, n)
+
+    def no_crash(c):
+        return FaultSpec.none(trials, n)
+
+    cb = run_curve_batched(cfg, fs, initial_values=bal, faults_for=no_crash)
+    assert cb.n_buckets == 1 and cb.compile_count == 1
+    for f, b in zip(fs, cb.points):
+        a = run_point(cfg.replace(n_faulty=f), initial_values=bal,
+                      faults=FaultSpec.none(trials, n))
+        assert_points_bit_identical(a, b)
+    if scheduler == "targeted":
+        # sanity that the regime is non-trivial: the partitioned
+        # adversary violates agreement at every even-quorum point
+        assert any(p.disagree_frac > 0 for p in cb.points)
+
+
+def test_uniform_common_coin_bit_identity():
+    """Both coin modes covered on the uniform scheduler too."""
+    cfg = SimConfig(n_nodes=CF_N, n_faulty=0, trials=4, delivery="quorum",
+                    scheduler="uniform", coin_mode="common",
+                    path="histogram", max_rounds=16, seed=11)
+    fs = CF_FS[:3]
+    cb = run_curve_batched(cfg, fs)
+    assert cb.compile_count == cb.n_buckets == 1
+    for f, b in zip(fs, cb.points):
+        a = run_point(cfg.replace(n_faulty=f))
+        assert_points_bit_identical(a, b)
+
+
+def test_mixed_regimes_split_buckets():
+    """An f past the CF boundary (quorum <= EXACT_TABLE_MAX) cannot share
+    the traced executable — it gets a static bucket of its own, still
+    bit-identical to the oracle."""
+    cfg = SimConfig(n_nodes=CF_N, n_faulty=0, trials=4, delivery="quorum",
+                    scheduler="uniform", path="histogram", max_rounds=16,
+                    seed=3)
+    f_exact = CF_N - sampling.EXACT_TABLE_MAX + 500   # quorum 3596 <= 4096
+    fs = CF_FS + [f_exact]
+    cb = run_curve_batched(cfg, fs)
+    assert cb.n_buckets == 2
+    assert cb.compile_count == 2
+    assert sorted(cb.bucket_sizes) == [1, len(CF_FS)]
+    a = run_point(cfg.replace(n_faulty=f_exact))
+    assert_points_bit_identical(a, cb.points[-1])
+
+
+def test_coin_comparison_batched_matches_per_point():
+    cfg = SimConfig(n_nodes=100, n_faulty=40, trials=16, max_rounds=8,
+                    seed=7)
+    per_point = coin_comparison(cfg, verbose=False)
+    batched = coin_comparison_batched(cfg, [40], verbose=False)
+    for coin in ("private", "common"):
+        assert_points_bit_identical(per_point[coin][0], batched[coin][0])
+
+
+def test_coin_comparison_batched_rejects_odd_quorum():
+    cfg = SimConfig(n_nodes=21, n_faulty=0, trials=4)
+    with pytest.raises(ValueError, match="even quorum"):
+        coin_comparison_batched(cfg, [6], verbose=False)
+
+
+class TestBucketing:
+    def test_cf_points_share_a_key(self):
+        cfg = SimConfig(n_nodes=CF_N, n_faulty=0, trials=4,
+                        delivery="quorum", scheduler="uniform",
+                        path="histogram")
+        keys = {sweep_bucket_key(cfg.replace(n_faulty=f)) for f in CF_FS}
+        assert len(keys) == 1
+
+    def test_exact_regime_specializes(self):
+        cfg = SimConfig(n_nodes=100, n_faulty=20, trials=4,
+                        delivery="quorum", scheduler="uniform",
+                        path="histogram")
+        assert quorum_specialized(cfg)       # quorum 80 <= EXACT_TABLE_MAX
+        k1 = sweep_bucket_key(cfg)
+        k2 = sweep_bucket_key(cfg.replace(n_faulty=30))
+        assert k1 != k2                      # one bucket per exact quorum
+
+    def test_dense_path_specializes_but_closed_forms_do_not(self):
+        dense = SimConfig(n_nodes=100, n_faulty=20, trials=4,
+                          delivery="quorum", scheduler="uniform",
+                          path="dense")
+        assert quorum_specialized(dense)     # top-k mask shape = m
+        adv = dense.replace(scheduler="adversarial")
+        assert not quorum_specialized(adv)   # closed form, any path
+
+    def test_pallas_flags_specialize(self):
+        cfg = SimConfig(n_nodes=CF_N, n_faulty=600, trials=4,
+                        delivery="quorum", scheduler="uniform",
+                        path="histogram", use_pallas_hist=True)
+        assert quorum_specialized(cfg)       # kernel bakes the quorum
+        assert not quorum_specialized(cfg.replace(use_pallas_hist=False))
+
+    def test_schedulers_never_share_buckets(self):
+        cfg = SimConfig(n_nodes=CF_N, n_faulty=600, trials=4,
+                        delivery="quorum", scheduler="uniform",
+                        path="histogram")
+        assert sweep_bucket_key(cfg) != sweep_bucket_key(
+            cfg.replace(scheduler="adversarial"))
+
+
+@pytest.mark.slow
+def test_sweep_cli_batched(tmp_path, capsys):
+    """`sweep --batched` routes through the engine (bucket banner printed)
+    and writes the same point schema as the per-point path."""
+    import json
+
+    from benor_tpu.__main__ import main
+    out = str(tmp_path / "b.json")
+    assert main(["sweep", "--n", "24", "--f-values", "4,9", "--trials", "8",
+                 "--max-rounds", "8", "--balanced", "--batched",
+                 "--out", out]) == 0
+    pts = json.load(open(out))
+    assert len(pts) == 2 and all("disagree_frac" in p for p in pts)
+    assert "batched curve:" in capsys.readouterr().out
+
+
+def test_compile_counter_hook_counts_fresh_compiles():
+    """The measurement primitive itself: AOT lower+compile emits exactly
+    one backend-compile event per executable, and scopes nest."""
+    import jax
+    import jax.numpy as jnp
+
+    from benor_tpu.utils.compile_counter import count_backend_compiles
+
+    x = jnp.arange(8.0)          # built OUTSIDE the counting scopes
+    y = jnp.arange(16.0)         # distinct shape: jax dedupes identical
+    f = lambda v: v * 3 + 1      # noqa: E731    HLO across AOT compiles
+    with count_backend_compiles() as outer:
+        with count_backend_compiles() as inner:
+            jax.jit(f).lower(x).compile()
+        jax.jit(f).lower(y).compile()
+    assert inner.count == 1
+    assert outer.count == 2
+    assert outer.seconds > 0
