@@ -76,7 +76,7 @@ def emit(obj) -> None:
 _DETAIL_KEYS = ("curve", "pallas_check", "pallas_hist_check",
                 "pallas_equiv_check", "pallas_weak_coin_check",
                 "pallas_round_check", "pallas_demoted",
-                "batched_sweep_check")
+                "batched_sweep_check", "flight_recorder")
 
 
 def _split_headline(out: dict) -> tuple[dict, dict]:
@@ -105,6 +105,11 @@ def _split_headline(out: dict) -> tuple[dict, dict]:
     head["pallas_interpret"] = interpret
     head["n_regimes"] = len(out.get("curve", []))
     head["pallas_demoted_n"] = len(out.get("pallas_demoted", []))
+    fr = out.get("flight_recorder")
+    if isinstance(fr, dict):
+        # one compact bool on the headline; the recorder-derived series
+        # (decide velocity, quiescence histogram) stay in the sidecar
+        head["recorder_ok"] = bool(fr.get("bit_equal_record_off_on"))
     head["detail_file"] = "BENCH_DETAIL.json"
     return head, detail
 
@@ -730,6 +735,79 @@ def _batched_sweep_check(n: int, trials: int, seed: int) -> dict:
     }
 
 
+def _flight_recorder_check(n: int, trials: int, max_rounds: int, seed: int,
+                           use_pallas: bool) -> dict:
+    """Flight-recorder proof + recorder-derived science on the flagship
+    balanced f=0.40 regime (the same config the main sweep runs, so the
+    record=False executable is cache-warm):
+
+      * record=True results are BIT-IDENTICAL to record=False (the
+        recorder only reduces values the round already computes);
+      * record=False costs zero extra backend compiles (its executable
+        was built by the sweep warm-up — the flag never enters the
+        trace);
+      * the buffer yields the per-round decide velocity and the
+        rounds-to-quiescence histogram over lanes
+        (utils/metrics.round_history_summary) — full round history from
+        a regime that previously ran blind (cfg.debug would demote the
+        fused pallas loop; the recorder runs inside it).
+    """
+    import jax
+
+    from benor_tpu.config import SimConfig
+    from benor_tpu.sim import run_consensus
+    from benor_tpu.state import FaultSpec, init_state
+    from benor_tpu.sweep import balanced_inputs
+    from benor_tpu.utils.compile_counter import count_backend_compiles
+    from benor_tpu.utils.metrics import round_history_summary
+
+    base = dict(n_nodes=n, n_faulty=int(0.40 * n), trials=trials,
+                max_rounds=max_rounds, delivery="quorum",
+                scheduler="uniform", path="histogram", fault_model="crash",
+                seed=seed, use_pallas_hist=use_pallas,
+                use_pallas_round=use_pallas)
+    cfg_off = SimConfig(**base)
+    cfg_on = SimConfig(record=True, **base)
+    faults = FaultSpec.none(trials, n)
+    state = init_state(cfg_off, balanced_inputs(trials, n), faults)
+    key = jax.random.key(seed)
+
+    with count_backend_compiles() as cc_off:
+        r0, fin0 = run_consensus(cfg_off, state, faults, key)
+        int(r0)
+    r1, fin1, rec = run_consensus(cfg_on, state, faults, key)
+    int(r1)
+
+    assert int(r0) == int(r1)
+    np.testing.assert_array_equal(np.asarray(fin0.x), np.asarray(fin1.x))
+    np.testing.assert_array_equal(np.asarray(fin0.decided),
+                                  np.asarray(fin1.decided))
+    np.testing.assert_array_equal(np.asarray(fin0.k), np.asarray(fin1.k))
+
+    # post-compile overhead of recording (one extra HBM buffer + the
+    # kernels' telemetry partials; zero host round trips either way)
+    times = []
+    for cfg in (cfg_off, cfg_on):
+        loops = 3
+        t0 = time.perf_counter()
+        for _ in range(loops):
+            out = run_consensus(cfg, state, faults, key)
+        int(out[0])
+        times.append((time.perf_counter() - t0) / loops)
+
+    return {
+        "regime": "balanced_f0.40", "n": n, "trials": trials,
+        "fused_round": use_pallas,
+        "bit_equal_record_off_on": True,
+        "compiles_record_off_warm": cc_off.count,
+        "unrecorded_ms": round(times[0] * 1e3, 3),
+        "recorded_ms": round(times[1] * 1e3, 3),
+        "record_overhead_x": (round(times[1] / times[0], 3)
+                              if times[0] > 0 else None),
+        **round_history_summary(rec),
+    }
+
+
 def bench_sweep(platform: str, fallback: bool) -> dict:
     """The north-star workload: multi-regime rounds-vs-f science sweep at
     N=1M (TPU) / 50k (CPU smoke), with hardware-capability accounting."""
@@ -927,6 +1005,13 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
     except Exception as e:  # noqa: BLE001
         batched_check = {"error": f"{type(e).__name__}: {e}"}
     log(f"bench: batched dynamic-F sweep check {batched_check}")
+    try:
+        recorder_check = _flight_recorder_check(n, trials, max_rounds,
+                                                seed,
+                                                use_pallas=not on_cpu)
+    except Exception as e:  # noqa: BLE001
+        recorder_check = {"error": f"{type(e).__name__}: {e}"}
+    log(f"bench: flight recorder check {recorder_check}")
 
     total_trials = trials * len(regimes)
     log(f"bench: sweep elapsed {elapsed:.2f}s for {total_trials} trials; "
@@ -978,6 +1063,7 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
         "pallas_weak_coin_check": pallas_wcoin,
         "pallas_round_check": pallas_round,
         "batched_sweep_check": batched_check,
+        "flight_recorder": recorder_check,
         "pallas_demoted": demoted,
     }
 
@@ -1078,6 +1164,17 @@ def main() -> None:
             "fallback_cpu": fallback,
             "error": f"{type(e).__name__}: {e}",
         }
+    # BENCH_METRICS_PATH: dump the unified metrics registry (compile
+    # counts/durations, probe accounting, timed spans) as JSON-lines —
+    # best-effort, off by default so driver artifacts don't grow
+    metrics_path = os.environ.get("BENCH_METRICS_PATH")
+    if metrics_path:
+        try:
+            from benor_tpu.utils.metrics import export_jsonl
+            n_rec = export_jsonl(metrics_path)
+            log(f"bench: {n_rec} metrics records -> {metrics_path}")
+        except Exception as e:  # noqa: BLE001 — observability is optional
+            log(f"bench: metrics export failed: {e}")
     if any(k in out for k in _DETAIL_KEYS):
         headline, detail = _split_headline(out)
         # BENCH_DETAIL_PATH: redirect the sidecar (ad-hoc smoke runs must

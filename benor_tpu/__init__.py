@@ -16,19 +16,22 @@ Layout (SURVEY.md §7):
   backends/        'tpu' array network + 'express' asyncio oracle (N1)
   sim.py           while-loop driver + checkpoint re-entry
   api.py           launch_network parity facade (N10)
+  utils/metrics.py unified metrics registry + flight-recorder rendering
+                   (SimConfig.record; see README "Observability")
 """
 
 from .api import (get_nodes_state, launch_network, reached_finality,
                   start_consensus, stop_consensus)
 from .config import BASE_NODE_PORT, SimConfig, VAL0, VAL1, VALQ
-from .state import DynParams, FaultSpec, NetState, init_state, \
-    observable_state
+from .state import (DynParams, FaultSpec, NetState, REC_COLUMNS, REC_WIDTH,
+                    init_state, new_recorder, observable_state)
 from .sim import (run_consensus, run_consensus_traced, resume_consensus,
                   simulate, start_state)
 
 __all__ = [
     "BASE_NODE_PORT", "SimConfig", "VAL0", "VAL1", "VALQ",
     "DynParams", "FaultSpec", "NetState", "init_state", "observable_state",
+    "REC_COLUMNS", "REC_WIDTH", "new_recorder",
     "run_consensus", "run_consensus_traced", "resume_consensus",
     "simulate", "start_state",
     "launch_network", "start_consensus", "stop_consensus",

@@ -7,7 +7,14 @@ Subcommands:
   demo   [--backend tpu|express] [-n N] [-f F] ...   the start.ts demo
   sweep  --n N --f-values 0,100,...                  rounds-vs-f curve
   coins  --n N --f F                                 private vs common coin
+  trace  --n N --f F --out trace.json                flight-recorder round
+                                                     history as a Chrome-
+                                                     trace/Perfetto file
   preset NAME                                        a BASELINE.json config
+
+Observability: `--record` (sweep) fills the on-device flight recorder;
+`--metrics-out PATH` (sweep/coins/trace) dumps the unified metrics
+registry (JSON-lines, or Prometheus textfile with a .prom extension).
 """
 
 from __future__ import annotations
@@ -16,6 +23,7 @@ import argparse
 import json
 import os
 import sys
+import time
 
 import numpy as np
 
@@ -100,6 +108,36 @@ def _add_pallas_arg(sub) -> None:
                           "accelerator backends, off on CPU)")
 
 
+def _add_obs_args(sub, record: bool = True) -> None:
+    """ONE definition of the observability options (flight recorder +
+    metrics export) for every compute subcommand."""
+    if record:
+        sub.add_argument("--record", action="store_true",
+                         help="fill the on-device flight recorder "
+                              "(SimConfig.record): per-round "
+                              "decided/killed/value-histogram/coin/"
+                              "margin telemetry with no demotion of the "
+                              "fused pallas path (unlike debug=True)")
+    sub.add_argument("--metrics-out", metavar="PATH",
+                     help="write the unified metrics registry "
+                          "(utils/metrics.py: timers, compile and probe "
+                          "counters) as JSON-lines on exit; .prom "
+                          "extension switches to Prometheus textfile "
+                          "format")
+
+
+def _export_metrics(path) -> None:
+    if not path:
+        return
+    from .utils import metrics
+    if str(path).endswith(".prom"):
+        n = metrics.export_prometheus(path)
+    else:
+        n = metrics.export_jsonl(path)
+    print(f"wrote {n} metrics records to {path}", file=sys.stderr,
+          flush=True)
+
+
 def _pallas_flags(choice: str) -> dict:
     """--pallas plumbing: 'auto' engages the fused flagship path exactly
     when results.py's accelerator-scale studies do (on for accelerator
@@ -124,7 +162,8 @@ def _sweep(args) -> int:
     cfg = SimConfig(n_nodes=args.n, n_faulty=0, trials=args.trials,
                     max_rounds=args.max_rounds, delivery="quorum",
                     scheduler=args.scheduler, coin_mode=args.coin,
-                    fault_model=args.fault_model, seed=args.seed, **flags)
+                    fault_model=args.fault_model, seed=args.seed,
+                    record=args.record, **flags)
     mode = "balanced/no-crash" if args.balanced else "iid/crash"
     fb = " [cpu fallback]" if FELL_BACK else ""
     # banner reports the compute path actually taken, not the request:
@@ -145,6 +184,7 @@ def _sweep(args) -> int:
           f"scheduler={args.scheduler}, coin={args.coin}, "
           f"faults={args.fault_model}, inputs={mode}"
           f"{pallas_note}{fb}")
+    t0 = time.perf_counter()
     if args.balanced:
         # the science regime: balanced inputs, F purely a protocol
         # parameter (crash-pinned faults make every tally the deterministic
@@ -180,9 +220,59 @@ def _sweep(args) -> int:
         points = rounds_vs_f_batched(cfg, f_values)
     else:
         points = rounds_vs_f(cfg, f_values)
+    from .utils.metrics import REGISTRY
+    REGISTRY.timer("cli.sweep").record(time.perf_counter() - t0)
+    if args.record:
+        # recorder-derived per-point science: round history is in each
+        # point (SweepPoint.round_history; --out JSON carries the rows)
+        from .utils.metrics import round_history_summary
+        for pt in points:
+            s = round_history_summary(pt.round_history)
+            print(f"  f={pt.n_faulty}: quiescence_round="
+                  f"{s['rounds_to_quiescence']} "
+                  f"decide_velocity={s['decide_velocity']}", flush=True)
     if args.out:
         save_points(args.out, points)
         print(f"wrote {args.out}")
+    _export_metrics(args.metrics_out)
+    return 0
+
+
+def _trace(args) -> int:
+    """Run ONE recorded config and export a Chrome-trace/Perfetto file:
+    every protocol round as a trace slice (its telemetry row in args)
+    alongside the registry's host-side timer spans."""
+    from .config import SimConfig
+    from .state import FaultSpec
+    from .sweep import balanced_inputs, run_point
+    from .utils import metrics
+    from .utils.tracing import timed
+
+    cfg = SimConfig(n_nodes=args.n, n_faulty=args.f, trials=args.trials,
+                    max_rounds=args.max_rounds, delivery="quorum",
+                    scheduler=args.scheduler, coin_mode=args.coin,
+                    fault_model=args.fault_model, seed=args.seed,
+                    record=True, **_pallas_flags(args.pallas))
+    with timed("trace.run"):
+        if args.balanced:
+            faults = (FaultSpec.first_f(cfg)
+                      if cfg.fault_model in ("byzantine", "equivocate")
+                      else FaultSpec.none(args.trials, args.n))
+            pt = run_point(cfg, initial_values=balanced_inputs(
+                args.trials, args.n), faults=faults)
+        else:
+            pt = run_point(cfg)
+    summ = metrics.round_history_summary(pt.round_history)
+    n_ev = metrics.export_chrome_trace(
+        args.out, round_history=pt.round_history,
+        rounds_label=f"benor N={args.n} f={args.f}")
+    fb = " [cpu fallback]" if FELL_BACK else ""
+    print(f"rounds={pt.rounds_executed} decided={pt.decided_frac:.3f} "
+          f"mean_k={pt.mean_k:.2f} "
+          f"quiescence_round={summ['rounds_to_quiescence']}{fb}")
+    print(f"wrote {n_ev} trace events to {args.out} "
+          f"(open in https://ui.perfetto.dev or chrome://tracing)")
+    _export_metrics(args.metrics_out)
     return 0
 
 
@@ -205,6 +295,7 @@ def _coins(args) -> int:
                       faults=FaultSpec.none(args.trials, args.n))
         print(f"weak_common(eps={eps}): decided={p.decided_frac:.3f} "
               f"mean_k={p.mean_k:.2f}")
+    _export_metrics(args.metrics_out)
     return 0
 
 
@@ -273,6 +364,7 @@ def main(argv=None) -> int:
                    default="crash")
     s.add_argument("--seed", type=int, default=0)
     _add_pallas_arg(s)
+    _add_obs_args(s)
     s.add_argument("--balanced", action="store_true",
                    help="balanced inputs + zero crashes (the multi-round "
                         "science regime; default is the reference-style "
@@ -295,6 +387,33 @@ def main(argv=None) -> int:
                    help="also run weak_common coins at these deviation "
                         "probabilities (0 ~ common, 1 ~ private; the "
                         "termination transition sits at 1 - F/N)")
+    _add_obs_args(c, record=False)
+
+    t = sub.add_parser("trace",
+                       help="run one recorded config, export a Chrome-"
+                            "trace/Perfetto file of its round history")
+    t.add_argument("--n", type=int, default=1000)
+    t.add_argument("--f", type=int, default=250)
+    t.add_argument("--trials", type=int, default=64)
+    t.add_argument("--max-rounds", type=int, default=64)
+    t.add_argument("--scheduler",
+                   choices=("uniform", "biased", "adversarial", "targeted"),
+                   default="uniform")
+    t.add_argument("--coin", choices=("private", "common", "weak_common"),
+                   default="private")
+    t.add_argument("--fault-model",
+                   choices=("crash", "byzantine", "equivocate"),
+                   default="crash")
+    t.add_argument("--balanced", action="store_true",
+                   help="balanced inputs + zero crashes (live marked "
+                        "faults under byzantine/equivocate) — the "
+                        "multi-round science regime")
+    t.add_argument("--seed", type=int, default=0)
+    t.add_argument("--out", default="benor_trace.json",
+                   help="Chrome-trace output path (default "
+                        "benor_trace.json)")
+    _add_pallas_arg(t)
+    _add_obs_args(t, record=False)   # trace implies --record
 
     p = sub.add_parser("preset", help="run a BASELINE.json preset config")
     p.add_argument("name")
@@ -314,16 +433,22 @@ def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     # bare `python -m benor_tpu [-n N -f F ...]` == the start.ts demo
     if not argv or argv[0] not in ("demo", "sweep", "coins", "preset",
-                                   "results", "-h", "--help"):
+                                   "results", "trace", "-h", "--help"):
         argv = ["demo"] + argv
     args = ap.parse_args(argv)
     _honor_platform_env()
+    if getattr(args, "metrics_out", None):
+        # feed the unified registry's compile counters from the first
+        # compile on (the jax.monitoring listener must precede them)
+        from .utils.compile_counter import install
+        install()
     # the event-loop oracle backends never touch a JAX backend — don't
     # spend a probe (or a fallback) on them
     if not (args.cmd == "demo" and args.backend in ("express", "native")):
         _ensure_live_backend()
     return {"demo": _demo, "sweep": _sweep, "coins": _coins,
-            "preset": _preset, "results": _results}[args.cmd](args)
+            "preset": _preset, "results": _results,
+            "trace": _trace}[args.cmd](args)
 
 
 if __name__ == "__main__":

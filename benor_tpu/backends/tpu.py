@@ -45,6 +45,9 @@ class TpuNetwork:
         self._faulty_list = list(faulty_list)
         self._started = False
         self.rounds_executed = 0
+        #: Flight-recorder buffer (cfg.record): int32
+        #: [max_rounds + 1, state.REC_WIDTH], filled by start().
+        self._recorder = None
 
     # -- /status (node.ts:33-39) ----------------------------------------
     def status(self, node_id: int, trial: int = 0):
@@ -76,11 +79,15 @@ class TpuNetwork:
                 "start(on_slice=...) requires SimConfig(poll_rounds > 0); "
                 "this config runs one uninterrupted compiled loop")
         base_key = jax.random.key(self.cfg.seed)
+        record = self.cfg.record
         if self.cfg.poll_rounds > 0:
             # sliced mid-run observability — single-device AND sharded
             # (the mesh case swaps in the shard_map'd slice primitive;
             # everything else, including bit-identity with the one-shot
-            # path, is shared)
+            # path, is shared).  Under cfg.record the flight recorder
+            # threads across slices: each published snapshot comes with
+            # the round history filled so far (get_round_history serves
+            # it live to concurrent pollers).
             from ..models.benor import all_settled
             from ..sim import run_consensus_slice, start_state
             import jax.numpy as jnp
@@ -96,20 +103,24 @@ class TpuNetwork:
                 self.state, faults_sh = shard_inputs(self.state,
                                                      self.faults, mesh)
 
-                def slice_fn(st, r, until):
+                def slice_fn(st, r, until, rec):
                     return run_consensus_slice_sharded(
-                        self.cfg, st, faults_sh, base_key, mesh, r, until)
+                        self.cfg, st, faults_sh, base_key, mesh, r, until,
+                        recorder=rec)
             else:
-                def slice_fn(st, r, until):
+                def slice_fn(st, r, until, rec):
                     return run_consensus_slice(
                         self.cfg, st, self.faults, base_key,
-                        jnp.int32(r), jnp.int32(until))
+                        jnp.int32(r), jnp.int32(until), rec)
             state = start_state(self.cfg, self.state)
             self.state = state               # k=1 visible (node.ts:172)
-            r = 1
+            r, rec = 1, None
             while True:
-                r_next, state = slice_fn(state, r,
-                                         r + self.cfg.poll_rounds)
+                out = slice_fn(state, r, r + self.cfg.poll_rounds, rec)
+                r_next, state = out[0], out[1]
+                if record:
+                    rec = out[2]
+                    self._recorder = rec
                 self.state = state           # publish the live snapshot
                 if on_slice is not None:
                     on_slice()
@@ -122,15 +133,18 @@ class TpuNetwork:
         elif self.cfg.mesh_shape is not None:
             from ..parallel import make_mesh, run_consensus_sharded
             mesh = make_mesh(*self.cfg.mesh_shape)
-            rounds, final = run_consensus_sharded(
+            out = run_consensus_sharded(
                 self.cfg, self.state, self.faults, base_key, mesh)
-            self.rounds_executed = int(rounds)
-            self.state = final
+            self.rounds_executed = int(out[0])
+            self.state = out[1]
+            if record:
+                self._recorder = out[2]
         else:
-            rounds, final = run_consensus(self.cfg, self.state, self.faults,
-                                          base_key)
-            self.rounds_executed = int(rounds)
-            self.state = final
+            out = run_consensus(self.cfg, self.state, self.faults, base_key)
+            self.rounds_executed = int(out[0])
+            self.state = out[1]
+            if record:
+                self._recorder = out[2]
         self._started = True
 
     # -- /stop (consensus.ts:10-15 -> node.ts:191-194) -------------------
@@ -149,6 +163,27 @@ class TpuNetwork:
     def get_state(self, node_id: int, trial: int = 0) -> dict:
         return observable_state(self.cfg, self.state, self.faults,
                                 node_id, trial)
+
+    # -- flight recorder (cfg.record) -------------------------------------
+    def get_round_history(self) -> List[dict]:
+        """Per-round telemetry rows next to /getState (one dict per row,
+        state.REC_COLUMNS keys plus "round") — the observable surface of
+        the flight recorder.  Requires SimConfig(record=True); before
+        start() the history is just the row-0 snapshot-to-come (empty
+        list).  Under poll_rounds the history grows live between slices,
+        so a concurrent poller watches decide velocity round by round.
+        """
+        if not self.cfg.record:
+            raise ValueError(
+                "get_round_history() requires SimConfig(record=True): "
+                "the flight recorder is off and no round history was "
+                "captured (cfg.debug streams host callbacks instead, but "
+                "demotes the fused-pallas regime — see README "
+                "Observability)")
+        from ..utils.metrics import round_history_rows
+        if self._recorder is None:
+            return []
+        return round_history_rows(np.asarray(self._recorder))
 
     def get_states(self, trial: int = 0) -> List[dict]:
         # Bulk path: one device->host transfer per array, then N dict builds

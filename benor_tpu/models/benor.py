@@ -45,7 +45,8 @@ def _sent_values(cfg: SimConfig, x: jax.Array, faults: FaultSpec) -> jax.Array:
 def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
                 base_key: jax.Array, r: jax.Array,
                 ctx: ShardCtx = SINGLE,
-                dyn: Optional[DynParams] = None) -> NetState:
+                dyn: Optional[DynParams] = None,
+                recorder: Optional[jax.Array] = None):
     """Advance every lane by one full Ben-Or round (proposal + vote phase).
 
     ``r`` is the 1-based round index; matches the reference's message ``k``.
@@ -53,6 +54,15 @@ def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
     blocks and ``ctx`` names the mesh axes; tallies psum over ICI and RNG
     keys derive from global ids, so results are bit-identical to the
     single-device run regardless of mesh shape.
+
+    ``recorder`` (flight-recorder buffer, state.new_recorder, or None)
+    makes this round write its telemetry row (state.REC_* columns,
+    psum-globalized under a mesh) at index ``r`` and changes the return
+    to ``(new_state, new_recorder)``; with None (every record=False
+    caller) the return is the plain NetState and the trace is untouched.
+    The recorder only REDUCES values the round already computes — no
+    random stream moves — so recorded results are bit-identical to
+    unrecorded ones.
 
     ``dyn`` (DynParams or None) supplies F and the quorum as TRACED
     scalars for the batched dynamic-F sweep (sweep.run_curve_batched):
@@ -91,9 +101,13 @@ def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
         cr = (pr._pad_cr(faults, pack.shape[1])
               if cfg.fault_model == "crash_at_round" else None)
         hist1 = pr.sent_hist_from_pack(cfg, pack, cr, r, ctx)
-        new_pack, _, _ = pr.packed_round(cfg, pack, faults, base_key, r,
-                                         hist1, ctx, N)
-        return pr.unpack_state(new_pack, N)
+        new_pack, _, _, row = pr.packed_round(cfg, pack, faults, base_key,
+                                              r, hist1, ctx, N)
+        new_state = pr.unpack_state(new_pack, N)
+        if recorder is not None:
+            from ..state import recorder_write
+            return new_state, recorder_write(recorder, r, row)
+        return new_state
 
     # --- crash-at-round fault injection (start of round) -----------------
     killed = state.killed
@@ -209,7 +223,23 @@ def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
     # after the decide branch), so a lane deciding in round r reports k=r+1.
     new_k = jnp.where(active, r + 1, state.k)
 
-    return NetState(x=new_x, decided=new_decided, k=new_k, killed=killed)
+    new_state = NetState(x=new_x, decided=new_decided, k=new_k,
+                         killed=killed)
+    if recorder is not None:
+        from ..state import recorder_round_row, recorder_write
+        # lanes that COMMITTED a coin flip: ran the round, no decide and
+        # (reference rule) no plurality-adopt — the same branch structure
+        # as the x2 selection above
+        no_decide = active & ~decide0 & ~decide1
+        if cfg.rule == "reference":
+            coined = no_decide & ~adopt0 & ~adopt1
+        else:
+            coined = no_decide
+        margin = jnp.where(active, jnp.abs(v0 - v1), 0).astype(jnp.int32)
+        row = recorder_round_row(new_x, new_decided, killed, coined,
+                                 margin, ctx)
+        return new_state, recorder_write(recorder, r, row)
+    return new_state
 
 
 def all_settled(state: NetState, ctx: ShardCtx = SINGLE) -> jax.Array:

@@ -74,6 +74,17 @@ class ShardCtx:
             axis = x.ndim + axis
         return lax.all_gather(x, self.node_axis, axis=axis, tiled=True)
 
+    def pmax_nodes(self, x: jax.Array) -> jax.Array:
+        """Max of per-shard partial maxima over the node axis.
+
+        The flight recorder's tally-margin column is a per-trial MAX over
+        lanes (state.REC_MARGIN) — a sum would overflow int32 at
+        N=1M x 1k-trial scale — so its node-axis combine is pmax, not
+        psum."""
+        if self.node_axis is None:
+            return x
+        return lax.pmax(x, self.node_axis)
+
     def psum_trials(self, x: jax.Array) -> jax.Array:
         """Sum partial reductions over the trial axis (DCN all-reduce).
 

@@ -62,6 +62,16 @@ from ..state import NetState
 
 _DEC, _KILL, _FAULT, _KSHIFT = 2, 3, 4, 5
 
+#: Flight-recorder partial columns emitted by the vote kernel when
+#: record=True (cols 0-4 are the historical histogram/settled partials).
+#: All are per-tile SUMS except _RP_MARGIN, a per-tile per-trial MAX
+#: (cross-tile combine = max).  _RP_KILL includes this shard's pad lanes
+#: (they carry the killed bit); packed_round subtracts the static pad
+#: count before the psum.
+_RP_DEC, _RP_KILL = 5, 6
+_RP_U0, _RP_U1, _RP_UQ = 7, 8, 9
+_RP_COIN, _RP_MARGIN = 10, 11
+
 
 def pack_state(state: NetState, faulty: jax.Array) -> jax.Array:
     """NetState leaves + faulty mask -> padded packed int32 [T, Np].
@@ -250,7 +260,7 @@ def _prop_hist_kernel(m, fault_model, freeze, has_cr, counts_mode,
 
 def _vote_commit_kernel(m, n_faulty, rule, coin_mode, eps, freeze,
                         fault_model, has_cr, counts_mode, camp_b0,
-                        camp_b1, *refs):
+                        camp_b1, record, *refs):
     """One lane-tile of the fused VOTE phase + commit.
 
     Per-lane vote tallies (by counts_mode, as in _prop_hist_kernel) ->
@@ -260,6 +270,15 @@ def _vote_commit_kernel(m, n_faulty, rule, coin_mode, eps, freeze,
     fault models — the crash_at_round caller recomputes it in XLA
     instead), col 3 settled count, col 4 unsettled count (the loop
     predicate).
+
+    ``record`` (static; the flight recorder, SimConfig.record) adds the
+    telemetry partials in cols 5-11 (_RP_* layout): decided / killed
+    (pads included — the wrapper's caller subtracts the static pad count)
+    / live-undecided 0-1-"?" histogram / coin-flip count, all per-tile
+    sums, plus col 11 the per-trial MAX |v0 - v1| vote margin over active
+    lanes (combined across tiles with max, not sum — see
+    vote_commit_pallas).  record=False emits exactly the historical five
+    columns, so unrecorded executables stay bit-identical.
     """
     has_eq = fault_model == "equivocate" and counts_mode == "sampled"
     refs = list(refs)
@@ -322,10 +341,12 @@ def _vote_commit_kernel(m, n_faulty, rule, coin_mode, eps, freeze,
     ff = jnp.float32(n_faulty)
     decide0 = v0 > ff
     decide1 = v1 > ff
+    no_adopt = None
     if rule == "reference":                              # quirk 9
         any_votes = (v0 + v1) > 0.0
         adopt0 = any_votes & (v0 > v1)
         adopt1 = any_votes & (v0 < v1)
+        no_adopt = ~adopt0 & ~adopt1
         x2 = jnp.where(decide0, VAL0,
              jnp.where(decide1, VAL1,
              jnp.where(adopt0, VAL0,
@@ -346,11 +367,30 @@ def _vote_commit_kernel(m, n_faulty, rule, coin_mode, eps, freeze,
     settled = (new_dec == 1) | (killed == 1)
     hon = _honest(fault_model, alive, faulty)
     t = p.shape[0]
-    part_ref[...] = _partial_cols(t, [
+    cols = [
         jnp.sum((sent_next == v) & hon, axis=1, dtype=jnp.int32)
         for v in (VAL0, VAL1, VALQ)
     ] + [jnp.sum(settled, axis=1, dtype=jnp.int32),
-         jnp.sum(~settled, axis=1, dtype=jnp.int32)])
+         jnp.sum(~settled, axis=1, dtype=jnp.int32)]
+    if record:
+        # flight-recorder partials (_RP_* layout, same masks as the XLA
+        # path in models/benor.py — so the delivered/camps regimes, where
+        # both paths share every bit, record identical rows)
+        undec = (new_dec == 0) & (killed == 0)
+        coined = active & ~decide0 & ~decide1
+        if no_adopt is not None:
+            coined = coined & no_adopt
+        margin = jnp.where(active, jnp.abs(v0 - v1), 0.0)
+        cols = cols + [
+            jnp.sum(new_dec == 1, axis=1, dtype=jnp.int32),
+            jnp.sum(killed == 1, axis=1, dtype=jnp.int32),
+            jnp.sum(undec & (new_x == VAL0), axis=1, dtype=jnp.int32),
+            jnp.sum(undec & (new_x == VAL1), axis=1, dtype=jnp.int32),
+            jnp.sum(undec & (new_x == VALQ), axis=1, dtype=jnp.int32),
+            jnp.sum(coined, axis=1, dtype=jnp.int32),
+            jnp.max(margin, axis=1).astype(jnp.int32),
+        ]
+    part_ref[...] = _partial_cols(t, cols)
 
 
 def _smem():
@@ -446,14 +486,14 @@ def proposal_hist_pallas(base_key, r, phase, hist, pack, crash_round,
 
 @functools.partial(jax.jit, static_argnames=(
     "m", "n_faulty", "rule", "coin_mode", "eps", "freeze", "fault_model",
-    "interpret", "counts_mode", "camp_b0", "camp_b1"))
+    "interpret", "counts_mode", "camp_b0", "camp_b1", "record"))
 def vote_commit_pallas(base_key, r, phase, hist, pack, crash_round,
                        quorum_ok, shared, m: int, n_faulty: int, rule: str,
                        coin_mode: str, eps: float, freeze: bool,
                        fault_model: str, interpret: bool = False,
                        node_offset=0, trial_offset=0, n_equiv=None,
                        counts_mode: str = "sampled", camp_b0: int = 0,
-                       camp_b1: int = 0):
+                       camp_b1: int = 0, record: bool = False):
     """Fused vote phase + commit -> (new_pack [T, Np], partials [T, 128]).
 
     Partials: cols 0-2 the next round's LOCAL proposal histogram (valid
@@ -496,7 +536,7 @@ def vote_commit_pallas(base_key, r, phase, hist, pack, crash_round,
     new_pack, parts = pl.pallas_call(
         functools.partial(_vote_commit_kernel, m, n_faulty, rule,
                           coin_mode, eps, freeze, fault_model, has_cr,
-                          counts_mode, camp_b0, camp_b1),
+                          counts_mode, camp_b0, camp_b1, record),
         out_shape=[jax.ShapeDtypeStruct((T, np_total), jnp.int32),
                    jax.ShapeDtypeStruct((np_total // TILE_N, T, 128),
                                         jnp.int32)],
@@ -505,7 +545,12 @@ def vote_commit_pallas(base_key, r, phase, hist, pack, crash_round,
         out_specs=[_lane(T), _part(T)],
         interpret=interpret,
     )(*args)
-    return new_pack, jnp.sum(parts, axis=0)
+    summed = jnp.sum(parts, axis=0)
+    if record:
+        # the margin partial is a per-tile MAX, not a sum
+        summed = summed.at[:, _RP_MARGIN].set(
+            jnp.max(parts[:, :, _RP_MARGIN], axis=0))
+    return new_pack, summed
 
 
 def _pad_cr(faults, np_total):
@@ -559,9 +604,11 @@ def packed_round(cfg, pack, faults, base_key, r, hist1, ctx, n_local,
     histogram.  ``n_equiv`` is the global live-equivocator count [T]
     ('equivocate' only; derived from the pack when not supplied —
     run_packed precomputes it so the loop stays free of per-lane XLA
-    ops).  Returns (new_pack, hist1_next or None, unsettled [T]);
+    ops).  Returns (new_pack, hist1_next or None, unsettled [T], row);
     hist1_next is None under crash_at_round (recompute via
-    sent_hist_from_pack).
+    sent_hist_from_pack); ``row`` is the flight-recorder row int32
+    [state.REC_WIDTH] when cfg.record (globalized: counts psum'd, margin
+    pmax'd over nodes then summed over trials) and None otherwise.
     """
     from . import rng, tally
 
@@ -616,15 +663,35 @@ def packed_round(cfg, pack, faults, base_key, r, hist1, ctx, n_local,
         float(cfg.coin_eps), bool(cfg.freeze_decided), cfg.fault_model,
         interpret=interp, node_offset=node_off, trial_offset=trial_off,
         n_equiv=n_equiv, counts_mode=mode, camp_b0=camp_b0,
-        camp_b1=camp_b1)
+        camp_b1=camp_b1, record=bool(cfg.record))
     hist1_next = (None if cfg.fault_model == "crash_at_round"
                   else ctx.psum_nodes(partsB[:, :3]))
     unsettled = ctx.psum_nodes(partsB[:, 4])
-    return new_pack, hist1_next, unsettled
+    row = None
+    if cfg.record:
+        from ..state import (REC_COINS, REC_DECIDED, REC_KILLED,
+                             REC_MARGIN, REC_UNDEC0, REC_UNDEC1,
+                             REC_UNDECQ, REC_WIDTH)
+        # pad lanes carry the killed bit — remove this shard's static pad
+        # count per trial BEFORE the node-axis psum
+        killed_local = partsB[:, _RP_KILL] - jnp.int32(np_total - n_local)
+        per_trial = {
+            REC_DECIDED: ctx.psum_nodes(partsB[:, _RP_DEC]),
+            REC_KILLED: ctx.psum_nodes(killed_local),
+            REC_UNDEC0: ctx.psum_nodes(partsB[:, _RP_U0]),
+            REC_UNDEC1: ctx.psum_nodes(partsB[:, _RP_U1]),
+            REC_UNDECQ: ctx.psum_nodes(partsB[:, _RP_UQ]),
+            REC_COINS: ctx.psum_nodes(partsB[:, _RP_COIN]),
+            REC_MARGIN: ctx.pmax_nodes(partsB[:, _RP_MARGIN]),
+        }
+        row = jnp.stack([
+            ctx.psum_trials(jnp.sum(per_trial[i], dtype=jnp.int32))
+            for i in range(REC_WIDTH)])
+    return new_pack, hist1_next, unsettled, row
 
 
 def run_packed_slice(cfg, state, faults, base_key, from_round, until_round,
-                     ctx=None):
+                     ctx=None, recorder=None):
     """The packed while-loop, generalized over (mesh ctx, round bounds).
 
     At most ``until_round - from_round`` rounds from ``from_round`` (both
@@ -638,11 +705,21 @@ def run_packed_slice(cfg, state, faults, base_key, from_round, until_round,
     single-device runner (run_packed) and the shard_map'd runner
     (parallel/sharded.py:_local_slice), so the fused loop cannot drift
     between them.
+
+    With cfg.record the flight recorder rides the carry — each round's
+    globalized row (packed_round) lands via dynamic_update_slice, so the
+    FUSED regime gets full round history with no demotion and no host
+    round trips.  ``recorder`` threads an existing buffer across slices
+    (None builds a fresh one snapshotting ``state`` into row 0); the
+    filled buffer is appended to the return.
     """
     from ..ops.collectives import SINGLE
+    from ..state import new_recorder, recorder_write
 
     ctx = SINGLE if ctx is None else ctx
     n_local = state.x.shape[-1]
+    if cfg.record and recorder is None:
+        recorder = new_recorder(cfg, state, ctx)
     pack = pack_state(state, faults.faulty)
     cr = (_pad_cr(faults, pack.shape[1])
           if cfg.fault_model == "crash_at_round" else None)
@@ -653,34 +730,45 @@ def run_packed_slice(cfg, state, faults, base_key, from_round, until_round,
         dtype=jnp.int32))
 
     def cond(carry):
-        r, _, _, unsettled = carry
+        r, unsettled = carry[0], carry[3]
         return (r <= cfg.max_rounds) & (unsettled > 0) & (r < until_round)
 
     def body(carry):
-        r, pack, hist1, _ = carry
+        r, pack, hist1 = carry[0], carry[1], carry[2]
         if cfg.fault_model == "crash_at_round":
             hist1 = sent_hist_from_pack(cfg, pack, cr, r, ctx)
-        new_pack, hist1_next, unsettled = packed_round(
+        new_pack, hist1_next, unsettled, row = packed_round(
             cfg, pack, faults, base_key, r, hist1, ctx, n_local,
             n_equiv=n_equiv)
         if hist1_next is None:
             hist1_next = hist1              # recomputed next iteration
-        return (r + 1, new_pack, hist1_next,
-                ctx.psum_trials(jnp.sum(unsettled)))
+        out = (r + 1, new_pack, hist1_next,
+               ctx.psum_trials(jnp.sum(unsettled)))
+        if cfg.record:
+            out = out + (recorder_write(carry[4], r, row),)
+        return out
 
-    r, pack, _, _ = jax.lax.while_loop(
-        cond, body,
-        (jnp.asarray(from_round, jnp.int32), pack, hist1, unsettled0))
+    carry = (jnp.asarray(from_round, jnp.int32), pack, hist1, unsettled0)
+    if cfg.record:
+        carry = carry + (recorder,)
+    out = jax.lax.while_loop(cond, body, carry)
+    r, pack = out[0], out[1]
+    if cfg.record:
+        return r, unpack_state(pack, n_local), out[4]
     return r, unpack_state(pack, n_local)
 
 
 def run_packed(cfg, state, faults, base_key):
     """Single-device fast path for sim.run_consensus: run_packed_slice
     from /start with an unbounded slice.  Bit-identical to the generic
-    loop."""
+    loop.  With cfg.record, returns the filled flight recorder too."""
     from ..sim import start_state
 
     state = start_state(cfg, state)
-    r, fin = run_packed_slice(cfg, state, faults, base_key,
-                              jnp.int32(1), jnp.int32(cfg.max_rounds + 2))
+    out = run_packed_slice(cfg, state, faults, base_key,
+                           jnp.int32(1), jnp.int32(cfg.max_rounds + 2))
+    if cfg.record:
+        r, fin, rec = out
+        return r - 1, fin, rec
+    r, fin = out
     return r - 1, fin

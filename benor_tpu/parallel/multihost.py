@@ -146,7 +146,7 @@ def _check_global(state: NetState, faults: FaultSpec,
 
 def run_consensus_multihost(cfg: SimConfig, state: NetState,
                             faults: FaultSpec, base_key: jax.Array,
-                            mesh: Mesh) -> Tuple[jax.Array, NetState]:
+                            mesh: Mesh):
     """Run /start -> termination over a process-spanning mesh.
 
     Same contract and SAME compiled executable as
@@ -160,6 +160,8 @@ def run_consensus_multihost(cfg: SimConfig, state: NetState,
     any host); ``final`` leaves are global arrays — reduce them on-device
     (sweep.summarize_final) or gather with
     jax.experimental.multihost_utils.process_allgather(..., tiled=True).
+    Under cfg.record the (replicated) flight recorder is appended as a
+    third output, like every other runner.
     """
     meshlib.check_divisible(cfg.trials, cfg.n_nodes, mesh)
     _check_global(state, faults, (cfg.trials, cfg.n_nodes))
@@ -169,8 +171,8 @@ def run_consensus_multihost(cfg: SimConfig, state: NetState,
 
 def run_consensus_slice_multihost(cfg: SimConfig, state: NetState,
                                   faults: FaultSpec, base_key: jax.Array,
-                                  mesh: Mesh, from_round,
-                                  until_round) -> Tuple[jax.Array, NetState]:
+                                  mesh: Mesh, from_round, until_round,
+                                  recorder=None):
     """Mid-run observability (cfg.poll_rounds) on a process-spanning mesh.
 
     Counterpart of sharded.run_consensus_slice_sharded with global inputs
@@ -179,24 +181,35 @@ def run_consensus_slice_multihost(cfg: SimConfig, state: NetState,
     the same replicated next_round, so all hosts stay in lockstep while a
     poller on any host watches its local slab's k grow.  A sliced
     multi-host run is bit-identical to the uninterrupted one — randomness
-    keys on (base_key, round, phase, global ids), never loop entry."""
+    keys on (base_key, round, phase, global ids), never loop entry.
+
+    Under cfg.record the (replicated) flight recorder threads through
+    like every other slice primitive: pass the previous slice's buffer,
+    None starts a fresh one; the filled buffer is the third output."""
     meshlib.check_divisible(cfg.trials, cfg.n_nodes, mesh)
     _check_global(state, faults, (cfg.trials, cfg.n_nodes))
-    return sharded._compiled_slice(cfg, mesh)(
-        state, faults, base_key, jnp.int32(from_round),
-        jnp.int32(until_round))
+    args = (state, faults, base_key, jnp.int32(from_round),
+            jnp.int32(until_round))
+    if cfg.record:
+        if recorder is None:
+            from ..state import new_recorder
+            recorder = new_recorder(cfg, state)
+        args = args + (recorder,)
+    return sharded._compiled_slice(cfg, mesh)(*args)
 
 
 def resume_consensus_multihost(cfg: SimConfig, state: NetState,
                                faults: FaultSpec, base_key: jax.Array,
-                               mesh: Mesh,
-                               from_round: int) -> Tuple[jax.Array, NetState]:
+                               mesh: Mesh, from_round: int):
     """Checkpoint re-entry on a process-spanning mesh (SURVEY §5.4).
 
     Counterpart of sharded.resume_consensus_sharded with global inputs: a
     checkpoint written by ANY run (single-device, single-process mesh, or
     another multi-host shape) resumes bit-identically here, because
-    randomness keys on (base_key, round, phase, global ids) only."""
+    randomness keys on (base_key, round, phase, global ids) only.  Under
+    cfg.record a FRESH (re-entry) flight recorder is appended — rows
+    before ``from_round`` stay unwritten (utils/metrics.py renders such
+    gapped buffers by true round index)."""
     meshlib.check_divisible(cfg.trials, cfg.n_nodes, mesh)
     _check_global(state, faults, (cfg.trials, cfg.n_nodes))
     return sharded._compiled(cfg, mesh, fresh=False)(

@@ -20,7 +20,6 @@ The whole run stays inside one jitted while_loop: zero host round-trips.
 from __future__ import annotations
 
 import functools
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -55,7 +54,7 @@ MESH_CTX = ShardCtx(trial_axis=meshlib.AXIS_TRIALS,
 
 def _local_run(cfg: SimConfig, fresh: bool, state: NetState,
                faults: FaultSpec, base_key: jax.Array,
-               from_round: jax.Array) -> Tuple[jax.Array, NetState]:
+               from_round: jax.Array):
     """Per-shard body: /start (or checkpoint re-entry) -> termination loop.
 
     ``fresh`` (static) applies the /start transition; a resume re-enters
@@ -72,23 +71,34 @@ def _local_run(cfg: SimConfig, fresh: bool, state: NetState,
     deadlock the collectives inside the body).
 
     Implemented as an unbounded _local_slice (until_round past the cap),
-    so the round loop exists ONCE.
+    so the round loop exists ONCE.  With cfg.record the flight recorder
+    is created in-shard (its rows are psum-globalized, so every shard
+    holds the identical replicated buffer) and returned as a third
+    output.
     """
     if fresh:
         state = start_state(cfg, state)
-    r, state = _local_slice(cfg, state, faults, base_key, from_round,
-                            jnp.int32(cfg.max_rounds + 1))
+    out = _local_slice(cfg, state, faults, base_key, from_round,
+                       jnp.int32(cfg.max_rounds + 1))
+    if cfg.record:
+        r, state, recorder = out
+        return r - 1, state, recorder
+    r, state = out
     return r - 1, state
 
 
 @functools.lru_cache(maxsize=None)
 def _compiled(cfg: SimConfig, mesh: Mesh, fresh: bool = True):
     sspec = meshlib.STATE_SPEC
+    # the flight recorder (cfg.record) is a replicated extra output: its
+    # rows are psum/pmax-globalized before every write, so each shard
+    # computes the identical buffer
+    out_specs = (P(), sspec) + ((P(),) if cfg.record else ())
     fn = shard_map(
         functools.partial(_local_run, cfg, fresh),
         mesh=mesh,
         in_specs=(sspec, sspec, P(), P()),
-        out_specs=(P(), sspec),
+        out_specs=out_specs,
         check_vma=False,  # while_loop results can't be proven replicated
     )
     return jax.jit(fn)
@@ -106,11 +116,13 @@ def shard_inputs(state: NetState, faults: FaultSpec, mesh: Mesh):
 
 
 def run_consensus_sharded(cfg: SimConfig, state: NetState, faults: FaultSpec,
-                          base_key: jax.Array,
-                          mesh: Mesh) -> Tuple[jax.Array, NetState]:
+                          base_key: jax.Array, mesh: Mesh):
     """Run /start -> termination over a ('trials','nodes') device mesh.
 
-    Same contract as sim.run_consensus; results are bit-identical to it.
+    Same contract as sim.run_consensus (including the extra flight
+    recorder output under cfg.record — the sharded recorder is
+    bit-identical to the single-device one, since every row is
+    psum-globalized before its write); results are bit-identical to it.
     """
     meshlib.check_divisible(cfg.trials, cfg.n_nodes, mesh)
     state, faults = shard_inputs(state, faults, mesh)
@@ -119,8 +131,7 @@ def run_consensus_sharded(cfg: SimConfig, state: NetState, faults: FaultSpec,
 
 def _local_slice_packed(cfg: SimConfig, state: NetState, faults: FaultSpec,
                         base_key: jax.Array, from_round: jax.Array,
-                        until_round: jax.Array
-                        ) -> Tuple[jax.Array, NetState]:
+                        until_round: jax.Array, recorder=None):
     """The fused-round fast path of _local_slice: the PACKED per-lane
     word is the while-loop carry (the sharded counterpart of
     pallas_round.run_packed).
@@ -135,12 +146,12 @@ def _local_slice_packed(cfg: SimConfig, state: NetState, faults: FaultSpec,
     from ..ops.pallas_round import run_packed_slice
 
     return run_packed_slice(cfg, state, faults, base_key, from_round,
-                            until_round, MESH_CTX)
+                            until_round, MESH_CTX, recorder=recorder)
 
 
 def _local_slice(cfg: SimConfig, state: NetState, faults: FaultSpec,
                  base_key: jax.Array, from_round: jax.Array,
-                 until_round: jax.Array) -> Tuple[jax.Array, NetState]:
+                 until_round: jax.Array, recorder=None):
     """Per-shard slice body: at most ``until_round - from_round`` rounds.
 
     The sharded counterpart of sim.run_consensus_slice (same contract:
@@ -154,40 +165,63 @@ def _local_slice(cfg: SimConfig, state: NetState, faults: FaultSpec,
     carries the packed state word instead of NetState — see
     _local_slice_packed — matching sim.run_consensus's run_packed
     dispatch, with bit-identical results.
+
+    With cfg.record the flight recorder threads through (created fresh
+    when ``recorder`` is None) and is returned as a third output —
+    replicated, since every row write is psum-globalized first.
     """
     from ..ops.tally import pallas_round_active
+    from ..sim import warn_debug_demotes_pallas
+    from ..state import new_recorder
 
-    if pallas_round_active(cfg) and not cfg.debug:
-        return _local_slice_packed(cfg, state, faults, base_key,
-                                   from_round, until_round)
     ctx = MESH_CTX
+    pallas = pallas_round_active(cfg)
+    if pallas and cfg.debug:
+        warn_debug_demotes_pallas(cfg)
+    if pallas and not cfg.debug:
+        return _local_slice_packed(cfg, state, faults, base_key,
+                                   from_round, until_round,
+                                   recorder=recorder)
+    if cfg.record and recorder is None:
+        recorder = new_recorder(cfg, state, ctx)
 
     def body(carry):
-        r, st, _ = carry
-        st = benor_round(cfg, st, faults, base_key, r, ctx)
+        r, st = carry[0], carry[1]
+        if cfg.record:
+            st, rec = benor_round(cfg, st, faults, base_key, r, ctx,
+                                  recorder=carry[3])
+        else:
+            st = benor_round(cfg, st, faults, base_key, r, ctx)
         if cfg.debug:
             from ..utils.tracing import emit_round_event
             emit_round_event(st, ctx)
-        return (r + 1, st, all_settled(st, ctx))
+        out = (r + 1, st, all_settled(st, ctx))
+        return out + ((rec,) if cfg.record else ())
 
     def cond(carry):
-        r, _, settled = carry
+        r, settled = carry[0], carry[2]
         return (r <= cfg.max_rounds) & ~settled & (r < until_round)
 
-    r, state, _ = jax.lax.while_loop(
-        cond, body,
-        (from_round.astype(jnp.int32), state, all_settled(state, ctx)))
-    return r, state
+    carry = (from_round.astype(jnp.int32), state, all_settled(state, ctx))
+    if cfg.record:
+        carry = carry + (recorder,)
+    out = jax.lax.while_loop(cond, body, carry)
+    if cfg.record:
+        return out[0], out[1], out[3]
+    return out[0], out[1]
 
 
 @functools.lru_cache(maxsize=None)
 def _compiled_slice(cfg: SimConfig, mesh: Mesh):
     sspec = meshlib.STATE_SPEC
+    # under cfg.record the recorder is a replicated extra INPUT (so poll
+    # slices keep filling one buffer) and extra output
+    rec = (P(),) if cfg.record else ()
     fn = shard_map(
         functools.partial(_local_slice, cfg),
         mesh=mesh,
-        in_specs=(sspec, sspec, P(), P(), P()),
-        out_specs=(P(), sspec),
+        in_specs=(sspec, sspec, P(), P(), P()) + rec,
+        out_specs=(P(), sspec) + rec,
         check_vma=False,
     )
     return jax.jit(fn)
@@ -195,33 +229,41 @@ def _compiled_slice(cfg: SimConfig, mesh: Mesh):
 
 def run_consensus_slice_sharded(cfg: SimConfig, state: NetState,
                                 faults: FaultSpec, base_key: jax.Array,
-                                mesh: Mesh, from_round, until_round
-                                ) -> Tuple[jax.Array, NetState]:
+                                mesh: Mesh, from_round, until_round,
+                                recorder=None):
     """Mid-run observability (cfg.poll_rounds) under a device mesh.
 
-    Same semantics as sim.run_consensus_slice; because every random draw
-    is keyed on global (trial, node, round) ids, a sliced sharded run is
-    bit-identical to the one-shot sharded run AND to the single-device
-    run for any mesh shape (tests/test_parallel.py pins both).
+    Same semantics as sim.run_consensus_slice (including the recorder
+    threading under cfg.record: pass the previous slice's buffer, None
+    starts a fresh one); because every random draw is keyed on global
+    (trial, node, round) ids, a sliced sharded run is bit-identical to
+    the one-shot sharded run AND to the single-device run for any mesh
+    shape (tests/test_parallel.py pins both).
     """
     meshlib.check_divisible(cfg.trials, cfg.n_nodes, mesh)
     state, faults = shard_inputs(state, faults, mesh)
-    return _compiled_slice(cfg, mesh)(state, faults, base_key,
-                                      jnp.int32(from_round),
-                                      jnp.int32(until_round))
+    args = (state, faults, base_key, jnp.int32(from_round),
+            jnp.int32(until_round))
+    if cfg.record:
+        if recorder is None:
+            from ..state import new_recorder
+            recorder = new_recorder(cfg, state)
+        args = args + (recorder,)
+    return _compiled_slice(cfg, mesh)(*args)
 
 
 def resume_consensus_sharded(cfg: SimConfig, state: NetState,
                              faults: FaultSpec, base_key: jax.Array,
-                             mesh: Mesh,
-                             from_round: int) -> Tuple[jax.Array, NetState]:
+                             mesh: Mesh, from_round: int):
     """Re-enter the round loop from a checkpointed round index on a mesh.
 
     Sharded counterpart of sim.resume_consensus: a checkpoint written by a
     single-device (or any-mesh) run resumes bit-identically on any mesh
     shape.  ``from_round`` is the 1-based next round (checkpoint's
     ``next_round``); it is traced, so resumes at different rounds share one
-    compiled executable."""
+    compiled executable.  Under cfg.record a FRESH (re-entry) recorder is
+    appended as a third output — rows before ``from_round`` stay
+    unwritten (utils/metrics.py renders gapped buffers by round index)."""
     meshlib.check_divisible(cfg.trials, cfg.n_nodes, mesh)
     state, faults = shard_inputs(state, faults, mesh)
     return _compiled(cfg, mesh, fresh=False)(state, faults, base_key,

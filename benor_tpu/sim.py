@@ -14,6 +14,7 @@ completes round r (node.ts:147).
 from __future__ import annotations
 
 import functools
+import warnings
 from typing import Optional, Tuple
 
 import jax
@@ -21,7 +22,34 @@ import jax.numpy as jnp
 
 from .config import SimConfig
 from .models.benor import all_settled, benor_round
-from .state import FaultSpec, NetState, init_state
+from .state import FaultSpec, NetState, init_state, new_recorder
+
+#: One warning per process for the debug-demotes-pallas perf cliff.
+_debug_demotion_warned = False
+
+
+def warn_debug_demotes_pallas(cfg: SimConfig) -> None:
+    """cfg.debug silently routes a fused-pallas-eligible config onto the
+    per-round XLA loop (the host-callback escape hatch cannot live inside
+    the packed kernels) — observing the run CHANGES the code that
+    executes.  Emit one loud process-wide warning the first time a
+    pallas-eligible config is demoted, so 'zero-cost tracing' is never
+    read as covering the fused regime.  cfg.record is the
+    non-perturbing alternative (the flight recorder runs INSIDE the
+    fused loop)."""
+    global _debug_demotion_warned
+    if _debug_demotion_warned:
+        return
+    _debug_demotion_warned = True
+    warnings.warn(
+        "SimConfig(debug=True) demotes this fused-pallas-eligible config "
+        "to the per-round XLA loop (host debug callbacks cannot run "
+        "inside the packed kernels): results are bit-identical via the "
+        "XLA samplers' own streams only where the paths share streams, "
+        "and the run is substantially slower.  For non-perturbing "
+        "per-round telemetry use SimConfig(record=True) — the flight "
+        "recorder fills on-device inside the fused loop.",
+        stacklevel=3)
 
 
 def start_state(cfg: SimConfig, state: NetState) -> NetState:
@@ -31,44 +59,73 @@ def start_state(cfg: SimConfig, state: NetState) -> NetState:
 
 
 def _run_body(cfg: SimConfig, faults: FaultSpec, base_key: jax.Array, carry,
-              dyn=None):
-    r, state = carry
-    state = benor_round(cfg, state, faults, base_key, r, dyn=dyn)
+              dyn=None, ctx=None):
+    """One while-loop iteration.  ``carry`` is (r, state) — or
+    (r, state, recorder) when cfg.record, the flight-recorder buffer
+    riding the carry so every executed round writes its row on device.
+    ``ctx`` (ShardCtx or None=single-device) is threaded into the round
+    kernel AND the debug callback, so a shard_map'd caller of
+    run_consensus_traced gets one psum-globalized event per round instead
+    of per-shard duplicates."""
+    from .ops.collectives import SINGLE
+    ctx = SINGLE if ctx is None else ctx
+    if cfg.record:
+        r, state, recorder = carry
+        state, recorder = benor_round(cfg, state, faults, base_key, r,
+                                      ctx, dyn=dyn, recorder=recorder)
+    else:
+        r, state = carry
+        state = benor_round(cfg, state, faults, base_key, r, ctx, dyn=dyn)
     if cfg.debug:  # per-round host callback (SURVEY §5.1); zero cost if off
         from .utils.tracing import emit_round_event
-        emit_round_event(state)
-    return (r + 1, state)
+        emit_round_event(state, ctx if ctx is not SINGLE else None)
+    return (r + 1, state, recorder) if cfg.record else (r + 1, state)
 
 
-def _run_cond(cfg: SimConfig, carry):
-    r, state = carry
-    return (r <= cfg.max_rounds) & ~all_settled(state)
+def _run_cond(cfg: SimConfig, carry, ctx=None):
+    from .ops.collectives import SINGLE
+    r, state = carry[0], carry[1]
+    return (r <= cfg.max_rounds) & ~all_settled(state, SINGLE if ctx is None
+                                                else ctx)
 
 
 @functools.partial(jax.jit, static_argnums=0)
 def run_consensus(cfg: SimConfig, state: NetState, faults: FaultSpec,
-                  base_key: jax.Array) -> Tuple[jax.Array, NetState]:
+                  base_key: jax.Array):
     """Run from /start to termination or round cap.
 
-    Returns (rounds_executed, final_state).  jit-compiled once per config
-    (SimConfig is static/hashable); the loop is on-device, zero host round
-    trips per round.  In the fused-kernel regime
-    (tally.pallas_round_active) the loop carries the PACKED per-lane state
-    word instead of NetState — pack/unpack and every per-lane XLA op run
-    once per RUN, not per round — with bit-identical results (the kernels
-    share the unfused path's exact random streams).
+    Returns (rounds_executed, final_state) — plus the filled
+    flight-recorder buffer as a third element when ``cfg.record`` is set.
+    jit-compiled once per config (SimConfig is static/hashable); the loop
+    is on-device, zero host round trips per round.  In the fused-kernel
+    regime (tally.pallas_round_active) the loop carries the PACKED
+    per-lane state word instead of NetState — pack/unpack and every
+    per-lane XLA op run once per RUN, not per round — with bit-identical
+    results (the kernels share the unfused path's exact random streams).
+
+    PERF CLIFF — ``cfg.debug`` is NOT zero-cost in the fused regime: the
+    per-round host callbacks cannot run inside the packed kernels, so a
+    pallas-round-eligible config with debug=True is silently DEMOTED to
+    the per-round XLA loop (a one-time warning fires;
+    warn_debug_demotes_pallas).  Off the fused regime debug=True traces
+    in one callback per round and debug=False costs nothing, as before.
+    ``cfg.record`` (the flight recorder) is the observation mechanism
+    that does NOT change which code runs.
     """
     from .ops.tally import pallas_round_active
 
-    if pallas_round_active(cfg) and not cfg.debug:
-        from .ops.pallas_round import run_packed
-        return run_packed(cfg, state, faults, base_key)
+    if pallas_round_active(cfg):
+        if cfg.debug:
+            warn_debug_demotes_pallas(cfg)
+        else:
+            from .ops.pallas_round import run_packed
+            return run_packed(cfg, state, faults, base_key)
     return run_consensus_traced(cfg, state, faults, base_key, None)
 
 
 def run_consensus_traced(cfg: SimConfig, state: NetState, faults: FaultSpec,
                          base_key: jax.Array,
-                         dyn=None) -> Tuple[jax.Array, NetState]:
+                         dyn=None, ctx=None):
     """The round loop as a plain traceable function with a DYNAMIC fault
     parameter — the building block of the batched dynamic-F sweep engine
     (sweep.run_curve_batched), which vmaps it over a [B] batch of
@@ -82,6 +139,12 @@ def run_consensus_traced(cfg: SimConfig, state: NetState, faults: FaultSpec,
     dyn=None this IS run_consensus's XLA loop, bit-for-bit.  Not jitted:
     callers embed it in their own jit (run_consensus above, or the
     batched engine's bucket executable).
+
+    ``ctx`` (ShardCtx or None) names the mesh axes when this loop is
+    embedded under shard_map: tallies, the termination predicate AND the
+    cfg.debug round events then psum-globalize instead of emitting
+    per-shard duplicates.  Returns (rounds, state), with the filled
+    flight recorder appended when cfg.record.
     """
     from .ops.tally import pallas_round_active
 
@@ -91,38 +154,66 @@ def run_consensus_traced(cfg: SimConfig, state: NetState, faults: FaultSpec,
             "bucket such configs statically (sweep.quorum_specialized)")
     state = start_state(cfg, state)
     carry = (jnp.int32(1), state)
-    r, state = jax.lax.while_loop(
-        functools.partial(_run_cond, cfg),
-        functools.partial(_run_body, cfg, faults, base_key, dyn=dyn),
+    if cfg.record:
+        carry = carry + (new_recorder(cfg, state, ctx),)
+    out = jax.lax.while_loop(
+        functools.partial(_run_cond, cfg, ctx=ctx),
+        functools.partial(_run_body, cfg, faults, base_key, dyn=dyn,
+                          ctx=ctx),
         carry)
+    if cfg.record:
+        r, state, recorder = out
+        return r - 1, state, recorder
+    r, state = out
     return r - 1, state
 
 
 def resume_consensus(cfg: SimConfig, state: NetState, faults: FaultSpec,
-                     base_key: jax.Array, from_round: int):
-    """Re-enter the round loop from a checkpointed round index (SURVEY §5.4)."""
+                     base_key: jax.Array, from_round: int, recorder=None):
+    """Re-enter the round loop from a checkpointed round index (SURVEY §5.4).
+
+    With cfg.record, pass the checkpointed run's ``recorder`` to keep
+    filling it (None starts a fresh buffer whose rows before
+    ``from_round`` stay zero except the re-entry snapshot in row 0) and
+    the return gains the recorder as a third element."""
     from .ops.tally import pallas_round_active
 
-    if pallas_round_active(cfg) and not cfg.debug:
+    pallas = pallas_round_active(cfg)
+    if pallas and cfg.debug:
+        warn_debug_demotes_pallas(cfg)
+    if pallas and not cfg.debug:
         # same fused dispatch as run_consensus: the packed loop serves
         # resume too (randomness keys on (key, round), never loop entry)
         from .ops.pallas_round import run_packed_slice
-        r, state = run_packed_slice(cfg, state, faults, base_key,
-                                    jnp.int32(from_round),
-                                    jnp.int32(cfg.max_rounds + 2))
+        out = run_packed_slice(cfg, state, faults, base_key,
+                               jnp.int32(from_round),
+                               jnp.int32(cfg.max_rounds + 2),
+                               recorder=recorder)
+        if cfg.record:
+            r, state, recorder = out
+            return r - 1, state, recorder
+        r, state = out
         return r - 1, state
     carry = (jnp.int32(from_round), state)
-    r, state = jax.lax.while_loop(
+    if cfg.record:
+        if recorder is None:
+            recorder = new_recorder(cfg, state)
+        carry = carry + (recorder,)
+    out = jax.lax.while_loop(
         functools.partial(_run_cond, cfg),
         functools.partial(_run_body, cfg, faults, base_key),
         carry)
+    if cfg.record:
+        r, state, recorder = out
+        return r - 1, state, recorder
+    r, state = out
     return r - 1, state
 
 
 @functools.partial(jax.jit, static_argnums=0)
 def run_consensus_slice(cfg: SimConfig, state: NetState, faults: FaultSpec,
                         base_key: jax.Array, from_round: jax.Array,
-                        until_round: jax.Array):
+                        until_round: jax.Array, recorder=None):
     """At most ``until_round - from_round`` rounds of the compiled loop.
 
     The slice primitive behind mid-run observability (cfg.poll_rounds):
@@ -139,21 +230,35 @@ def run_consensus_slice(cfg: SimConfig, state: NetState, faults: FaultSpec,
     In the fused-round regime the slice runs the packed loop
     (run_packed_slice — the same dispatch run_consensus and the sharded
     runner make), with bit-identical results.
+
+    With cfg.record, ``recorder`` threads the flight-recorder buffer
+    across slices (None builds a fresh one, row 0 snapshotting ``state``)
+    and the filled buffer is appended to the return — slice-by-slice
+    filling is bit-identical to the one-shot run's recorder.
     """
     from .ops.tally import pallas_round_active
 
-    if pallas_round_active(cfg) and not cfg.debug:
+    pallas = pallas_round_active(cfg)
+    if pallas and cfg.debug:
+        warn_debug_demotes_pallas(cfg)
+    if pallas and not cfg.debug:
         from .ops.pallas_round import run_packed_slice
         return run_packed_slice(cfg, state, faults, base_key,
-                                from_round, until_round)
+                                from_round, until_round, recorder=recorder)
     carry = (jnp.int32(from_round), state)
+    if cfg.record:
+        if recorder is None:
+            recorder = new_recorder(cfg, state)
+        carry = carry + (recorder,)
 
     def cond(carry):
-        r, st = carry
-        return _run_cond(cfg, carry) & (r < until_round)
+        return _run_cond(cfg, carry) & (carry[0] < until_round)
 
-    r, state = jax.lax.while_loop(
+    out = jax.lax.while_loop(
         cond, functools.partial(_run_body, cfg, faults, base_key), carry)
+    if cfg.record:
+        return out
+    r, state = out
     return r, state
 
 
@@ -164,7 +269,8 @@ def simulate(cfg: SimConfig, initial_values, faulty_list=None,
     ``faulty_list`` is the reference's launch-time fault vector
     (launchNodes.ts:8); ``crash_rounds`` is required for
     fault_model='crash_at_round'; pass ``faults`` directly for fully
-    per-trial specs.
+    per-trial specs.  With cfg.record the filled flight recorder is
+    appended: (rounds, state, faults, recorder).
     """
     if faults is None:
         if faulty_list is None:
@@ -172,5 +278,8 @@ def simulate(cfg: SimConfig, initial_values, faulty_list=None,
         faults = FaultSpec.from_faulty_list(cfg, faulty_list, crash_rounds)
     state = init_state(cfg, initial_values, faults)
     base_key = jax.random.key(cfg.seed)
+    if cfg.record:
+        rounds, final, recorder = run_consensus(cfg, state, faults, base_key)
+        return rounds, final, faults, recorder
     rounds, final = run_consensus(cfg, state, faults, base_key)
     return rounds, final, faults

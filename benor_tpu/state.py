@@ -152,6 +152,93 @@ class FaultSpec:
                    crash_round=jnp.zeros((trials, n_nodes), jnp.int32))
 
 
+# --------------------------------------------------------------------------
+# Flight recorder (SimConfig.record): the on-device round-history buffer.
+#
+# One int32 row per executed round, written inside the compiled while-loop
+# via dynamic_update_slice — full round history for one extra HBM buffer
+# and zero host round trips, in EVERY regime (traced XLA, fused pallas,
+# sliced poll_rounds, batched dynamic-F sweep, sharded mesh).  Row 0 is the
+# post-/start snapshot; row r (1-based) is the network at the END of round
+# r; unwritten rows stay all-zero (distinguishable: a written row's
+# decided + killed + undecided classes sum to T*N >= 1).
+# --------------------------------------------------------------------------
+
+#: Recorder columns.  All network-global counts (summed over trials AND
+#: nodes) except REC_MARGIN — the tally-margin summary, sum over trials of
+#: the per-trial MAX |v0 - v1| vote margin over lanes that ran the vote
+#: phase (a max, not a sum, so int32 cannot overflow at N=1M x 1k trials;
+#: 0 everywhere = the count-controlling adversary's forced-tie livelock).
+REC_DECIDED = 0   # decided lanes (cumulative)
+REC_KILLED = 1    # killed lanes
+REC_UNDEC0 = 2    # live undecided lanes holding x=0
+REC_UNDEC1 = 3    # live undecided lanes holding x=1
+REC_UNDECQ = 4    # live undecided lanes holding "?"
+REC_COINS = 5     # lanes that committed a coin flip this round
+REC_MARGIN = 6    # tally-margin summary (see above); 0 on row 0
+REC_WIDTH = 7
+
+#: Column names, index-aligned with the REC_* constants — the single
+#: source of truth for every host-side renderer (utils/metrics.py).
+REC_COLUMNS = ("decided", "killed", "undecided_0", "undecided_1",
+               "undecided_q", "coin_flips", "tally_margin")
+
+
+def recorder_snapshot_row(x: jax.Array, decided: jax.Array,
+                          killed: jax.Array, ctx=None) -> jax.Array:
+    """Network-global recorder row from raw state fields -> int32 [REC_WIDTH].
+
+    Used for row 0 (post-/start snapshot: no votes yet, so coin-flip count
+    and tally margin are 0).  Under a mesh ``ctx`` the counts are psum'd
+    over every axis, so each shard holds the identical global row.
+    """
+    from .ops.collectives import SINGLE
+    ctx = SINGLE if ctx is None else ctx
+    undec = ~decided & ~killed
+    cols = [decided, killed, undec & (x == VAL0), undec & (x == VAL1),
+            undec & (x == VALQ)]
+    counts = [ctx.psum_all(jnp.sum(c, dtype=jnp.int32)) for c in cols]
+    zero = jnp.int32(0)
+    return jnp.stack(counts + [zero, zero])
+
+
+def recorder_round_row(x: jax.Array, decided: jax.Array, killed: jax.Array,
+                       coined: jax.Array, margin: jax.Array,
+                       ctx=None) -> jax.Array:
+    """Full end-of-round recorder row -> int32 [REC_WIDTH].
+
+    ``x``/``decided``/``killed`` are the committed post-round fields;
+    ``coined`` bool [T, N] marks lanes that committed a coin flip;
+    ``margin`` int32 [T, N] is each vote-phase lane's |v0 - v1| (0 for
+    lanes that did not run the phase).  Counts psum over every mesh axis;
+    the margin column is pmax over the node axis (per-trial max), then a
+    trial sum — see REC_MARGIN.
+    """
+    from .ops.collectives import SINGLE
+    ctx = SINGLE if ctx is None else ctx
+    base = recorder_snapshot_row(x, decided, killed, ctx)
+    coins = ctx.psum_all(jnp.sum(coined, dtype=jnp.int32))
+    per_trial_max = ctx.pmax_nodes(jnp.max(margin, axis=-1))
+    marg = ctx.psum_trials(jnp.sum(per_trial_max, dtype=jnp.int32))
+    return base.at[REC_COINS].set(coins).at[REC_MARGIN].set(marg)
+
+
+def recorder_write(recorder: jax.Array, r: jax.Array,
+                   row: jax.Array) -> jax.Array:
+    """Write one row at (traced) round index ``r`` — the loop-body update."""
+    return jax.lax.dynamic_update_slice(
+        recorder, row[None, :], (jnp.asarray(r, jnp.int32), jnp.int32(0)))
+
+
+def new_recorder(cfg: SimConfig, state: NetState, ctx=None) -> jax.Array:
+    """Fresh [max_rounds + 1, REC_WIDTH] int32 buffer with row 0 set to the
+    snapshot of ``state``.  Traceable (callers embed it in their jits) and
+    mesh-safe (``ctx`` globalizes the row-0 counts)."""
+    rec = jnp.zeros((cfg.max_rounds + 1, REC_WIDTH), jnp.int32)
+    row0 = recorder_snapshot_row(state.x, state.decided, state.killed, ctx)
+    return rec.at[0].set(row0)
+
+
 def init_state(cfg: SimConfig, initial_values, faults: FaultSpec) -> NetState:
     """Build the T x N state arrays from per-node initial values.
 

@@ -52,10 +52,16 @@ class SweepPoint:
     #: model, reachable under quorum sampling + split adversaries or
     #: byzantine faults; see PARITY.md "Findings beyond the reference").
     disagree_frac: float = 0.0
+    #: Flight-recorder round history (cfg.record): int32
+    #: [max_rounds + 1, state.REC_WIDTH], row r = network at end of round
+    #: r (state.REC_COLUMNS names the columns); None when record is off.
+    round_history: Optional[np.ndarray] = None
 
     def to_dict(self) -> Dict:
         d = dataclasses.asdict(self)
         d["k_hist"] = self.k_hist.tolist()
+        if self.round_history is not None:
+            d["round_history"] = self.round_history.tolist()
         return d
 
 
@@ -161,13 +167,16 @@ def run_point(cfg: SimConfig, initial_values=None, faulty_list=None,
     state = init_state(cfg, initial_values, faults)
     base_key = jax.random.key(cfg.seed)
 
-    # compile (cached across calls with the same static cfg)
-    r, final = run_consensus(cfg, state, faults, base_key)
-    int(r)  # completion barrier
+    # compile (cached across calls with the same static cfg); under
+    # cfg.record the run returns the flight recorder as a third output
+    out = run_consensus(cfg, state, faults, base_key)
+    int(out[0])  # completion barrier
     t0 = time.perf_counter()
-    r, final = run_consensus(cfg, state, faults, base_key)
-    rounds = int(r)  # completion barrier inside the timed window
+    out = run_consensus(cfg, state, faults, base_key)
+    rounds = int(out[0])  # completion barrier inside the timed window
     seconds = time.perf_counter() - t0
+    final = out[1]
+    history = np.asarray(out[2]) if cfg.record else None
 
     dec, mk, ones, khist, disagree = summarize_final(
         final, faults.faulty, cfg.max_rounds)
@@ -178,7 +187,7 @@ def run_point(cfg: SimConfig, initial_values=None, faulty_list=None,
         k_hist=np.asarray(khist).astype(np.int64), ones_frac=float(ones),
         seconds=seconds,
         trials_per_sec=cfg.trials / seconds if seconds > 0 else float("inf"),
-        disagree_frac=float(disagree))
+        disagree_frac=float(disagree), round_history=history)
 
 
 def rounds_vs_f(base_cfg: SimConfig, f_values: Sequence[int],
@@ -370,18 +379,30 @@ def run_curve_batched(base_cfg: SimConfig, f_values: Sequence[int],
             # state outputs — the carry lives in the donated buffers
             # instead of input + carry both being live.  The states are
             # never fetched; only the six summary outputs cross the wire.
+            # Under cfg.record each point's flight recorder joins the
+            # executable's outputs right before the (unfetched) final
+            # state — [B, R, REC_WIDTH] per dyn bucket, filled on device
+            # inside the same vmapped loop.
             if key[0] == "dyn":
                 def runner(states, faults, dyn, bk, _cfg=rep):
                     def one(s, fl, d):
-                        r, fin = run_consensus_traced(_cfg, s, fl, bk, d)
-                        return _summarize_inline(_cfg, r, fin, fl) + (fin,)
+                        out = run_consensus_traced(_cfg, s, fl, bk, d)
+                        r, fin = out[0], out[1]
+                        summ = _summarize_inline(_cfg, r, fin, fl)
+                        if _cfg.record:
+                            summ = summ + (out[2],)
+                        return summ + (fin,)
                     return jax.vmap(one, in_axes=(0, 0, 0))(
                         states, faults, dyn)
                 args = (b["states"], b["faults"], b["dyn"], base_key)
             else:
                 def runner(state, faults, bk, _cfg=rep):
-                    r, fin = run_consensus(_cfg, state, faults, bk)
-                    return _summarize_inline(_cfg, r, fin, faults) + (fin,)
+                    out = run_consensus(_cfg, state, faults, bk)
+                    r, fin = out[0], out[1]
+                    summ = _summarize_inline(_cfg, r, fin, faults)
+                    if _cfg.record:
+                        summ = summ + (out[2],)
+                    return summ + (fin,)
                 args = (b["states"], b["faults"], base_key)
             t0 = time.perf_counter()
             with warnings.catch_warnings():
@@ -420,7 +441,8 @@ def run_curve_batched(base_cfg: SimConfig, f_values: Sequence[int],
 def _assemble_points(cfgs, raw, secs) -> List[SweepPoint]:
     points = []
     for cfg_f, vals, s in zip(cfgs, raw, secs):
-        r, dec, mk, ones, khist, dis = vals
+        r, dec, mk, ones, khist, dis, *rest = vals
+        history = np.asarray(rest[0], np.int32) if rest else None
         points.append(SweepPoint(
             n_nodes=cfg_f.n_nodes, n_faulty=cfg_f.n_faulty,
             trials=cfg_f.trials, coin_mode=cfg_f.coin_mode,
@@ -429,7 +451,7 @@ def _assemble_points(cfgs, raw, secs) -> List[SweepPoint]:
             k_hist=np.asarray(khist).astype(np.int64),
             ones_frac=float(ones), seconds=s,
             trials_per_sec=(cfg_f.trials / s if s > 0 else float("inf")),
-            disagree_frac=float(dis)))
+            disagree_frac=float(dis), round_history=history))
     return points
 
 

@@ -39,15 +39,24 @@ def probe_backend(timeout_s: float,
     platform name ('tpu'/'axon'/'cpu'/...), or None on failure/timeout.
     ``log`` receives one diagnostic line on failure (rc + stderr tail, or
     the timeout)."""
+    from .metrics import REGISTRY
+
     say = log or (lambda s: None)
+    REGISTRY.counter("backend.probe_attempts").inc()
+    t0 = time.perf_counter()
     try:
         r = subprocess.run([sys.executable, "-c", PROBE_CODE],
                            capture_output=True, text=True,
                            timeout=timeout_s, cwd=cwd)
     except subprocess.TimeoutExpired:
+        REGISTRY.counter("backend.probe_timeouts").inc()
         say(f"backend probe timed out after {timeout_s:.0f}s")
         return None
+    finally:
+        REGISTRY.counter("backend.probe_seconds").inc(
+            time.perf_counter() - t0)
     if r.returncode != 0:
+        REGISTRY.counter("backend.probe_failures").inc()
         tail = (r.stderr or "").strip().splitlines()[-1:]
         say(f"backend probe failed rc={r.returncode} {tail}")
         return None

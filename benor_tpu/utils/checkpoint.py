@@ -100,10 +100,12 @@ def resume_from(path: str, mesh=None):
     cfg, state, faults, next_round, base_key = load_checkpoint(path)
     if mesh is not None:
         from ..parallel import resume_consensus_sharded
-        rounds, final = resume_consensus_sharded(
+        out = resume_consensus_sharded(
             cfg, state, faults, base_key, mesh, next_round)
     else:
         from ..sim import resume_consensus
-        rounds, final = resume_consensus(cfg, state, faults, base_key,
-                                         next_round)
+        out = resume_consensus(cfg, state, faults, base_key, next_round)
+    # under cfg.record the runners append the (resume-fresh) flight
+    # recorder; the checkpoint return contract stays (rounds, final, faults)
+    rounds, final = out[0], out[1]
     return rounds, final, faults

@@ -52,6 +52,11 @@ def _listener(event: str, duration: float, **kwargs) -> None:
     for c in active:
         c.count += 1
         c.seconds += duration
+    # unified metrics (utils/metrics.py): process-lifetime compile totals,
+    # exportable even when no scoped counter is open
+    from .metrics import REGISTRY
+    REGISTRY.counter("jax.backend_compiles").inc()
+    REGISTRY.counter("jax.backend_compile_seconds").inc(duration)
 
 
 def _ensure_installed() -> None:
@@ -63,6 +68,14 @@ def _ensure_installed() -> None:
 
         jax.monitoring.register_event_duration_secs_listener(_listener)
         _installed = True
+
+
+def install() -> None:
+    """Install the process-lifetime listener WITHOUT opening a scope —
+    for callers that only want the unified-metrics compile counters
+    (utils/metrics.REGISTRY) fed, e.g. the CLI's --metrics-out.  Must
+    run before the compiles it should observe."""
+    _ensure_installed()
 
 
 @contextlib.contextmanager

@@ -1,0 +1,339 @@
+"""Unified host-side metrics: one process-wide registry, three exporters.
+
+Before this module the repo had three disjoint accounting mechanisms —
+``utils/tracing.timed`` (ad-hoc stderr wall-clocks), ``utils/
+compile_counter`` (the jax.monitoring backend-compile hook) and the
+backend-probe retry loop (``utils/backend.py``) — none of which could be
+exported or correlated.  They now all feed ONE registry of counters /
+gauges / timers (each keeps feeding its original surface too: stderr
+lines, scoped CompileCounter objects), and the registry exports as:
+
+  * JSON-lines   (``export_jsonl``)      — one metric per line, grep/jq-able
+  * Prometheus   (``export_prometheus``) — textfile-collector format
+  * Chrome trace (``export_chrome_trace``) — Perfetto / chrome://tracing;
+    timer spans render as complete events on the host track, and a
+    flight-recorder buffer (SimConfig.record) renders as one trace slice
+    per protocol round on a synthetic round track — next to any
+    ``jax.profiler`` capture you take of the same run.
+
+The registry is dependency-free and import-cheap (stdlib only): the
+device-side flight recorder must never pay for host-side bookkeeping.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import re
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+# --------------------------------------------------------------------------
+# Registry
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Counter:
+    """Monotone accumulator (events, compiles, probe attempts)."""
+
+    name: str
+    value: float = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        with _REGISTRY_LOCK:
+            self.value += amount
+
+
+@dataclasses.dataclass
+class Gauge:
+    """Last-write-wins sample (sizes, utilizations, platform flags)."""
+
+    name: str
+    value: float = 0.0
+
+    def set(self, value: float) -> None:
+        with _REGISTRY_LOCK:
+            self.value = float(value)
+
+
+@dataclasses.dataclass
+class Timer:
+    """Duration accumulator; keeps per-span events for the trace export."""
+
+    name: str
+    count: int = 0
+    total_s: float = 0.0
+    min_s: float = float("inf")
+    max_s: float = 0.0
+    #: (start wall-clock epoch seconds, duration seconds) per span, in
+    #: record order — the Chrome-trace exporter's raw material.
+    events: List = dataclasses.field(default_factory=list)
+
+    def record(self, seconds: float, start: Optional[float] = None) -> None:
+        with _REGISTRY_LOCK:
+            self.count += 1
+            self.total_s += seconds
+            self.min_s = min(self.min_s, seconds)
+            self.max_s = max(self.max_s, seconds)
+            self.events.append(
+                (time.time() - seconds if start is None else start, seconds))
+
+    @contextlib.contextmanager
+    def time(self):
+        t0 = time.perf_counter()
+        start = time.time()
+        yield
+        self.record(time.perf_counter() - t0, start=start)
+
+
+_REGISTRY_LOCK = threading.RLock()
+
+
+class MetricsRegistry:
+    """Process-wide named metric store.  ``counter``/``gauge``/``timer``
+    are get-or-create (idempotent, thread-safe); ``snapshot`` returns
+    plain dicts for the exporters."""
+
+    def __init__(self) -> None:
+        self._metrics: Dict[str, object] = {}
+
+    def _get(self, name: str, cls):
+        with _REGISTRY_LOCK:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name=name)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, not {cls.__name__}")
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def timer(self, name: str) -> Timer:
+        return self._get(name, Timer)
+
+    def snapshot(self) -> List[dict]:
+        """All metrics as JSON-able dicts (one per metric, typed)."""
+        out = []
+        with _REGISTRY_LOCK:
+            for name in sorted(self._metrics):
+                m = self._metrics[name]
+                if isinstance(m, Counter):
+                    out.append({"name": name, "type": "counter",
+                                "value": m.value})
+                elif isinstance(m, Gauge):
+                    out.append({"name": name, "type": "gauge",
+                                "value": m.value})
+                else:
+                    out.append({
+                        "name": name, "type": "timer", "count": m.count,
+                        "total_s": round(m.total_s, 6),
+                        "min_s": (round(m.min_s, 6) if m.count else None),
+                        "max_s": round(m.max_s, 6),
+                    })
+        return out
+
+    def reset(self) -> None:
+        """Drop every metric (tests only — the registry is process-global)."""
+        with _REGISTRY_LOCK:
+            self._metrics.clear()
+
+
+#: The process-wide registry every instrumented module feeds.
+REGISTRY = MetricsRegistry()
+
+
+# --------------------------------------------------------------------------
+# Flight-recorder rendering (SimConfig.record buffers -> host structures)
+# --------------------------------------------------------------------------
+
+
+def written_round_indices(recorder) -> np.ndarray:
+    """Indices of recorder rows that were actually written, ascending.
+
+    A written row's decided + killed + undecided-class counts sum to
+    T*N >= 1, so an all-zero row marks a round the loop never wrote.
+    Contiguous in the common case (row 0 snapshot + rounds 1..R), but a
+    ``resume_consensus(..., recorder=None)`` buffer legitimately has a
+    GAP: row 0 re-snapshots the re-entry state and the next written row
+    is ``from_round`` — every renderer here keys rows by their true
+    round index instead of assuming contiguity.
+    """
+    rec = np.asarray(recorder)
+    return np.nonzero(rec[:, :5].sum(axis=1) > 0)[0]
+
+
+def executed_rows(recorder) -> np.ndarray:
+    """The written rows of a recorder buffer (see written_round_indices
+    for which), as int64 [n_written, REC_WIDTH]."""
+    rec = np.asarray(recorder).astype(np.int64)
+    return rec[written_round_indices(recorder)]
+
+
+def round_history_rows(recorder) -> List[dict]:
+    """Recorder buffer -> one dict per WRITTEN row, REC_COLUMNS-keyed plus
+    the row's true round index ("round": 0 = post-/start snapshot;
+    unwritten gap rows, e.g. before a fresh-buffer resume's re-entry
+    round, are skipped)."""
+    from ..state import REC_COLUMNS
+    rec = np.asarray(recorder).astype(np.int64)
+    rows = []
+    for r in written_round_indices(recorder):
+        d = {"round": int(r)}
+        d.update({col: int(v) for col, v in zip(REC_COLUMNS, rec[r])})
+        rows.append(d)
+    return rows
+
+
+def round_history_summary(recorder) -> dict:
+    """Derived science of one recorder buffer: the keys bench.py ships.
+
+      rounds_executed           written rounds (excluding the first row,
+                                the snapshot)
+      rounds_to_quiescence      first written round with zero undecided
+                                live lanes (None = never quiesced inside
+                                the history)
+      decide_velocity           newly decided lanes between consecutive
+                                WRITTEN rows (diff of the cumulative
+                                decided column) — per round in the common
+                                contiguous case; across a fresh-resume
+                                gap one entry aggregates the unobserved
+                                rounds
+      rounds_to_quiescence_hist histogram over lanes of their decide round
+                                (numerically the velocity, exposed as the
+                                lane-population histogram it is)
+      final                     the last written row, REC_COLUMNS-keyed
+    """
+    from ..state import (REC_COLUMNS, REC_DECIDED, REC_UNDEC0, REC_UNDEC1,
+                         REC_UNDECQ)
+    rows = executed_rows(recorder)
+    undec = rows[:, REC_UNDEC0] + rows[:, REC_UNDEC1] + rows[:, REC_UNDECQ]
+    quiesced = np.nonzero(undec == 0)[0]
+    idx = written_round_indices(recorder)
+    velocity = np.diff(rows[:, REC_DECIDED]).tolist()
+    return {
+        "rounds_executed": int(rows.shape[0] - 1),
+        "rounds_to_quiescence": (int(idx[quiesced[0]]) if quiesced.size
+                                 else None),
+        "decide_velocity": velocity,
+        "rounds_to_quiescence_hist": velocity,
+        "final": {c: int(v) for c, v in zip(REC_COLUMNS, rows[-1])},
+    }
+
+
+# --------------------------------------------------------------------------
+# Exporters
+# --------------------------------------------------------------------------
+
+
+def export_jsonl(path: str, registry: MetricsRegistry = None,
+                 extra: Optional[List[dict]] = None) -> int:
+    """Write the registry snapshot (plus optional extra records, e.g.
+    round_history_rows) as JSON-lines; returns the record count."""
+    registry = REGISTRY if registry is None else registry
+    records = registry.snapshot() + list(extra or [])
+    ts = time.time()
+    with open(path, "w") as fh:
+        for rec in records:
+            fh.write(json.dumps({"ts": ts, **rec}) + "\n")
+    return len(records)
+
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str, prefix: str) -> str:
+    return prefix + _PROM_BAD.sub("_", name)
+
+
+def export_prometheus(path: str, registry: MetricsRegistry = None,
+                      prefix: str = "benor_tpu_") -> int:
+    """Write the registry in Prometheus textfile-collector format (the
+    node_exporter drop-in contract: ``# TYPE`` headers + bare samples;
+    timers expand to _count/_seconds_total/_seconds_max).  Returns the
+    sample count."""
+    registry = REGISTRY if registry is None else registry
+    lines = []
+    n = 0
+    for m in registry.snapshot():
+        name = _prom_name(m["name"], prefix)
+        if m["type"] in ("counter", "gauge"):
+            lines.append(f"# TYPE {name} {m['type']}")
+            lines.append(f"{name} {m['value']}")
+            n += 1
+        else:
+            lines.append(f"# TYPE {name}_count counter")
+            lines.append(f"{name}_count {m['count']}")
+            lines.append(f"# TYPE {name}_seconds_total counter")
+            lines.append(f"{name}_seconds_total {m['total_s']}")
+            lines.append(f"# TYPE {name}_seconds_max gauge")
+            lines.append(f"{name}_seconds_max {m['max_s']}")
+            n += 3
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    return n
+
+
+def export_chrome_trace(path: str, registry: MetricsRegistry = None,
+                        round_history=None,
+                        rounds_label: str = "consensus") -> int:
+    """Write a Chrome-trace/Perfetto JSON file; returns the event count.
+
+    Timer spans land on pid 0 / tid "host" as complete ("X") events at
+    their real wall-clock offsets.  ``round_history`` (a flight-recorder
+    buffer) lands on tid "rounds" with a SYNTHETIC 1 ms-per-round
+    timescale — the recorder is filled on device with no per-round host
+    timestamps (that is the point) — each slice carrying its full
+    telemetry row in ``args``.  Counters/gauges become metadata counter
+    events.  Open in https://ui.perfetto.dev or chrome://tracing;
+    ``jax.profiler.trace`` captures of the same run sit alongside as
+    separate tracks when loaded together.
+    """
+    registry = REGISTRY if registry is None else registry
+    events = []
+    t0 = None
+    snap = registry.snapshot()
+    with _REGISTRY_LOCK:
+        timers = [(m.name, list(m.events))
+                  for m in registry._metrics.values()
+                  if isinstance(m, Timer)]
+    for _, evs in timers:
+        for start, _ in evs:
+            t0 = start if t0 is None else min(t0, start)
+    t0 = t0 or time.time()
+    for name, evs in timers:
+        for start, dur in evs:
+            events.append({
+                "name": name, "ph": "X", "pid": 0, "tid": "host",
+                "ts": (start - t0) * 1e6, "dur": dur * 1e6,
+            })
+    for m in snap:
+        if m["type"] in ("counter", "gauge"):
+            events.append({
+                "name": m["name"], "ph": "C", "pid": 0, "ts": 0,
+                "args": {m["type"]: m["value"]},
+            })
+    if round_history is not None:
+        for row in round_history_rows(round_history):
+            r = row["round"]
+            events.append({
+                "name": (f"{rounds_label} round {r}" if r
+                         else f"{rounds_label} start"),
+                "ph": "X", "pid": 0, "tid": "rounds",
+                "ts": r * 1000.0, "dur": 1000.0,
+                "args": {k: v for k, v in row.items() if k != "round"},
+            })
+    with open(path, "w") as fh:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, fh)
+    return len(events)

@@ -8,6 +8,16 @@ escape hatch: with ``SimConfig(debug=True)`` the simulator emits one
 #killed) — streamed to every registered sink without leaving the compiled
 while-loop.
 
+PERF CLIFF — debug is NOT zero-cost in the fused-pallas regime: host
+callbacks cannot run inside the packed round kernels, so a
+pallas-round-eligible config with debug=True is silently DEMOTED to the
+per-round XLA loop (sim.warn_debug_demotes_pallas fires once per
+process).  debug=False still costs nothing anywhere, and off the fused
+regime the callback cost is one async host transfer per round.  For
+observation that does not change which code runs, use
+``SimConfig(record=True)`` — the flight recorder fills on device inside
+the fused loop (README "Observability" has the decision table).
+
 ``profile_trace`` wraps ``jax.profiler.trace`` for XLA-level traces
 viewable in TensorBoard / Perfetto.
 """
@@ -97,9 +107,17 @@ def profile_trace(log_dir: str):
 
 @contextlib.contextmanager
 def timed(label: str, sink=None):
-    """Wall-clock a host-side block; prints to stderr by default."""
+    """Wall-clock a host-side block; prints to stderr by default.
+
+    Every span ALSO records into the unified metrics registry
+    (utils/metrics.REGISTRY timer ``label``), so ad-hoc timings show up
+    in the JSON-lines / Prometheus / Chrome-trace exports next to the
+    compile and probe counters."""
+    from .metrics import REGISTRY
+    start = time.time()
     t0 = time.perf_counter()
     yield
     dt = time.perf_counter() - t0
+    REGISTRY.timer(label).record(dt, start=start)
     msg = f"[benor_tpu] {label}: {dt * 1e3:.1f} ms"
     (sink or (lambda m: print(m, file=sys.stderr, flush=True)))(msg)

@@ -1,0 +1,234 @@
+"""Flight recorder (SimConfig.record): the on-device round-history buffer.
+
+Acceptance contract (ISSUE 2):
+  * identical per-round (decided, killed) series across the traced,
+    fused-pallas, sliced (poll_rounds), batched-sweep and sharded regimes
+    on the same seed;
+  * record=False leaves compile counts and results bit-identical
+    (asserted via utils/compile_counter).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from benor_tpu.config import SimConfig
+from benor_tpu.sim import (run_consensus, run_consensus_slice, simulate,
+                           start_state)
+from benor_tpu.state import (REC_COINS, REC_DECIDED, REC_KILLED, REC_MARGIN,
+                             REC_UNDEC0, REC_UNDEC1, REC_UNDECQ, REC_WIDTH,
+                             FaultSpec, init_state)
+from benor_tpu.sweep import balanced_inputs
+
+T, N = 8, 24
+
+#: The cross-path fixture: count-controlling adversary + common coin.
+#: Every regime — the XLA loop, the fused pallas round (counts_mode
+#: 'delivered', interpret-mode on CPU), slices, the batched dynamic-F
+#: engine and the sharded mesh — shares EVERY random bit here (closed-form
+#: counts, one per-trial shared coin), so the full recorder buffers must
+#: be bit-identical, not just the (decided, killed) series.
+ADV = dict(n_nodes=N, n_faulty=4, trials=T, delivery="quorum",
+           scheduler="adversarial", coin_mode="common", path="histogram",
+           max_rounds=12, seed=3, record=True)
+
+
+def _adv_inputs():
+    cfg = SimConfig(**ADV)
+    faults = FaultSpec.none(T, N)
+    state = init_state(cfg, balanced_inputs(T, N), faults)
+    return cfg, state, faults, jax.random.key(ADV["seed"])
+
+
+def _slice_all(cfg, state, faults, key, chunk):
+    """Drive run_consensus_slice to termination in ``chunk``-round steps,
+    threading one recorder across slices — the poll_rounds shape."""
+    st = start_state(cfg, state)
+    r, rec = jnp.int32(1), None
+    while True:
+        r_next, st, rec = run_consensus_slice(cfg, st, faults, key, r,
+                                              r + chunk, rec)
+        if int(r_next) == int(r) or int(r_next) > cfg.max_rounds:
+            break
+        r = r_next
+    return st, rec
+
+
+def test_series_identical_across_all_regimes():
+    """The acceptance pin: one seed, five regimes, one recorder."""
+    from benor_tpu.parallel import make_mesh, run_consensus_sharded
+    from benor_tpu.sweep import run_curve_batched
+
+    cfg, state, faults, key = _adv_inputs()
+    r, fin, rec = run_consensus(cfg, state, faults, key)
+    rec = np.asarray(rec)
+    assert int(r) >= 2                      # multi-round, or the pin is vacuous
+
+    # fused pallas round (bit-identical here: delivered counts + common coin)
+    cfg_p = cfg.replace(use_pallas_round=True)
+    from benor_tpu.ops.tally import pallas_round_active
+    assert pallas_round_active(cfg_p)
+    rp, finp, recp = run_consensus(cfg_p, state, faults, key)
+    assert int(rp) == int(r)
+    np.testing.assert_array_equal(rec, np.asarray(recp))
+    np.testing.assert_array_equal(np.asarray(fin.x), np.asarray(finp.x))
+
+    # sliced (poll_rounds shape), both compute paths
+    for c, chunk in ((cfg, 3), (cfg_p, 2)):
+        fin_s, rec_s = _slice_all(c, state, faults, key, chunk)
+        np.testing.assert_array_equal(rec, np.asarray(rec_s))
+        np.testing.assert_array_equal(np.asarray(fin.x),
+                                      np.asarray(fin_s.x))
+
+    # batched dynamic-F sweep (the adversarial curve is a dyn bucket)
+    cb = run_curve_batched(cfg.replace(n_faulty=0), [4, 6],
+                           initial_values=balanced_inputs(T, N),
+                           faults_for=lambda c: FaultSpec.none(T, N))
+    np.testing.assert_array_equal(rec, cb.points[0].round_history)
+
+    # sharded mesh (multiple shapes; counts psum'd before the row write)
+    for shape in ((2, 4), (1, 8), (4, 1)):
+        rs, fs, rec_m = run_consensus_sharded(cfg, state, faults, key,
+                                              make_mesh(*shape))
+        assert int(rs) == int(r)
+        np.testing.assert_array_equal(rec, np.asarray(rec_m))
+
+
+def test_uniform_dense_regimes_match():
+    """Same pin on the uniform scheduler's dense path (per-lane sampled
+    deliveries): traced vs sliced vs sharded share streams by the RNG
+    global-id contract, so recorders must agree bit-for-bit."""
+    from benor_tpu.parallel import make_mesh, run_consensus_sharded
+
+    cfg = SimConfig(n_nodes=16, n_faulty=4, trials=4, delivery="quorum",
+                    scheduler="uniform", max_rounds=16, seed=11,
+                    record=True)
+    faults = FaultSpec.from_faulty_list(cfg, [True] * 4 + [False] * 12)
+    state = init_state(cfg, [i % 2 for i in range(16)], faults)
+    key = jax.random.key(cfg.seed)
+    r, fin, rec = run_consensus(cfg, state, faults, key)
+    rec = np.asarray(rec)
+
+    fin_s, rec_s = _slice_all(cfg, state, faults, key, 2)
+    np.testing.assert_array_equal(rec, np.asarray(rec_s))
+
+    rs, fs, rec_m = run_consensus_sharded(cfg, state, faults, key,
+                                          make_mesh(2, 2))
+    np.testing.assert_array_equal(rec, np.asarray(rec_m))
+
+
+def test_record_off_results_and_compile_count():
+    """record=False must be indistinguishable from a build without the
+    feature: bit-identical results to record=True, and exactly ONE
+    backend compile for the run (the flag is static — no hidden extra
+    executables), measured by the jax.monitoring hook."""
+    from benor_tpu.utils.compile_counter import count_backend_compiles
+
+    base = dict(n_nodes=26, n_faulty=5, trials=5, delivery="quorum",
+                scheduler="uniform", max_rounds=16, seed=77)
+    cfg_off = SimConfig(**base)
+    cfg_on = SimConfig(record=True, **base)
+    faults = FaultSpec.from_faulty_list(
+        cfg_off, [True] * 5 + [False] * 21)
+    state = init_state(cfg_off, [i % 2 for i in range(26)], faults)
+    key = jax.random.key(cfg_off.seed)
+
+    with count_backend_compiles() as cc:
+        r0, fin0 = run_consensus(cfg_off, state, faults, key)
+        int(r0)
+    assert cc.count == 1, cc.count
+
+    r1, fin1, _rec = run_consensus(cfg_on, state, faults, key)
+    assert int(r0) == int(r1)
+    for leaf in ("x", "decided", "k", "killed"):
+        np.testing.assert_array_equal(np.asarray(getattr(fin0, leaf)),
+                                      np.asarray(getattr(fin1, leaf)))
+
+
+def test_row_semantics():
+    """Row invariants: the class columns partition the lane population,
+    row 0 is the pre-round snapshot, the decided column is cumulative and
+    ends at the final decided count, margins/coins behave per regime."""
+    cfg, state, faults, key = _adv_inputs()
+    r, fin, rec = run_consensus(cfg, state, faults, key)
+    rec, rounds = np.asarray(rec), int(r)
+
+    written = rec[:rounds + 1]
+    # decided + killed + the three undecided classes == T*N on every row
+    assert (written[:, :5].sum(axis=1) == T * N).all()
+    # row 0: nothing decided yet, balanced inputs split the histogram
+    assert written[0, REC_DECIDED] == 0 and written[0, REC_KILLED] == 0
+    assert written[0, REC_UNDEC0] == written[0, REC_UNDEC1] == T * N // 2
+    assert written[0, [REC_COINS, REC_MARGIN]].sum() == 0
+    # cumulative decided, ending at the final state's count
+    assert (np.diff(written[:, REC_DECIDED]) >= 0).all()
+    assert written[-1, REC_DECIDED] == int(np.asarray(fin.decided).sum())
+    # unwritten tail rows stay zero
+    assert (rec[rounds + 1:] == 0).all()
+    # the forced-tie round: every live lane flips, margin 0; the common
+    # coin then aligns values, so a later round shows a positive margin
+    assert written[1, REC_COINS] == T * N
+    assert written[1, REC_MARGIN] == 0
+    assert written[rounds, REC_MARGIN] > 0
+
+
+def test_recorder_vs_debug_and_simulate_arity():
+    """simulate() appends the recorder under cfg.record; cfg.record is
+    rejected on the oracle backends (no device loop to fill)."""
+    cfg = SimConfig(n_nodes=10, n_faulty=2, trials=2, delivery="quorum",
+                    scheduler="uniform", seed=9, record=True)
+    rounds, final, faults, rec = simulate(
+        cfg, [1] * 10, [True] * 2 + [False] * 8)
+    assert np.asarray(rec).shape == (cfg.max_rounds + 1, REC_WIDTH)
+    with pytest.raises(ValueError, match="record"):
+        SimConfig(n_nodes=4, n_faulty=0, backend="express", record=True)
+
+
+def test_tpu_network_round_history():
+    """TpuNetwork.get_round_history(): the parity-API surface, live under
+    poll_rounds slicing and loud when record is off."""
+    from benor_tpu.backends.tpu import TpuNetwork
+
+    cfg = SimConfig(n_nodes=10, n_faulty=2, trials=4, delivery="quorum",
+                    scheduler="uniform", seed=1, max_rounds=16,
+                    record=True, poll_rounds=2)
+    net = TpuNetwork(cfg, [1] * 10, [True] * 2 + [False] * 8)
+    seen = []
+    net.start(on_slice=lambda: seen.append(len(net.get_round_history())))
+    hist = net.get_round_history()
+    assert len(hist) == net.rounds_executed + 1
+    assert hist[0]["round"] == 0
+    # recorder counts are global over ALL trials
+    assert hist[-1]["decided"] == int(np.asarray(net.state.decided).sum())
+    assert seen and seen[0] <= len(hist)    # grew live between slices
+
+    # one-shot (no poll) path fills it too; record off raises
+    cfg1 = cfg.replace(poll_rounds=0)
+    net1 = TpuNetwork(cfg1, [1] * 10, [True] * 2 + [False] * 8)
+    net1.start()
+    assert net1.get_round_history() == hist
+    net0 = TpuNetwork(cfg1.replace(record=False), [1] * 10,
+                      [True] * 2 + [False] * 8)
+    net0.start()
+    with pytest.raises(ValueError, match="record=True"):
+        net0.get_round_history()
+
+
+def test_resume_threads_recorder():
+    """resume_consensus keeps filling a checkpointed run's buffer: cut at
+    round c, resume with the partial recorder, get the one-shot buffer."""
+    from benor_tpu.sim import resume_consensus
+
+    cfg, state, faults, key = _adv_inputs()
+    r, fin, rec = run_consensus(cfg, state, faults, key)
+
+    st = start_state(cfg, state)
+    r_cut, st_cut, rec_cut = run_consensus_slice(
+        cfg, st, faults, key, jnp.int32(1), jnp.int32(2), None)
+    rr, fr, rec_res = resume_consensus(cfg, st_cut, faults, key,
+                                       int(r_cut), recorder=rec_cut)
+    assert int(rr) == int(r)
+    np.testing.assert_array_equal(np.asarray(rec), np.asarray(rec_res))
+    np.testing.assert_array_equal(np.asarray(fin.x), np.asarray(fr.x))

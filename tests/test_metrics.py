@@ -1,0 +1,153 @@
+"""Unified metrics layer (utils/metrics.py): registry + exporters."""
+
+import json
+
+import numpy as np
+import pytest
+
+from benor_tpu.state import REC_COLUMNS, REC_WIDTH
+from benor_tpu.utils import metrics
+
+
+@pytest.fixture
+def registry():
+    reg = metrics.MetricsRegistry()
+    return reg
+
+
+def test_registry_types_and_snapshot(registry):
+    registry.counter("a.count").inc()
+    registry.counter("a.count").inc(2.5)
+    registry.gauge("b.gauge").set(7)
+    with registry.timer("c.timer").time():
+        pass
+    snap = {m["name"]: m for m in registry.snapshot()}
+    assert snap["a.count"]["value"] == 3.5
+    assert snap["b.gauge"]["value"] == 7.0
+    assert snap["c.timer"]["count"] == 1
+    assert snap["c.timer"]["total_s"] >= 0
+    # one name, one type — a re-registration under another type is loud
+    with pytest.raises(TypeError):
+        registry.gauge("a.count")
+
+
+def test_exporters_roundtrip(tmp_path, registry):
+    registry.counter("compiles").inc(4)
+    registry.gauge("hbm.util").set(0.33)
+    with registry.timer("sweep.run").time():
+        pass
+
+    p = tmp_path / "m.jsonl"
+    n = metrics.export_jsonl(str(p), registry)
+    lines = [json.loads(ln) for ln in p.read_text().splitlines()]
+    assert n == len(lines) == 3
+    assert {ln["name"] for ln in lines} == {"compiles", "hbm.util",
+                                            "sweep.run"}
+    assert all("ts" in ln for ln in lines)
+
+    p = tmp_path / "m.prom"
+    metrics.export_prometheus(str(p), registry)
+    text = p.read_text()
+    assert "# TYPE benor_tpu_compiles counter" in text
+    assert "benor_tpu_compiles 4.0" in text
+    assert "benor_tpu_hbm_util 0.33" in text          # name sanitized
+    assert "benor_tpu_sweep_run_count 1" in text
+
+    p = tmp_path / "t.json"
+    n_ev = metrics.export_chrome_trace(str(p), registry)
+    trace = json.loads(p.read_text())
+    assert len(trace["traceEvents"]) == n_ev
+    phases = {e["ph"] for e in trace["traceEvents"]}
+    assert "X" in phases and "C" in phases
+
+
+def _synthetic_recorder(rows):
+    """Recorder buffer from (decided, killed, u0, u1, uq, coins, margin)
+    tuples, padded with unwritten (all-zero) tail rows."""
+    rec = np.zeros((10, REC_WIDTH), np.int32)
+    for i, row in enumerate(rows):
+        rec[i] = row
+    return rec
+
+
+def test_round_history_rows_and_summary():
+    rec = _synthetic_recorder([
+        (0, 2, 10, 10, 0, 0, 0),      # row 0: snapshot
+        (8, 2, 5, 5, 2, 12, 3),       # round 1
+        (20, 2, 0, 0, 0, 0, 9),       # round 2: quiesced
+    ])
+    rows = metrics.round_history_rows(rec)
+    assert len(rows) == 3                       # zero tail rows trimmed
+    assert rows[0] == {"round": 0, **dict(zip(REC_COLUMNS,
+                                              (0, 2, 10, 10, 0, 0, 0)))}
+    summ = metrics.round_history_summary(rec)
+    assert summ["rounds_executed"] == 2
+    assert summ["rounds_to_quiescence"] == 2
+    assert summ["decide_velocity"] == [8, 12]
+    assert summ["rounds_to_quiescence_hist"] == [8, 12]
+    assert summ["final"]["decided"] == 20
+
+    # a never-quiescing (livelock) history reports None
+    live = _synthetic_recorder([(0, 0, 10, 12, 0, 0, 0),
+                                (0, 0, 8, 8, 6, 22, 0)])
+    assert metrics.round_history_summary(live)["rounds_to_quiescence"] is None
+
+
+def test_gapped_resume_buffer_renders_by_round_index():
+    """A resume_consensus(..., recorder=None) buffer has unwritten rows
+    between the re-entry snapshot (row 0) and from_round: renderers must
+    key written rows by their TRUE round index, not drop the history at
+    the first gap."""
+    rec = np.zeros((8, REC_WIDTH), np.int32)
+    rec[0] = (6, 2, 6, 6, 2, 0, 0)      # re-entry snapshot
+    rec[4] = (12, 2, 3, 3, 2, 5, 1)     # resumed round 4
+    rec[5] = (20, 2, 0, 0, 0, 0, 4)     # round 5: quiesced
+    rows = metrics.round_history_rows(rec)
+    assert [r["round"] for r in rows] == [0, 4, 5]
+    summ = metrics.round_history_summary(rec)
+    assert summ["rounds_executed"] == 2
+    assert summ["rounds_to_quiescence"] == 5
+    assert summ["decide_velocity"] == [6, 8]    # gap entry aggregates
+    assert summ["final"]["decided"] == 20
+
+
+def test_chrome_trace_renders_rounds(tmp_path, registry):
+    rec = _synthetic_recorder([(0, 0, 4, 4, 0, 0, 0),
+                               (8, 0, 0, 0, 0, 0, 2)])
+    p = tmp_path / "t.json"
+    metrics.export_chrome_trace(str(p), registry, round_history=rec,
+                                rounds_label="unit")
+    evs = json.loads(p.read_text())["traceEvents"]
+    rounds = [e for e in evs if e["tid"] == "rounds"]
+    assert len(rounds) == 2
+    assert rounds[0]["name"] == "unit start"
+    assert rounds[1]["args"]["decided"] == 8
+
+
+def test_timed_feeds_registry():
+    """Satellite: utils/tracing.timed now also records into the unified
+    registry (same label), so ad-hoc timings reach every exporter."""
+    from benor_tpu.utils import tracing
+
+    name = "unit.timed_feeds_registry"
+    before = metrics.REGISTRY.timer(name).count
+    with tracing.timed(name, sink=lambda m: None):
+        pass
+    assert metrics.REGISTRY.timer(name).count == before + 1
+
+
+def test_compile_counter_feeds_registry():
+    """utils/compile_counter's process-lifetime listener mirrors every
+    backend compile into the registry counters."""
+    import jax
+    import jax.numpy as jnp
+
+    from benor_tpu.utils.compile_counter import count_backend_compiles
+
+    c = metrics.REGISTRY.counter("jax.backend_compiles")
+    before = c.value
+    with count_backend_compiles() as cc:
+        jax.jit(lambda x: x * 3 + 1)(jnp.arange(17, dtype=jnp.int32) % 5
+                                     ).block_until_ready()
+    assert cc.count >= 1
+    assert c.value >= before + cc.count

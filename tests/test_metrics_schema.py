@@ -1,0 +1,80 @@
+"""Bench artifact contract (tools/check_metrics_schema.py): the stdout
+headline must stay under the driver's truncation horizon and
+BENCH_DETAIL.json must match the checked-in schema — so new recorder/
+metrics keys can never re-trigger the round-3 parsed-null failure."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "check_metrics_schema.py")
+DETAIL = os.path.join(REPO, "BENCH_DETAIL.json")
+
+spec = importlib.util.spec_from_file_location("check_metrics_schema", TOOL)
+tool = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(tool)
+
+
+@pytest.fixture(scope="module")
+def committed_detail():
+    with open(DETAIL) as fh:
+        return json.load(fh)
+
+
+def test_committed_detail_passes_schema(committed_detail):
+    assert tool.check_schema(committed_detail) == []
+
+
+def test_committed_detail_headline_under_budget(committed_detail):
+    assert tool.check_headline(committed_detail) == []
+    assert tool.headline_bytes(committed_detail) <= tool.HEADLINE_BUDGET
+
+
+def test_headline_budget_catches_inflation(committed_detail):
+    """A key that bench._split_headline would keep on stdout (i.e. not in
+    _DETAIL_KEYS) must trip the budget check once it is large — the exact
+    round-3 failure shape."""
+    bloated = dict(committed_detail)
+    bloated["giant_new_headline_key"] = ["x" * 40] * 60
+    errs = tool.check_headline(bloated)
+    assert errs and "sidecar" in errs[0]
+
+
+def test_detail_keys_stay_off_headline(committed_detail):
+    """The series-sized keys (curve, kernel checks, flight recorder) must
+    be routed to the sidecar by bench._split_headline."""
+    import sys
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    blob = dict(committed_detail)
+    blob["flight_recorder"] = {"bit_equal_record_off_on": True,
+                               "decide_velocity": list(range(64))}
+    head, detail = bench._split_headline(blob)
+    for key in bench._DETAIL_KEYS:
+        assert key not in head
+    assert "flight_recorder" in detail
+    assert head.get("recorder_ok") is True
+
+
+def test_schema_catches_missing_required(committed_detail):
+    broken = {k: v for k, v in committed_detail.items() if k != "curve"}
+    errs = tool.check_schema(broken)
+    assert any("curve" in e for e in errs)
+
+
+def test_schema_catches_type_drift(committed_detail):
+    broken = dict(committed_detail)
+    broken["n_regimes"] = "seventeen"
+    errs = tool.check_schema(broken)
+    assert any("n_regimes" in e for e in errs)
+
+
+def test_tool_main_passes_on_committed_artifact(capsys):
+    assert tool.main([DETAIL]) == 0
+    assert "schema OK" in capsys.readouterr().out

@@ -89,6 +89,70 @@ def test_round_events_under_sharded_runner():
     assert sorted(shard_rows) == sorted(single_rows)
 
 
+def test_round_events_fast_in_order():
+    """Tier-1 (non-slow) coverage for the debug-callback path: the only
+    other emission tests are @slow, so a regression in emit_round_event /
+    the _run_body wiring used to reach the fast lane unseen.  Tiny
+    network, ordered single-device emission, counts match the final
+    state."""
+    rows = []
+    sink = lambda r, d, k: rows.append((r, d, k))
+    tracing.add_sink(sink)
+    try:
+        cfg = SimConfig(n_nodes=8, n_faulty=2, trials=2, max_rounds=12,
+                        delivery="quorum", scheduler="uniform", seed=5,
+                        debug=True)
+        rounds, final, _ = simulate(
+            cfg, [1] * 6 + [0] * 2, [True] * 2 + [False] * 6)
+        jax.effects_barrier()
+    finally:
+        tracing.remove_sink(sink)
+    assert len(rows) == int(rounds) >= 1
+    assert [r for r, _, _ in rows] == sorted(r for r, _, _ in rows)
+    assert rows[-1][1] == int(np.asarray(final.decided).sum())
+    assert all(k == 2 * 2 for _, _, k in rows)      # killed count, all trials
+
+
+def test_debug_demotion_warns_once():
+    """Satellite: a pallas-eligible config with debug=True silently loses
+    the fused regime — that demotion now warns, once per process."""
+    import warnings
+    from benor_tpu import sim
+    from benor_tpu.ops.tally import pallas_round_active
+    from benor_tpu.state import FaultSpec, init_state
+    from benor_tpu.sweep import balanced_inputs
+
+    cfg = SimConfig(n_nodes=16, n_faulty=2, trials=2, max_rounds=2,
+                    delivery="quorum", scheduler="adversarial",
+                    coin_mode="common", path="histogram",
+                    use_pallas_round=True, debug=True, seed=2)
+    assert pallas_round_active(cfg)
+    faults = FaultSpec.none(2, 16)
+    state = init_state(cfg, balanced_inputs(2, 16), faults)
+    quiet = lambda *a: None
+    tracing.add_sink(quiet)                 # keep the default sink quiet
+    old = sim._debug_demotion_warned
+    sim._debug_demotion_warned = False
+    try:
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            sim.run_consensus(cfg, state, faults, jax.random.key(2))
+            jax.effects_barrier()
+        demote = [x for x in w if "demotes" in str(x.message)]
+        assert len(demote) == 1
+        assert "record=True" in str(demote[0].message)
+        # one-time: a second run stays quiet
+        with warnings.catch_warnings(record=True) as w2:
+            warnings.simplefilter("always")
+            sim.run_consensus(cfg.replace(seed=3), state, faults,
+                              jax.random.key(3))
+            jax.effects_barrier()   # flush callbacks while `quiet` holds
+        assert not [x for x in w2 if "demotes" in str(x.message)]
+    finally:
+        sim._debug_demotion_warned = old
+        tracing.remove_sink(quiet)
+
+
 def test_timed_context(capsys):
     msgs = []
     with tracing.timed("unit", sink=msgs.append):

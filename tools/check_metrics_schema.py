@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Guard the bench artifact contract: headline size + detail schema.
+
+The round-3 failure mode this tool exists to prevent: bench.py's stdout
+line grew past the driver's 2,000-char stdout window and the artifact
+parsed as null.  The fix split the output into a compact stdout headline
+plus the BENCH_DETAIL.json sidecar — but nothing STOPPED a future key
+(like the flight recorder's series) from re-inflating the headline.  Now
+something does:
+
+  * ``check_headline``  — recompute the stdout headline exactly as
+    bench.py would (``bench._split_headline``) from a detail record and
+    assert its JSON stays under the byte budget (default 1200 — "~1 KB",
+    comfortably inside the 2,000-char driver window).
+  * ``check_schema``    — validate the detail record against the
+    checked-in ``tools/bench_detail_schema.json``: required keys,
+    recursive types, nullability.  The validator is a dependency-free
+    JSON-Schema subset (type / required / properties / items / nullable /
+    enum) — the container ships no ``jsonschema`` package.
+
+Run as a script over a committed capture (exit 0 = pass):
+
+    python tools/check_metrics_schema.py [BENCH_DETAIL.json]
+
+or import from tests (tests/test_metrics_schema.py keeps this in tier-1,
+so a key that would re-trigger the truncation fails the suite before it
+ever reaches a driver run).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import List
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+SCHEMA_PATH = os.path.join(HERE, "bench_detail_schema.json")
+
+#: Byte budget for the stdout headline JSON line ("~1 KB"; the driver
+#: keeps only the last 2,000 chars of stdout, so 1200 leaves headroom
+#: for platform-dependent value widths).
+HEADLINE_BUDGET = 1200
+
+_TYPES = {
+    "object": dict,
+    "array": list,
+    "string": str,
+    "boolean": bool,
+    "integer": int,
+    "number": (int, float),
+}
+
+
+def _validate(value, schema, path: str, errors: List[str]) -> None:
+    """Minimal JSON-Schema-subset validator (see module docstring)."""
+    if value is None:
+        if not schema.get("nullable", False):
+            errors.append(f"{path}: null but not nullable")
+        return
+    typ = schema.get("type")
+    if typ is not None:
+        py = _TYPES[typ]
+        ok = isinstance(value, py)
+        if ok and typ in ("integer", "number") and isinstance(value, bool):
+            ok = False          # bool is an int subclass; keep them apart
+        if not ok:
+            errors.append(f"{path}: expected {typ}, "
+                          f"got {type(value).__name__} ({value!r:.60})")
+            return
+    if "enum" in schema and value not in schema["enum"]:
+        errors.append(f"{path}: {value!r} not in {schema['enum']}")
+    if typ == "object":
+        for key in schema.get("required", []):
+            if key not in value:
+                errors.append(f"{path}: missing required key {key!r}")
+        for key, sub in schema.get("properties", {}).items():
+            if key in value:
+                _validate(value[key], sub, f"{path}.{key}", errors)
+    elif typ == "array" and "items" in schema:
+        for i, item in enumerate(value):
+            _validate(item, schema["items"], f"{path}[{i}]", errors)
+
+
+def check_schema(detail: dict, schema_path: str = SCHEMA_PATH) -> List[str]:
+    """Validate a BENCH_DETAIL record; returns the error list (empty = ok)."""
+    with open(schema_path) as fh:
+        schema = json.load(fh)
+    errors: List[str] = []
+    _validate(detail, schema, "$", errors)
+    return errors
+
+
+def headline_bytes(detail: dict) -> int:
+    """Size of the stdout headline bench.py would emit for this record.
+
+    Reuses bench._split_headline itself (not a reimplementation), so the
+    check can never drift from the real emission path."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    head, _ = bench._split_headline(dict(detail))
+    return len(json.dumps(head).encode())
+
+
+def check_headline(detail: dict,
+                   budget: int = HEADLINE_BUDGET) -> List[str]:
+    n = headline_bytes(detail)
+    if n > budget:
+        return [f"headline is {n} bytes > budget {budget}: a new key "
+                f"must go to the BENCH_DETAIL sidecar (_DETAIL_KEYS in "
+                f"bench.py), not the stdout line"]
+    return []
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    path = argv[0] if argv else os.path.join(REPO, "BENCH_DETAIL.json")
+    with open(path) as fh:
+        detail = json.load(fh)
+    errors = check_schema(detail) + check_headline(detail)
+    for e in errors:
+        print(f"FAIL {e}", file=sys.stderr)
+    n = headline_bytes(detail)
+    print(f"{os.path.basename(path)}: schema "
+          f"{'OK' if not errors else 'INVALID'}; "
+          f"headline {n} bytes (budget {HEADLINE_BUDGET})")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
