@@ -107,9 +107,12 @@ def _split_headline(out: dict) -> tuple[dict, dict]:
     head["pallas_demoted_n"] = len(out.get("pallas_demoted", []))
     fr = out.get("flight_recorder")
     if isinstance(fr, dict):
-        # one compact bool on the headline; the recorder-derived series
-        # (decide velocity, quiescence histogram) stay in the sidecar
+        # two compact bools on the headline; the recorder-derived series
+        # (decide velocity, quiescence histogram) and the audit detail
+        # stay in the sidecar.  audit_ok = the witnessed flagship regime
+        # upheld every Ben-Or invariant (benor_tpu/audit.py).
         head["recorder_ok"] = bool(fr.get("bit_equal_record_off_on"))
+        head["audit_ok"] = bool(fr.get("audit_ok"))
     head["detail_file"] = "BENCH_DETAIL.json"
     return head, detail
 
@@ -737,12 +740,14 @@ def _batched_sweep_check(n: int, trials: int, seed: int) -> dict:
 
 def _flight_recorder_check(n: int, trials: int, max_rounds: int, seed: int,
                            use_pallas: bool) -> dict:
-    """Flight-recorder proof + recorder-derived science on the flagship
-    balanced f=0.40 regime (the same config the main sweep runs, so the
-    record=False executable is cache-warm):
+    """Flight-recorder + witness proof + recorder-derived science on the
+    flagship balanced f=0.40 regime (the same config the main sweep runs,
+    so the record=False executable is cache-warm):
 
       * record=True results are BIT-IDENTICAL to record=False (the
-        recorder only reduces values the round already computes);
+        recorder only reduces values the round already computes), and so
+        are witness-armed results — ONE bench pass guards both on-device
+        recorders;
       * record=False costs zero extra backend compiles (its executable
         was built by the sweep warm-up — the flag never enters the
         trace);
@@ -750,10 +755,15 @@ def _flight_recorder_check(n: int, trials: int, max_rounds: int, seed: int,
         rounds-to-quiescence histogram over lanes
         (utils/metrics.round_history_summary) — full round history from
         a regime that previously ran blind (cfg.debug would demote the
-        fused pallas loop; the recorder runs inside it).
+        fused pallas loop; the recorder runs inside it);
+      * the witness buffer is machine-checked by the invariant auditor
+        (benor_tpu/audit.py) — ``audit_ok`` is the headline bool saying
+        this capture's flagship regime upheld the Ben-Or invariants.
     """
     import jax
 
+    from benor_tpu.audit import (WitnessBundle, audit_witness,
+                                 default_witness_overrides)
     from benor_tpu.config import SimConfig
     from benor_tpu.sim import run_consensus
     from benor_tpu.state import FaultSpec, init_state
@@ -768,6 +778,8 @@ def _flight_recorder_check(n: int, trials: int, max_rounds: int, seed: int,
                 use_pallas_round=use_pallas)
     cfg_off = SimConfig(**base)
     cfg_on = SimConfig(record=True, **base)
+    cfg_wit = SimConfig(record=True,
+                        **default_witness_overrides(trials, n), **base)
     faults = FaultSpec.none(trials, n)
     state = init_state(cfg_off, balanced_inputs(trials, n), faults)
     key = jax.random.key(seed)
@@ -777,12 +789,22 @@ def _flight_recorder_check(n: int, trials: int, max_rounds: int, seed: int,
         int(r0)
     r1, fin1, rec = run_consensus(cfg_on, state, faults, key)
     int(r1)
+    r2, fin2, rec2, wit = run_consensus(cfg_wit, state, faults, key)
+    int(r2)
 
-    assert int(r0) == int(r1)
-    np.testing.assert_array_equal(np.asarray(fin0.x), np.asarray(fin1.x))
-    np.testing.assert_array_equal(np.asarray(fin0.decided),
-                                  np.asarray(fin1.decided))
-    np.testing.assert_array_equal(np.asarray(fin0.k), np.asarray(fin1.k))
+    assert int(r0) == int(r1) == int(r2)
+    for fin in (fin1, fin2):
+        np.testing.assert_array_equal(np.asarray(fin0.x),
+                                      np.asarray(fin.x))
+        np.testing.assert_array_equal(np.asarray(fin0.decided),
+                                      np.asarray(fin.decided))
+        np.testing.assert_array_equal(np.asarray(fin0.k),
+                                      np.asarray(fin.k))
+    # the witness run's recorder must match the record-only run's too
+    np.testing.assert_array_equal(np.asarray(rec), np.asarray(rec2))
+
+    report = audit_witness(WitnessBundle.from_run(
+        cfg_wit, wit, faults=faults, label="bench balanced_f0.40"))
 
     # post-compile overhead of recording (one extra HBM buffer + the
     # kernels' telemetry partials; zero host round trips either way)
@@ -799,6 +821,10 @@ def _flight_recorder_check(n: int, trials: int, max_rounds: int, seed: int,
         "regime": "balanced_f0.40", "n": n, "trials": trials,
         "fused_round": use_pallas,
         "bit_equal_record_off_on": True,
+        "bit_equal_witness_off_on": True,
+        "audit_ok": report.ok,
+        "audit_violations": len(report.violations),
+        "audit_checks": sum(report.checks.values()),
         "compiles_record_off_warm": cc_off.count,
         "unrecorded_ms": round(times[0] * 1e3, 3),
         "recorded_ms": round(times[1] * 1e3, 3),

@@ -18,20 +18,27 @@ Layout (SURVEY.md §7):
   api.py           launch_network parity facade (N10)
   utils/metrics.py unified metrics registry + flight-recorder rendering
                    (SimConfig.record; see README "Observability")
+  audit.py         witness traces + protocol invariant auditor
+                   (SimConfig.witness_trials; per-node forensics for
+                   every regime — see README "Observability")
 """
 
 from .api import (get_nodes_state, launch_network, reached_finality,
                   start_consensus, stop_consensus)
-from .config import BASE_NODE_PORT, SimConfig, VAL0, VAL1, VALQ
+from .config import (BASE_NODE_PORT, SimConfig, VAL0, VAL1, VALQ,
+                     WITNESS_MAX_NODES)
 from .state import (DynParams, FaultSpec, NetState, REC_COLUMNS, REC_WIDTH,
-                    init_state, new_recorder, observable_state)
+                    WIT_COLUMNS, WIT_WIDTH, init_state, new_recorder,
+                    new_witness, observable_state, witness_node_ids)
 from .sim import (run_consensus, run_consensus_traced, resume_consensus,
                   simulate, start_state)
 
 __all__ = [
     "BASE_NODE_PORT", "SimConfig", "VAL0", "VAL1", "VALQ",
+    "WITNESS_MAX_NODES",
     "DynParams", "FaultSpec", "NetState", "init_state", "observable_state",
     "REC_COLUMNS", "REC_WIDTH", "new_recorder",
+    "WIT_COLUMNS", "WIT_WIDTH", "new_witness", "witness_node_ids",
     "run_consensus", "run_consensus_traced", "resume_consensus",
     "simulate", "start_state",
     "launch_network", "start_consensus", "stop_consensus",

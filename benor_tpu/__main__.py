@@ -10,10 +10,15 @@ Subcommands:
   trace  --n N --f F --out trace.json                flight-recorder round
                                                      history as a Chrome-
                                                      trace/Perfetto file
+  audit  --n N --f F [--witness-trials 0,1]          run one witnessed
+         [--witness-nodes k] [--audit-out b.json]    config, machine-check
+                                                     the Ben-Or invariants
+                                                     (benor_tpu/audit.py),
+                                                     dump the bundle
   preset NAME                                        a BASELINE.json config
 
 Observability: `--record` (sweep) fills the on-device flight recorder;
-`--metrics-out PATH` (sweep/coins/trace) dumps the unified metrics
+`--metrics-out PATH` (sweep/coins/trace/audit) dumps the unified metrics
 registry (JSON-lines, or Prometheus textfile with a .prom extension).
 """
 
@@ -276,6 +281,54 @@ def _trace(args) -> int:
     return 0
 
 
+def _audit(args) -> int:
+    """Run ONE witnessed config and machine-check the Ben-Or invariants:
+    prints the audit verdict (pinpointed violations with trial/round/node
+    ids and tallies), optionally dumps the JSON witness bundle, and feeds
+    the audit.* counters of the unified metrics registry.  Exit code 0 =
+    clean, 2 = violations found (so CI can gate on it)."""
+    from .audit import audit_point, default_witness_overrides, save_bundle
+    from .config import SimConfig
+    from .state import FaultSpec
+    from .sweep import balanced_inputs
+
+    dflt = default_witness_overrides(args.trials, args.n)
+    wt = (tuple(int(x) for x in args.witness_trials.split(","))
+          if args.witness_trials else dflt["witness_trials"])
+    wk = args.witness_nodes or dflt["witness_nodes"]
+    cfg = SimConfig(n_nodes=args.n, n_faulty=args.f, trials=args.trials,
+                    max_rounds=args.max_rounds, delivery="quorum",
+                    scheduler=args.scheduler, coin_mode=args.coin,
+                    fault_model=args.fault_model, seed=args.seed,
+                    witness_trials=wt, witness_nodes=wk,
+                    **_pallas_flags(args.pallas))
+    initial = faults = unanimous = None
+    if args.balanced:
+        initial = balanced_inputs(args.trials, args.n)
+        if cfg.fault_model not in ("byzantine", "equivocate"):
+            faults = FaultSpec.none(args.trials, args.n)
+    if args.unanimous is not None:
+        initial = np.full((args.trials, args.n), args.unanimous, np.int8)
+        unanimous = args.unanimous
+    report, bundle = audit_point(cfg, initial_values=initial,
+                                 faults=faults, unanimous=unanimous,
+                                 label=f"cli N={args.n} f={args.f}")
+    fb = " [cpu fallback]" if FELL_BACK else ""
+    print(f"watched trials={[int(t) for t in bundle.trial_ids]} "
+          f"nodes={[int(i) for i in bundle.node_ids]}{fb}")
+    print(report.summary())
+    for v in report.violations[:args.max_violations]:
+        print(f"  [{v.invariant}] {v.message}")
+    if len(report.violations) > args.max_violations:
+        print(f"  ... {len(report.violations) - args.max_violations} more "
+              f"(see --audit-out)")
+    if args.audit_out:
+        save_bundle(args.audit_out, bundle, report)
+        print(f"wrote witness bundle to {args.audit_out}")
+    _export_metrics(args.metrics_out)
+    return 0 if report.ok else 2
+
+
 def _coins(args) -> int:
     from .config import SimConfig
     from .state import FaultSpec
@@ -415,6 +468,46 @@ def main(argv=None) -> int:
     _add_pallas_arg(t)
     _add_obs_args(t, record=False)   # trace implies --record
 
+    a = sub.add_parser("audit",
+                       help="run one witnessed config and machine-check "
+                            "the Ben-Or invariants (benor_tpu/audit.py)")
+    a.add_argument("--n", type=int, default=100)
+    a.add_argument("--f", type=int, default=25)
+    a.add_argument("--trials", type=int, default=16)
+    a.add_argument("--max-rounds", type=int, default=32)
+    a.add_argument("--scheduler",
+                   choices=("uniform", "biased", "adversarial", "targeted"),
+                   default="uniform")
+    a.add_argument("--coin", choices=("private", "common"),
+                   default="private")
+    a.add_argument("--fault-model",
+                   choices=("crash", "byzantine", "equivocate"),
+                   default="crash")
+    a.add_argument("--balanced", action="store_true",
+                   help="balanced inputs + zero crashes (live marked "
+                        "faults under byzantine/equivocate) — the regime "
+                        "where the safety adversaries bite")
+    a.add_argument("--unanimous", type=int, choices=(0, 1), default=None,
+                   help="run all-<v> inputs and arm the VALIDITY check "
+                        "(any decision != v is a violation)")
+    a.add_argument("--seed", type=int, default=0)
+    a.add_argument("--witness-trials", default=None,
+                   help="comma-separated global trial ids to watch "
+                        "(default: the first min(trials, 4))")
+    a.add_argument("--witness-nodes", type=int, default=None,
+                   help="how many nodes to watch — the first ceil(k/2) + "
+                        "last floor(k/2) global ids (default: "
+                        "min(n, 16))")
+    a.add_argument("--audit-out", metavar="PATH",
+                   help="write the witness bundle + audit verdict as one "
+                        "JSON document (re-auditable offline via "
+                        "audit.load_bundle)")
+    a.add_argument("--max-violations", type=int, default=5,
+                   help="violations printed before truncating (all land "
+                        "in --audit-out)")
+    _add_pallas_arg(a)
+    _add_obs_args(a, record=False)
+
     p = sub.add_parser("preset", help="run a BASELINE.json preset config")
     p.add_argument("name")
 
@@ -433,7 +526,8 @@ def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     # bare `python -m benor_tpu [-n N -f F ...]` == the start.ts demo
     if not argv or argv[0] not in ("demo", "sweep", "coins", "preset",
-                                   "results", "trace", "-h", "--help"):
+                                   "results", "trace", "audit", "-h",
+                                   "--help"):
         argv = ["demo"] + argv
     args = ap.parse_args(argv)
     _honor_platform_env()
@@ -448,7 +542,7 @@ def main(argv=None) -> int:
         _ensure_live_backend()
     return {"demo": _demo, "sweep": _sweep, "coins": _coins,
             "preset": _preset, "results": _results,
-            "trace": _trace}[args.cmd](args)
+            "trace": _trace, "audit": _audit}[args.cmd](args)
 
 
 if __name__ == "__main__":

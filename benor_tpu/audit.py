@@ -1,0 +1,516 @@
+"""Protocol invariant auditor: machine-checked Ben-Or forensics.
+
+The flight recorder (state.REC_*) says *that* something happened — e.g.
+``disagree_frac > 0`` in a safety study — but not which nodes decided
+which value on what evidence.  This module closes that gap: it replays a
+WITNESS buffer (SimConfig(witness_trials=..., witness_nodes=k); filled
+on device by every compiled regime, state.WIT_* columns) and
+machine-checks the Ben-Or invariants, emitting structured violation
+reports with a minimal witness (trial, round, node ids, tallies) — every
+simulated run becomes a self-verifying artifact, at scales where eyeballing
+``/getState`` snapshots (the reference's only forensic tool) is
+impossible.
+
+The five audited invariants, each anchored to the reference
+implementation (``src/nodes/node.ts``):
+
+  agreement        No two honest nodes in one trial decide different
+                   values.  The decide rule is ``count(v) > F`` with the
+                   0-branch checked first (node.ts:99-104); the witness
+                   records each decide's (v0, v1) evidence, so a
+                   violation report names the two nodes, their decide
+                   rounds AND the tallies that justified both decisions.
+  validity         If every node starts with the same input v, any
+                   decision is v.  The opposing count can then only come
+                   from faulty senders, never exceeding F, so
+                   ``count(¬v) > F`` (node.ts:99,102) is unsatisfiable —
+                   checked when the witnessed inputs are known unanimous
+                   (full node coverage, or the caller asserts it).
+  irrevocability   ``decided`` is set (node.ts:100,103) and never unset:
+                   a decided lane freezes and keeps broadcasting its value
+                   forever (node.ts:147-157 — quirk 5), so its witnessed
+                   (x, decided) must be constant from the decide round on.
+  quorum evidence  Every decide is backed by a ``> F`` tally of its value
+                   under the active decision rule: x=0 needs v0 > F
+                   (node.ts:99), x=1 needs v1 > F AND v0 <= F (the
+                   0-branch is checked first, node.ts:99-104 — both
+                   ``rule='reference'`` and ``'textbook'`` share this
+                   ordering); deciding "?" is impossible; the tallies
+                   themselves are bounded by the quorum N - F
+                   (node.ts:52,88).  A coin commit (node.ts:111) needs
+                   the complementary evidence: no decide, and under the
+                   reference's plurality-adopt quirk (node.ts:106-112) a
+                   tied v0 == v1; under 'textbook', v0 <= F and v1 <= F.
+  killed silence   A killed node stops participating: birth-faulty lanes
+                   are dead with null state (node.ts:21-26), /stop kills
+                   at any time (node.ts:191-194) — once the witnessed
+                   killed bit is set the lane's (x, decided) must freeze
+                   and it must never commit another coin.
+
+Host-side and dependency-light (numpy + the metrics registry): the
+auditor never touches a device.  ``audit_witness`` feeds pass/violation
+counters into utils/metrics.REGISTRY, so audit outcomes flow to the
+JSON-lines / Prometheus exporters alongside compile and timer metrics.
+``results.py``'s safety studies auto-rerun violating points with
+witnessing enabled and dump bundles via ``save_bundle``; the same bundle
+renders as Perfetto trace slices through
+utils/metrics.export_chrome_trace(witness=...).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .config import SimConfig, VAL0, VAL1, VALQ
+from .state import (WIT_COINED, WIT_COLUMNS, WIT_DECIDED, WIT_KILLED,
+                    WIT_P0, WIT_P1, WIT_V0, WIT_V1, WIT_WIDTH, WIT_WRITTEN,
+                    WIT_X, witness_node_ids)
+
+#: The audited invariants, in check order — the single source of truth
+#: for reports, the metrics counters and the witness-bundle schema.
+INVARIANTS = ("agreement", "validity", "irrevocability",
+              "quorum_evidence", "killed_silence")
+
+
+# --------------------------------------------------------------------------
+# Bundle: a witness buffer plus the static facts the checks need.
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class WitnessBundle:
+    """One run's witness evidence, self-describing for offline audit.
+
+    ``buffer`` is the device-filled int32 [max_rounds + 1, W, k,
+    WIT_WIDTH] array; ``trial_ids``/``node_ids`` name the watched GLOBAL
+    ids; ``faulty`` (optional bool [W, k]) marks watched lanes that are
+    protocol-faulty (equivocators / byzantine senders — their own
+    decisions are excluded from the agreement/validity checks);
+    ``unanimous`` (0, 1 or None) asserts that ALL inputs — watched or not
+    — were that value, arming the validity check even under partial node
+    coverage.
+    """
+
+    buffer: np.ndarray
+    trial_ids: np.ndarray          # int [W] global trial ids
+    node_ids: np.ndarray           # int [k] global node ids
+    rule: str                      # 'reference' | 'textbook'
+    n_faulty: int                  # F — the decide bar count > F
+    n_nodes: int
+    freeze_decided: bool = True
+    faulty: Optional[np.ndarray] = None     # bool [W, k] or None
+    unanimous: Optional[int] = None         # 0 | 1 | None
+    label: str = ""
+
+    @classmethod
+    def from_run(cls, cfg: SimConfig, buffer, faults=None,
+                 unanimous: Optional[int] = None,
+                 label: str = "") -> "WitnessBundle":
+        """Bundle a run's witness output with the facts its config and
+        (optionally) FaultSpec pin down.  ``faults`` narrows the honest
+        population — but only under the lying fault models
+        ('byzantine'/'equivocate'): a fail-stop lane ('crash',
+        'crash_at_round') follows the protocol until it dies, so its
+        decisions MUST count for agreement/validity.  ``unanimous``
+        asserts globally-unanimous inputs."""
+        if not cfg.witness:
+            raise ValueError("cfg has no witness armed (witness_trials)")
+        trial_ids = np.asarray(cfg.witness_trials, np.int64)
+        node_ids = np.asarray(witness_node_ids(cfg), np.int64)
+        faulty = None
+        if faults is not None and cfg.fault_model in ("byzantine",
+                                                      "equivocate"):
+            f = np.asarray(faults.faulty)
+            faulty = f[np.ix_(trial_ids, node_ids)]
+        return cls(buffer=np.asarray(buffer), trial_ids=trial_ids,
+                   node_ids=node_ids, rule=cfg.rule,
+                   n_faulty=cfg.n_faulty, n_nodes=cfg.n_nodes,
+                   freeze_decided=cfg.freeze_decided, faulty=faulty,
+                   unanimous=unanimous, label=label)
+
+    def to_dict(self) -> Dict:
+        return {
+            "label": self.label,
+            "rule": self.rule,
+            "n_faulty": int(self.n_faulty),
+            "n_nodes": int(self.n_nodes),
+            "freeze_decided": bool(self.freeze_decided),
+            "trial_ids": [int(t) for t in self.trial_ids],
+            "node_ids": [int(n) for n in self.node_ids],
+            "unanimous": (None if self.unanimous is None
+                          else int(self.unanimous)),
+            "faulty": (None if self.faulty is None
+                       else np.asarray(self.faulty).astype(bool).tolist()),
+            "columns": list(WIT_COLUMNS),
+            "buffer": np.asarray(self.buffer).astype(int).tolist(),
+        }
+
+
+def witness_rows(buffer, trial_ids, node_ids) -> List[dict]:
+    """Witness buffer -> one dict per written (round, trial, node) entry,
+    WIT_COLUMNS-keyed (minus the sentinel) plus global "round"/"trial"/
+    "node" ids — the rendering contract TpuNetwork.get_witness and the
+    Perfetto exporter share.  Unwritten rows (gap rows of a fresh-buffer
+    resume included) are skipped via the WIT_WRITTEN sentinel."""
+    buf = np.asarray(buffer).astype(np.int64)
+    rows = []
+    for r in np.nonzero(buf[:, 0, 0, WIT_WRITTEN] > 0)[0]:
+        for wi, t in enumerate(trial_ids):
+            for ki, n in enumerate(node_ids):
+                d = {"round": int(r), "trial": int(t), "node": int(n)}
+                d.update({col: int(v) for col, v
+                          in zip(WIT_COLUMNS[:WIT_WRITTEN],
+                                 buf[r, wi, ki])})
+                rows.append(d)
+    return rows
+
+
+# --------------------------------------------------------------------------
+# Reports
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Violation:
+    """One invariant breach with its minimal witness."""
+
+    invariant: str                 # one of INVARIANTS
+    trial: int                     # global trial id
+    round: int                     # round index of the (last) breach
+    nodes: List[int]               # global node ids involved
+    detail: Dict                   # tallies / values justifying the claim
+    message: str
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class AuditReport:
+    """The auditor's verdict over one witness bundle."""
+
+    ok: bool
+    violations: List[Violation]
+    checks: Dict[str, int]         # per-invariant count of checks applied
+    rounds_audited: int
+    lanes_audited: int
+    label: str = ""
+
+    def to_dict(self) -> Dict:
+        return {
+            "ok": self.ok,
+            "label": self.label,
+            "rounds_audited": self.rounds_audited,
+            "lanes_audited": self.lanes_audited,
+            "checks": dict(self.checks),
+            "n_violations": len(self.violations),
+            "violations": [v.to_dict() for v in self.violations],
+        }
+
+    def summary(self) -> str:
+        if self.ok:
+            return (f"audit OK: {self.lanes_audited} lanes x "
+                    f"{self.rounds_audited} rounds, "
+                    f"{sum(self.checks.values())} checks, 0 violations")
+        v = self.violations[0]
+        return (f"audit FAILED: {len(self.violations)} violation(s); "
+                f"first: {v.message}")
+
+
+# --------------------------------------------------------------------------
+# The auditor
+# --------------------------------------------------------------------------
+
+
+def _first_decide(series):
+    """(decide_round_index_into_series or None, pre_decided: bool)."""
+    dec = series[:, WIT_DECIDED] > 0
+    if not dec.any():
+        return None, False
+    first = int(np.argmax(dec))
+    return first, first == 0      # decided in row 0 => decide unobserved
+
+
+def _decide_claim(node, value, rd, v0, v1, F):
+    """One node's decide, phrased with only the facts the witness saw:
+    a snapshot-decided lane (fresh-buffer resume) has no observed tallies
+    — never assert quorum evidence the buffer doesn't contain."""
+    tally = v0 if value == VAL0 else v1
+    if tally is None:
+        return (f"node {node} decided {value} at round {rd} "
+                f"(decide pre-dates the witness window)")
+    return (f"node {node} decided {value} at round {rd} "
+            f"(v{value}={tally} > F={F})")
+
+
+def audit_witness(bundle: WitnessBundle) -> AuditReport:
+    """Machine-check the Ben-Or invariants over a witness bundle.
+
+    Returns an AuditReport whose violations carry minimal witnesses
+    (trial, round, node ids, tallies).  Feeds the audit.* counters of
+    utils/metrics.REGISTRY (runs / pass / violations, plus one counter
+    per violated invariant) so outcomes reach the exporters.
+    """
+    buf = np.asarray(bundle.buffer).astype(np.int64)
+    if buf.ndim != 4 or buf.shape[-1] != WIT_WIDTH:
+        raise ValueError(
+            f"witness buffer must be [rounds, W, k, {WIT_WIDTH}]; got "
+            f"{buf.shape}")
+    W, k = buf.shape[1], buf.shape[2]
+    F = int(bundle.n_faulty)
+    violations: List[Violation] = []
+    checks = {name: 0 for name in INVARIANTS}
+    written = np.nonzero(buf[:, 0, 0, WIT_WRITTEN] > 0)[0]
+
+    # validity ground truth: caller-asserted, or derivable when the
+    # witness covers EVERY node (k == n_nodes) and row 0 is unanimous —
+    # partial coverage must not let a locally-unanimous watched set
+    # masquerade as global unanimity (an honest global-minority decide
+    # would then be flagged as a violation that never happened)
+    full_cover = k == bundle.n_nodes and 0 in written
+
+    for wi in range(W):
+        trial = int(bundle.trial_ids[wi])
+        honest = np.ones(k, bool)
+        if bundle.faulty is not None:
+            honest = ~np.asarray(bundle.faulty[wi], bool)
+
+        unanimous = bundle.unanimous
+        if unanimous is None and full_cover:
+            x0 = buf[0, wi, :, WIT_X]
+            live0 = buf[0, wi, :, WIT_KILLED] == 0
+            vals = np.unique(x0[honest & live0])
+            if len(vals) == 1 and vals[0] in (VAL0, VAL1):
+                unanimous = int(vals[0])
+
+        decided_evidence = []      # (node_id, value, round, v0, v1) honest
+        for ki in range(k):
+            node = int(bundle.node_ids[ki])
+            rounds, series = written, buf[written, wi, ki, :]
+            x = series[:, WIT_X]
+            dec = series[:, WIT_DECIDED] > 0
+            killed = series[:, WIT_KILLED] > 0
+            coined = series[:, WIT_COINED] > 0
+            v0, v1 = series[:, WIT_V0], series[:, WIT_V1]
+
+            first, pre_decided = _first_decide(series)
+
+            # --- irrevocability (node.ts:100,103,147-157) ---------------
+            checks["irrevocability"] += 1
+            if first is not None:
+                tail = slice(first, None)
+                if not dec[tail].all():
+                    rbad = int(rounds[first:][~dec[tail]][0])
+                    violations.append(Violation(
+                        "irrevocability", trial, rbad, [node],
+                        {"decide_round": int(rounds[first])},
+                        f"trial {trial} node {node} revoked decided at "
+                        f"round {rbad} (decided at {int(rounds[first])})"))
+                elif bundle.freeze_decided and \
+                        (x[tail] != x[first]).any():
+                    bad_i = first + int(np.argmax(x[tail] != x[first]))
+                    rbad = int(rounds[bad_i])
+                    violations.append(Violation(
+                        "irrevocability", trial, rbad, [node],
+                        {"decided_value": int(x[first]),
+                         "changed_to": int(x[bad_i])},
+                        f"trial {trial} node {node} changed its decided "
+                        f"value after deciding (round {rbad})"))
+
+            # --- quorum evidence (node.ts:99-104; coin node.ts:111) -----
+            if first is not None and not pre_decided:
+                checks["quorum_evidence"] += 1
+                rd = int(rounds[first])
+                val = int(x[first])
+                ev = {"round": rd, "v0": int(v0[first]),
+                      "v1": int(v1[first]), "F": F}
+                if val == VALQ:
+                    violations.append(Violation(
+                        "quorum_evidence", trial, rd, [node], ev,
+                        f"trial {trial} node {node} decided \"?\" at "
+                        f"round {rd} — no decide branch produces it"))
+                elif val == VAL0 and not v0[first] > F:
+                    violations.append(Violation(
+                        "quorum_evidence", trial, rd, [node], ev,
+                        f"trial {trial} node {node} decided 0 at round "
+                        f"{rd} on v0={int(v0[first])} <= F={F}"))
+                elif val == VAL1 and not v1[first] > F:
+                    violations.append(Violation(
+                        "quorum_evidence", trial, rd, [node], ev,
+                        f"trial {trial} node {node} decided 1 at round "
+                        f"{rd} on v1={int(v1[first])} <= F={F}"))
+                elif val == VAL1 and v0[first] > F:
+                    violations.append(Violation(
+                        "quorum_evidence", trial, rd, [node], ev,
+                        f"trial {trial} node {node} decided 1 at round "
+                        f"{rd} although v0={int(v0[first])} > F={F} — "
+                        "the 0-branch is checked first (node.ts:99)"))
+            # coin commits carry complementary evidence
+            for ci in np.nonzero(coined)[0]:
+                checks["quorum_evidence"] += 1
+                rd, ev = int(rounds[ci]), {
+                    "round": int(rounds[ci]), "v0": int(v0[ci]),
+                    "v1": int(v1[ci]), "F": F}
+                # a decided lane only stops coining when it freezes; with
+                # freeze_decided=False it legally re-coins on later ties
+                bad = ((bundle.freeze_decided and dec[ci]) or
+                       (bundle.rule == "reference" and v0[ci] != v1[ci]) or
+                       (bundle.rule == "textbook" and
+                        (v0[ci] > F or v1[ci] > F)))
+                if bad:
+                    violations.append(Violation(
+                        "quorum_evidence", trial, rd, [node], ev,
+                        f"trial {trial} node {node} committed a coin at "
+                        f"round {rd} despite decide/adopt evidence "
+                        f"(v0={int(v0[ci])}, v1={int(v1[ci])})"))
+
+            # --- killed silence (node.ts:21-26,191-194) -----------------
+            checks["killed_silence"] += 1
+            if killed.any():
+                kf = int(np.argmax(killed))
+                tail = slice(kf, None)
+                if (x[tail] != x[kf]).any() or \
+                        (series[tail, WIT_DECIDED] !=
+                         series[kf, WIT_DECIDED]).any() or \
+                        coined[tail].any():
+                    rbad = int(rounds[kf])
+                    violations.append(Violation(
+                        "killed_silence", trial, rbad, [node],
+                        {"killed_round": int(rounds[kf])},
+                        f"trial {trial} node {node} kept participating "
+                        f"after being killed at round {int(rounds[kf])}"))
+
+            # collect the decide evidence for the trial-level checks; a
+            # snapshot decide (pre_decided: fresh-buffer resume) is a real
+            # decision but its justifying tallies were never witnessed
+            if honest[ki] and first is not None and \
+                    int(x[first]) in (VAL0, VAL1):
+                decided_evidence.append(
+                    (node, int(x[first]), int(rounds[first]),
+                     None if pre_decided else int(v0[first]),
+                     None if pre_decided else int(v1[first])))
+
+        # --- agreement (node.ts:99-104) ---------------------------------
+        checks["agreement"] += 1
+        by_value: Dict[int, tuple] = {}
+        for evd in decided_evidence:
+            by_value.setdefault(evd[1], evd)
+        if VAL0 in by_value and VAL1 in by_value:
+            a, b = by_value[VAL0], by_value[VAL1]
+            violations.append(Violation(
+                "agreement", trial, max(a[2], b[2]), [a[0], b[0]],
+                {"node_a": {"node": a[0], "value": 0, "round": a[2],
+                            "v0": a[3], "v1": a[4]},
+                 "node_b": {"node": b[0], "value": 1, "round": b[2],
+                            "v0": b[3], "v1": b[4]},
+                 "F": F},
+                f"trial {trial}: "
+                f"{_decide_claim(a[0], 0, a[2], a[3], a[4], F)} but "
+                f"{_decide_claim(b[0], 1, b[2], b[3], b[4], F)}"
+                " — agreement violated"))
+
+        # --- validity ----------------------------------------------------
+        if unanimous is not None:
+            checks["validity"] += 1
+            for node, val, rd, e0, e1 in decided_evidence:
+                if val != unanimous:
+                    violations.append(Violation(
+                        "validity", trial, rd, [node],
+                        {"unanimous_input": int(unanimous),
+                         "decided": val, "v0": e0, "v1": e1, "F": F},
+                        f"trial {trial} node {node} decided {val} at "
+                        f"round {rd} despite unanimous input "
+                        f"{int(unanimous)}"))
+
+    report = AuditReport(
+        ok=not violations, violations=violations, checks=checks,
+        rounds_audited=max(len(written) - 1, 0), lanes_audited=W * k,
+        label=bundle.label)
+
+    from .utils.metrics import REGISTRY
+    REGISTRY.counter("audit.runs").inc()
+    REGISTRY.counter("audit.pass" if report.ok else "audit.fail").inc()
+    REGISTRY.counter("audit.violations").inc(len(violations))
+    for v in violations:
+        REGISTRY.counter(f"audit.violation.{v.invariant}").inc()
+    return report
+
+
+# --------------------------------------------------------------------------
+# Convenience: run-and-audit, bundle persistence
+# --------------------------------------------------------------------------
+
+
+def default_witness_overrides(trials: int, n_nodes: int) -> Dict:
+    """The default forensic watch-set, as SimConfig overrides: the first
+    min(trials, 4) trials and as many nodes as the device buffer allows
+    (witness_node_ids puts them at both ends of the id range, where the
+    adversary camps and fault masks live).  The single policy the bench
+    witness proof, the CLI ``audit`` defaults and results.py's safety
+    reruns all share — edit it here and they stay in lockstep."""
+    from .config import WITNESS_MAX_NODES
+    return {"witness_trials": tuple(range(min(trials, 4))),
+            "witness_nodes": min(n_nodes, WITNESS_MAX_NODES)}
+
+
+def audit_point(cfg: SimConfig, initial_values=None, faults=None,
+                unanimous: Optional[int] = None, label: str = ""):
+    """Run one witnessed MC batch and audit it -> (report, bundle).
+
+    ``cfg`` must have the witness armed; inputs/faults default like
+    sweep.run_point (per-trial random bits, first-F-faulty).  The bundle
+    carries the watched lanes' faulty mask, so equivocators'/byzantine
+    senders' own decisions stay out of the agreement check.
+    """
+    import jax
+
+    from .state import FaultSpec, init_state
+    from .sim import run_consensus
+    from .sweep import random_inputs
+
+    if not cfg.witness:
+        raise ValueError(
+            "audit_point needs a witnessed config: set "
+            "SimConfig(witness_trials=..., witness_nodes=k)")
+    if initial_values is None:
+        initial_values = random_inputs(cfg.seed, cfg.trials, cfg.n_nodes)
+    if faults is None:
+        faults = FaultSpec.first_f(cfg)
+    state = init_state(cfg, initial_values, faults)
+    out = run_consensus(cfg, state, faults, jax.random.key(cfg.seed))
+    witness = out[-1]
+    bundle = WitnessBundle.from_run(cfg, witness, faults=faults,
+                                    unanimous=unanimous, label=label)
+    return audit_witness(bundle), bundle
+
+
+def save_bundle(path: str, bundle: WitnessBundle,
+                report: Optional[AuditReport] = None) -> None:
+    """Dump a witness bundle (+ its audit verdict) as one JSON document —
+    the artifact results.py's safety studies attach to violating points
+    (schema pinned by tools/witness_bundle_schema.json)."""
+    doc = bundle.to_dict()
+    if report is not None:
+        doc["audit"] = report.to_dict()
+    with open(path, "w") as fh:
+        json.dump(doc, fh, indent=1)
+
+
+def load_bundle(path: str) -> WitnessBundle:
+    """Re-hydrate a saved bundle for offline (re-)auditing."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    return WitnessBundle(
+        buffer=np.asarray(doc["buffer"], np.int64),
+        trial_ids=np.asarray(doc["trial_ids"], np.int64),
+        node_ids=np.asarray(doc["node_ids"], np.int64),
+        rule=doc["rule"], n_faulty=doc["n_faulty"],
+        n_nodes=doc["n_nodes"],
+        freeze_decided=doc.get("freeze_decided", True),
+        faulty=(None if doc.get("faulty") is None
+                else np.asarray(doc["faulty"], bool)),
+        unanimous=doc.get("unanimous"), label=doc.get("label", ""))

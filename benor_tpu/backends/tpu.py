@@ -48,6 +48,9 @@ class TpuNetwork:
         #: Flight-recorder buffer (cfg.record): int32
         #: [max_rounds + 1, state.REC_WIDTH], filled by start().
         self._recorder = None
+        #: Witness buffer (cfg.witness): int32
+        #: [max_rounds + 1, W, k, state.WIT_WIDTH], filled by start().
+        self._witness = None
 
     # -- /status (node.ts:33-39) ----------------------------------------
     def status(self, node_id: int, trial: int = 0):
@@ -79,15 +82,16 @@ class TpuNetwork:
                 "start(on_slice=...) requires SimConfig(poll_rounds > 0); "
                 "this config runs one uninterrupted compiled loop")
         base_key = jax.random.key(self.cfg.seed)
-        record = self.cfg.record
+        record, witness = self.cfg.record, self.cfg.witness
         if self.cfg.poll_rounds > 0:
             # sliced mid-run observability — single-device AND sharded
             # (the mesh case swaps in the shard_map'd slice primitive;
             # everything else, including bit-identity with the one-shot
-            # path, is shared).  Under cfg.record the flight recorder
-            # threads across slices: each published snapshot comes with
-            # the round history filled so far (get_round_history serves
-            # it live to concurrent pollers).
+            # path, is shared).  Under cfg.record / cfg.witness the
+            # flight recorder and witness buffer thread across slices:
+            # each published snapshot comes with the round history and
+            # per-node witness filled so far (get_round_history /
+            # get_witness serve them live to concurrent pollers).
             from ..models.benor import all_settled
             from ..sim import run_consensus_slice, start_state
             import jax.numpy as jnp
@@ -103,24 +107,30 @@ class TpuNetwork:
                 self.state, faults_sh = shard_inputs(self.state,
                                                      self.faults, mesh)
 
-                def slice_fn(st, r, until, rec):
+                def slice_fn(st, r, until, rec, wit):
                     return run_consensus_slice_sharded(
                         self.cfg, st, faults_sh, base_key, mesh, r, until,
-                        recorder=rec)
+                        recorder=rec, witness=wit)
             else:
-                def slice_fn(st, r, until, rec):
+                def slice_fn(st, r, until, rec, wit):
                     return run_consensus_slice(
                         self.cfg, st, self.faults, base_key,
-                        jnp.int32(r), jnp.int32(until), rec)
+                        jnp.int32(r), jnp.int32(until), rec, wit)
             state = start_state(self.cfg, self.state)
             self.state = state               # k=1 visible (node.ts:172)
-            r, rec = 1, None
+            r, rec, wit = 1, None, None
             while True:
-                out = slice_fn(state, r, r + self.cfg.poll_rounds, rec)
+                out = slice_fn(state, r, r + self.cfg.poll_rounds, rec,
+                               wit)
                 r_next, state = out[0], out[1]
+                idx = 2
                 if record:
-                    rec = out[2]
+                    rec = out[idx]
                     self._recorder = rec
+                    idx += 1
+                if witness:
+                    wit = out[idx]
+                    self._witness = wit
                 self.state = state           # publish the live snapshot
                 if on_slice is not None:
                     on_slice()
@@ -130,21 +140,23 @@ class TpuNetwork:
                     break
                 r = rn
             self.rounds_executed = rn - 1
-        elif self.cfg.mesh_shape is not None:
-            from ..parallel import make_mesh, run_consensus_sharded
-            mesh = make_mesh(*self.cfg.mesh_shape)
-            out = run_consensus_sharded(
-                self.cfg, self.state, self.faults, base_key, mesh)
-            self.rounds_executed = int(out[0])
-            self.state = out[1]
-            if record:
-                self._recorder = out[2]
         else:
-            out = run_consensus(self.cfg, self.state, self.faults, base_key)
+            if self.cfg.mesh_shape is not None:
+                from ..parallel import make_mesh, run_consensus_sharded
+                mesh = make_mesh(*self.cfg.mesh_shape)
+                out = run_consensus_sharded(
+                    self.cfg, self.state, self.faults, base_key, mesh)
+            else:
+                out = run_consensus(self.cfg, self.state, self.faults,
+                                    base_key)
             self.rounds_executed = int(out[0])
             self.state = out[1]
+            idx = 2
             if record:
-                self._recorder = out[2]
+                self._recorder = out[idx]
+                idx += 1
+            if witness:
+                self._witness = out[idx]
         self._started = True
 
     # -- /stop (consensus.ts:10-15 -> node.ts:191-194) -------------------
@@ -184,6 +196,31 @@ class TpuNetwork:
         if self._recorder is None:
             return []
         return round_history_rows(np.asarray(self._recorder))
+
+    # -- witness trace (cfg.witness) ---------------------------------------
+    def get_witness(self) -> List[dict]:
+        """Per-node forensic witness rows beside get_round_history() (one
+        dict per watched (round, trial, node): state.WIT_COLUMNS keys plus
+        "round"/"trial"/"node" global ids) — the observable surface of
+        the witness recorder.  Requires SimConfig(witness_trials=...);
+        before start() the history is empty.  Under poll_rounds the
+        witness grows live between slices, same contract as the round
+        history, so a concurrent poller watches each watched lane's
+        evidence chain round by round.  Machine-check the same buffer
+        with benor_tpu.audit.
+        """
+        if not self.cfg.witness:
+            raise ValueError(
+                "get_witness() requires SimConfig(witness_trials=..., "
+                "witness_nodes=k): the witness recorder is off and no "
+                "per-node trace was captured (see README Observability)")
+        from ..audit import witness_rows
+        from ..state import witness_node_ids
+        if self._witness is None:
+            return []
+        return witness_rows(np.asarray(self._witness),
+                            self.cfg.witness_trials,
+                            witness_node_ids(self.cfg))
 
     def get_states(self, trial: int = 0) -> List[dict]:
         # Bulk path: one device->host transfer per array, then N dict builds

@@ -24,6 +24,16 @@ VAL0 = 0
 VAL1 = 1
 VALQ = 2  # the "?" value
 
+#: Ceiling on SimConfig.witness_nodes.  The fused pallas round emits the
+#: witness as extra per-tile partial COLUMNS of its [tiles, T, 128]
+#: reduction layout (ops/pallas_round.py): the vote kernel spends 5 base
+#: + 7 flight-recorder + 6-per-watched-node columns, so 16 watched nodes
+#: (12 + 96 = 108 <= 128) is the largest count every regime can serve
+#: uniformly.  The XLA paths could watch more, but a config that works in
+#: one regime and explodes in another would defeat the witness's whole
+#: cross-regime-forensics contract.
+WITNESS_MAX_NODES = 16
+
 
 @dataclasses.dataclass(frozen=True)
 class SimConfig:
@@ -211,6 +221,28 @@ class SimConfig:
     # is static, so the recorder never enters the trace).
     record: bool = False
 
+    # --- witness traces (per-node forensics; see benor_tpu/audit.py) -----
+    # witness_trials=(t0, t1, ...) + witness_nodes=k arm the WITNESS
+    # recorder: a preallocated [max_rounds + 1, W, k, state.WIT_WIDTH]
+    # int32 buffer rides the compiled round loop and every executed round
+    # writes, for each watched (trial, node), the committed value, decided
+    # bit, killed bit, coin-commit bit and the R/P tallies (proposal
+    # p0/p1, vote v0/v1) that justified the transition — the per-node
+    # evidence the flight recorder's aggregates cannot carry.  Works in
+    # EVERY regime (traced XLA loop, fused pallas round via per-tile
+    # witness partials, poll_rounds slices/resume, the batched dynamic-F
+    # sweep, the sharded/multihost mesh — rows psum-globalized so every
+    # shard holds the identical buffer).  The watched node set is the
+    # first ceil(k/2) + last floor(k/2) global node ids
+    # (state.witness_node_ids): both ends of the id range, where the
+    # seeded fault masks (first-F-faulty) and the targeted adversary's
+    # camps (top of the range) live.  witness off (the default) leaves
+    # every executable bit-identical in results AND compile counts, the
+    # same discipline as ``record``.  Host-side machine-checking of the
+    # Ben-Or invariants over a filled buffer: benor_tpu/audit.py.
+    witness_trials: Optional[Tuple[int, ...]] = None
+    witness_nodes: int = 0
+
     # --- misc -----------------------------------------------------------
     # The N1 backend switch: 'tpu' = device-array simulator; 'express' =
     # pure-Python event-loop oracle; 'native' = the C++ oracle (bit-exact
@@ -284,6 +316,40 @@ class SimConfig:
                 "use_pallas_round packs the round counter k into the top "
                 "27 bits of an int32; max_rounds must be < 2**26 - 1 "
                 f"(got {self.max_rounds})")
+        if self.witness_trials is not None:
+            # normalize to a sorted unique tuple: the config must stay
+            # hashable (jit-static) and the witness row layout deterministic
+            wt = tuple(sorted({int(t) for t in self.witness_trials}))
+            if not wt:
+                raise ValueError(
+                    "witness_trials must name at least one trial "
+                    "(None disables witnessing)")
+            if wt[0] < 0 or wt[-1] >= self.trials:
+                raise ValueError(
+                    f"witness_trials must lie in [0, trials); got {wt} "
+                    f"with trials={self.trials}")
+            object.__setattr__(self, "witness_trials", wt)
+            if not (1 <= self.witness_nodes <= self.n_nodes):
+                raise ValueError(
+                    "witness_nodes must be in [1, n_nodes] when "
+                    f"witness_trials is set (got {self.witness_nodes})")
+            if self.witness_nodes > WITNESS_MAX_NODES:
+                raise ValueError(
+                    f"witness_nodes must be <= {WITNESS_MAX_NODES}: the "
+                    "fused pallas round carries the witness as extra "
+                    "partial columns of its 128-column reduction layout "
+                    "(see config.WITNESS_MAX_NODES)")
+            if self.backend != "tpu":
+                raise ValueError(
+                    "witness_trials fills the on-device witness recorder "
+                    "inside the tpu backend's compiled loop; the "
+                    "event-loop oracles have no device buffer to fill — "
+                    "a silent no-op would fake per-node forensics, so "
+                    "use backend='tpu'")
+        elif self.witness_nodes:
+            raise ValueError(
+                "witness_nodes requires witness_trials (which trials to "
+                "watch); set both or neither")
         if self.record and self.backend != "tpu":
             raise ValueError(
                 "record=True fills the on-device flight recorder inside "
@@ -299,6 +365,11 @@ class SimConfig:
     def quorum(self) -> int:
         """Messages required before a tally fires: N - F (node.ts:52,88)."""
         return self.n_nodes - self.n_faulty
+
+    @property
+    def witness(self) -> bool:
+        """True iff the witness recorder is armed (witness_trials set)."""
+        return self.witness_trials is not None
 
     @property
     def resolved_path(self) -> str:

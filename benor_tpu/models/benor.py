@@ -46,7 +46,8 @@ def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
                 base_key: jax.Array, r: jax.Array,
                 ctx: ShardCtx = SINGLE,
                 dyn: Optional[DynParams] = None,
-                recorder: Optional[jax.Array] = None):
+                recorder: Optional[jax.Array] = None,
+                witness: Optional[jax.Array] = None):
     """Advance every lane by one full Ben-Or round (proposal + vote phase).
 
     ``r`` is the 1-based round index; matches the reference's message ``k``.
@@ -63,6 +64,15 @@ def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
     The recorder only REDUCES values the round already computes — no
     random stream moves — so recorded results are bit-identical to
     unrecorded ones.
+
+    ``witness`` (witness buffer, state.new_witness, or None) makes this
+    round write its per-node forensic row (state.WIT_* columns — value,
+    decided/killed bits, coin-commit bit, and the proposal/vote tallies
+    that justified the transition — for every watched (trial, node),
+    psum-globalized under a mesh) at index ``r`` and appends the new
+    buffer to the return, after the recorder when both ride.  Like the
+    recorder, the witness only REDUCES values the round already computes,
+    so witnessed results are bit-identical to unwitnessed ones.
 
     ``dyn`` (DynParams or None) supplies F and the quorum as TRACED
     scalars for the batched dynamic-F sweep (sweep.run_curve_batched):
@@ -101,13 +111,17 @@ def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
         cr = (pr._pad_cr(faults, pack.shape[1])
               if cfg.fault_model == "crash_at_round" else None)
         hist1 = pr.sent_hist_from_pack(cfg, pack, cr, r, ctx)
-        new_pack, _, _, row = pr.packed_round(cfg, pack, faults, base_key,
-                                              r, hist1, ctx, N)
+        new_pack, _, _, row, wrow = pr.packed_round(
+            cfg, pack, faults, base_key, r, hist1, ctx, N)
         new_state = pr.unpack_state(new_pack, N)
+        extras = []
         if recorder is not None:
             from ..state import recorder_write
-            return new_state, recorder_write(recorder, r, row)
-        return new_state
+            extras.append(recorder_write(recorder, r, row))
+        if witness is not None:
+            from ..state import witness_write
+            extras.append(witness_write(witness, r, wrow))
+        return (new_state, *extras) if extras else new_state
 
     # --- crash-at-round fault injection (start of round) -----------------
     killed = state.killed
@@ -225,21 +239,29 @@ def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
 
     new_state = NetState(x=new_x, decided=new_decided, k=new_k,
                          killed=killed)
+    if recorder is None and witness is None:
+        return new_state
+    # lanes that COMMITTED a coin flip: ran the round, no decide and
+    # (reference rule) no plurality-adopt — the same branch structure as
+    # the x2 selection above; shared by the recorder and the witness
+    no_decide = active & ~decide0 & ~decide1
+    if cfg.rule == "reference":
+        coined = no_decide & ~adopt0 & ~adopt1
+    else:
+        coined = no_decide
+    extras = []
     if recorder is not None:
         from ..state import recorder_round_row, recorder_write
-        # lanes that COMMITTED a coin flip: ran the round, no decide and
-        # (reference rule) no plurality-adopt — the same branch structure
-        # as the x2 selection above
-        no_decide = active & ~decide0 & ~decide1
-        if cfg.rule == "reference":
-            coined = no_decide & ~adopt0 & ~adopt1
-        else:
-            coined = no_decide
         margin = jnp.where(active, jnp.abs(v0 - v1), 0).astype(jnp.int32)
         row = recorder_round_row(new_x, new_decided, killed, coined,
                                  margin, ctx)
-        return new_state, recorder_write(recorder, r, row)
-    return new_state
+        extras.append(recorder_write(recorder, r, row))
+    if witness is not None:
+        from ..state import witness_round_row, witness_write
+        wrow = witness_round_row(cfg, new_x, new_decided, killed, coined,
+                                 cnt1[..., 0], cnt1[..., 1], v0, v1, ctx)
+        extras.append(witness_write(witness, r, wrow))
+    return (new_state, *extras)
 
 
 def all_settled(state: NetState, ctx: ShardCtx = SINGLE) -> jax.Array:

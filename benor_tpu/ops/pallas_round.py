@@ -72,6 +72,51 @@ _RP_DEC, _RP_KILL = 5, 6
 _RP_U0, _RP_U1, _RP_UQ = 7, 8, 9
 _RP_COIN, _RP_MARGIN = 10, 11
 
+#: Witness-partial layout (SimConfig.witness_trials / witness_nodes).
+#: Each watched global node id owns a block of per-tile partial columns —
+#: only the tile holding the (real, non-pad) lane contributes, so the
+#: cross-tile/cross-shard combine is a plain sum.  The proposal kernel
+#: emits 2 columns per watched node (p0, p1) starting at _WITA_BASE; the
+#: vote kernel emits 6 (x, decided, killed, coined, v0, v1) starting
+#: after its base + flight-recorder columns (see _witb_base).  The
+#: per-trial values ride the partial layout's [T] axis; packed_round
+#: selects the watched trials outside the kernel.
+_WITA_BASE = 4
+_WITA_PER_NODE = 2
+_WITB_PER_NODE = 6
+
+
+def _witb_base(record: bool) -> int:
+    """First vote-kernel witness column: after the 5 base partials and,
+    when the flight recorder rides too, its 7 telemetry columns."""
+    return 5 + (7 if record else 0)
+
+
+def _witness_cols(scal_ref, shape, witness_ids, n_local, fields):
+    """Per-tile witness partial columns: for each watched GLOBAL node id,
+    one column per field carrying that lane's value (all other tiles
+    contribute 0, so the combine is a sum).  Pad lanes are masked by
+    LOCAL index: on a node-sharded mesh a non-final shard's pad ids alias
+    the NEXT shard's real id range (same caveat _camp_select documents)
+    and their in-kernel draws are keyed on those aliased global ids — an
+    unmasked pad lane would exactly double the real lane's contribution
+    after the node-axis psum."""
+    node, _ = _lane_ids(scal_ref, shape)
+    tile = shape[1]
+    lidx = (jax.lax.broadcasted_iota(jnp.uint32, shape, 1)
+            + jnp.uint32(pl.program_id(0) * tile))
+    real = lidx < jnp.uint32(n_local)
+    cols = []
+    for wid in witness_ids:
+        sel = (node == jnp.uint32(wid)) & real
+        for f in fields:
+            if f.dtype == jnp.float32:
+                v = jnp.sum(jnp.where(sel, f, 0.0), axis=1)
+            else:
+                v = jnp.sum(jnp.where(sel, f, 0), axis=1)
+            cols.append(v.astype(jnp.int32))
+    return cols
+
 
 def pack_state(state: NetState, faulty: jax.Array) -> jax.Array:
     """NetState leaves + faulty mask -> padded packed int32 [T, Np].
@@ -199,7 +244,7 @@ def _camp_select(scal_ref, shape, camp_b0, camp_b1, vecs):
 
 
 def _prop_hist_kernel(m, fault_model, freeze, has_cr, counts_mode,
-                      camp_b0, camp_b1, *refs):
+                      camp_b0, camp_b1, witness_ids, n_local, *refs):
     """One lane-tile of the fused PROPOSAL phase.
 
     Per-lane tallies -> phase-1 majority/tie (node.ts:63-69) -> each
@@ -211,6 +256,13 @@ def _prop_hist_kernel(m, fault_model, freeze, has_cr, counts_mode,
     'equivocate'); 'delivered' broadcasts the adversary's per-trial
     closed-form counts; 'camps' selects the targeted adversary's per-camp
     triple by global lane id — the latter two run no sampler at all.
+
+    ``witness_ids`` (static tuple of global node ids; the witness
+    recorder, SimConfig.witness_trials) appends 2 columns per watched
+    node — its per-lane (p0, p1) proposal tallies, pad lanes masked by
+    ``n_local`` — at _WITA_BASE.  witness off (the empty tuple) emits
+    exactly the historical four columns, so unwitnessed executables stay
+    bit-identical.
     """
     has_eq = fault_model == "equivocate" and counts_mode == "sampled"
     refs = list(refs)
@@ -252,15 +304,19 @@ def _prop_hist_kernel(m, fault_model, freeze, has_cr, counts_mode,
     vote = _sent(fault_model, jnp.where(frozen, x, x1), faulty)
     hon = _honest(fault_model, alive, faulty)
     t = p.shape[0]
-    out_ref[...] = _partial_cols(t, [
+    cols = [
         jnp.sum((vote == v) & hon, axis=1, dtype=jnp.int32)
         for v in (VAL0, VAL1, VALQ)
-    ] + [jnp.sum(alive, axis=1, dtype=jnp.int32)])
+    ] + [jnp.sum(alive, axis=1, dtype=jnp.int32)]
+    if witness_ids:
+        cols += _witness_cols(scal_ref, p.shape, witness_ids, n_local,
+                              [p0, p1])
+    out_ref[...] = _partial_cols(t, cols)
 
 
 def _vote_commit_kernel(m, n_faulty, rule, coin_mode, eps, freeze,
                         fault_model, has_cr, counts_mode, camp_b0,
-                        camp_b1, record, *refs):
+                        camp_b1, record, witness_ids, n_local, *refs):
     """One lane-tile of the fused VOTE phase + commit.
 
     Per-lane vote tallies (by counts_mode, as in _prop_hist_kernel) ->
@@ -279,6 +335,12 @@ def _vote_commit_kernel(m, n_faulty, rule, coin_mode, eps, freeze,
     lanes (combined across tiles with max, not sum — see
     vote_commit_pallas).  record=False emits exactly the historical five
     columns, so unrecorded executables stay bit-identical.
+
+    ``witness_ids`` (static; the witness recorder) appends 6 columns per
+    watched global node id — the lane's committed x / decided / killed /
+    coin-commit bit and its (v0, v1) vote tallies, pad lanes masked by
+    ``n_local`` — after the base (and, when record, telemetry) columns;
+    see _witb_base.  The empty tuple leaves the layout untouched.
     """
     has_eq = fault_model == "equivocate" and counts_mode == "sampled"
     refs = list(refs)
@@ -372,14 +434,18 @@ def _vote_commit_kernel(m, n_faulty, rule, coin_mode, eps, freeze,
         for v in (VAL0, VAL1, VALQ)
     ] + [jnp.sum(settled, axis=1, dtype=jnp.int32),
          jnp.sum(~settled, axis=1, dtype=jnp.int32)]
+    coined = None
+    if record or witness_ids:
+        # coin-commit mask, same branch structure as the XLA path in
+        # models/benor.py (shared by the recorder and witness partials)
+        coined = active & ~decide0 & ~decide1
+        if no_adopt is not None:
+            coined = coined & no_adopt
     if record:
         # flight-recorder partials (_RP_* layout, same masks as the XLA
         # path in models/benor.py — so the delivered/camps regimes, where
         # both paths share every bit, record identical rows)
         undec = (new_dec == 0) & (killed == 0)
-        coined = active & ~decide0 & ~decide1
-        if no_adopt is not None:
-            coined = coined & no_adopt
         margin = jnp.where(active, jnp.abs(v0 - v1), 0.0)
         cols = cols + [
             jnp.sum(new_dec == 1, axis=1, dtype=jnp.int32),
@@ -390,6 +456,10 @@ def _vote_commit_kernel(m, n_faulty, rule, coin_mode, eps, freeze,
             jnp.sum(coined, axis=1, dtype=jnp.int32),
             jnp.max(margin, axis=1).astype(jnp.int32),
         ]
+    if witness_ids:
+        cols = cols + _witness_cols(
+            vote_scal_ref, p.shape, witness_ids, n_local,
+            [new_x, new_dec, killed, coined.astype(jnp.int32), v0, v1])
     part_ref[...] = _partial_cols(t, cols)
 
 
@@ -427,13 +497,14 @@ def _count_vecs(hist, counts_mode):
 
 @functools.partial(jax.jit, static_argnames=(
     "m", "fault_model", "freeze", "interpret", "counts_mode", "camp_b0",
-    "camp_b1"))
+    "camp_b1", "witness_ids", "n_local"))
 def proposal_hist_pallas(base_key, r, phase, hist, pack, crash_round,
                          m: int, fault_model: str, freeze: bool,
                          interpret: bool = False, node_offset=0,
                          trial_offset=0, n_equiv=None,
                          counts_mode: str = "sampled", camp_b0: int = 0,
-                         camp_b1: int = 0):
+                         camp_b1: int = 0, witness_ids: tuple = (),
+                         n_local: int = 0):
     """Fused proposal phase over the packed state -> partials int32
     [T, 128]: cols 0-2 this shard's LOCAL vote histogram, col 3 its alive
     count (callers psum both over the nodes axis under a mesh).
@@ -473,7 +544,8 @@ def proposal_hist_pallas(base_key, r, phase, hist, pack, crash_round,
         specs.append(_lane(T))
     parts = pl.pallas_call(
         functools.partial(_prop_hist_kernel, m, fault_model, freeze,
-                          has_cr, counts_mode, camp_b0, camp_b1),
+                          has_cr, counts_mode, camp_b0, camp_b1,
+                          witness_ids, n_local),
         out_shape=jax.ShapeDtypeStruct((np_total // TILE_N, T, 128),
                                        jnp.int32),
         grid=(np_total // TILE_N,),
@@ -486,14 +558,16 @@ def proposal_hist_pallas(base_key, r, phase, hist, pack, crash_round,
 
 @functools.partial(jax.jit, static_argnames=(
     "m", "n_faulty", "rule", "coin_mode", "eps", "freeze", "fault_model",
-    "interpret", "counts_mode", "camp_b0", "camp_b1", "record"))
+    "interpret", "counts_mode", "camp_b0", "camp_b1", "record",
+    "witness_ids", "n_local"))
 def vote_commit_pallas(base_key, r, phase, hist, pack, crash_round,
                        quorum_ok, shared, m: int, n_faulty: int, rule: str,
                        coin_mode: str, eps: float, freeze: bool,
                        fault_model: str, interpret: bool = False,
                        node_offset=0, trial_offset=0, n_equiv=None,
                        counts_mode: str = "sampled", camp_b0: int = 0,
-                       camp_b1: int = 0, record: bool = False):
+                       camp_b1: int = 0, record: bool = False,
+                       witness_ids: tuple = (), n_local: int = 0):
     """Fused vote phase + commit -> (new_pack [T, Np], partials [T, 128]).
 
     Partials: cols 0-2 the next round's LOCAL proposal histogram (valid
@@ -536,7 +610,8 @@ def vote_commit_pallas(base_key, r, phase, hist, pack, crash_round,
     new_pack, parts = pl.pallas_call(
         functools.partial(_vote_commit_kernel, m, n_faulty, rule,
                           coin_mode, eps, freeze, fault_model, has_cr,
-                          counts_mode, camp_b0, camp_b1, record),
+                          counts_mode, camp_b0, camp_b1, record,
+                          witness_ids, n_local),
         out_shape=[jax.ShapeDtypeStruct((T, np_total), jnp.int32),
                    jax.ShapeDtypeStruct((np_total // TILE_N, T, 128),
                                         jnp.int32)],
@@ -604,13 +679,17 @@ def packed_round(cfg, pack, faults, base_key, r, hist1, ctx, n_local,
     histogram.  ``n_equiv`` is the global live-equivocator count [T]
     ('equivocate' only; derived from the pack when not supplied —
     run_packed precomputes it so the loop stays free of per-lane XLA
-    ops).  Returns (new_pack, hist1_next or None, unsettled [T], row);
-    hist1_next is None under crash_at_round (recompute via
+    ops).  Returns (new_pack, hist1_next or None, unsettled [T], row,
+    wrow); hist1_next is None under crash_at_round (recompute via
     sent_hist_from_pack); ``row`` is the flight-recorder row int32
     [state.REC_WIDTH] when cfg.record (globalized: counts psum'd, margin
-    pmax'd over nodes then summed over trials) and None otherwise.
+    pmax'd over nodes then summed over trials) and None otherwise;
+    ``wrow`` is the witness row int32 [W, k, state.WIT_WIDTH] when
+    cfg.witness (assembled from the kernels' per-tile witness partials,
+    psum-globalized over both mesh axes) and None otherwise.
     """
     from . import rng, tally
+    from ..state import witness_node_ids
 
     T, np_total = pack.shape
     interp = jax.default_backend() == "cpu"
@@ -621,6 +700,8 @@ def packed_round(cfg, pack, faults, base_key, r, hist1, ctx, n_local,
         n_equiv = n_equiv_from_pack(cfg, pack, ctx)
     node_off = ctx.node_ids(n_local)[0]
     trial_off = ctx.trial_ids(T)[0]
+    wids = (tuple(int(i) for i in witness_node_ids(cfg))
+            if cfg.witness else ())
 
     # Counts source (tally.pallas_round_counts_mode): the uniform CF
     # regime samples tallies in-kernel from the phase histogram; the
@@ -646,7 +727,8 @@ def packed_round(cfg, pack, faults, base_key, r, hist1, ctx, n_local,
         base_key, r, rng.PHASE_PROPOSAL, kernel_counts(hist1), pack, cr, m,
         cfg.fault_model, bool(cfg.freeze_decided), interpret=interp,
         node_offset=node_off, trial_offset=trial_off, n_equiv=n_equiv,
-        counts_mode=mode, camp_b0=camp_b0, camp_b1=camp_b1)
+        counts_mode=mode, camp_b0=camp_b0, camp_b1=camp_b1,
+        witness_ids=wids, n_local=n_local)
     hist2 = ctx.psum_nodes(partsA[:, :3])
     n_alive = ctx.psum_nodes(partsA[:, 3])
     quorum_ok = n_alive >= m
@@ -663,7 +745,8 @@ def packed_round(cfg, pack, faults, base_key, r, hist1, ctx, n_local,
         float(cfg.coin_eps), bool(cfg.freeze_decided), cfg.fault_model,
         interpret=interp, node_offset=node_off, trial_offset=trial_off,
         n_equiv=n_equiv, counts_mode=mode, camp_b0=camp_b0,
-        camp_b1=camp_b1, record=bool(cfg.record))
+        camp_b1=camp_b1, record=bool(cfg.record), witness_ids=wids,
+        n_local=n_local)
     hist1_next = (None if cfg.fault_model == "crash_at_round"
                   else ctx.psum_nodes(partsB[:, :3]))
     unsettled = ctx.psum_nodes(partsB[:, 4])
@@ -687,11 +770,41 @@ def packed_round(cfg, pack, faults, base_key, r, hist1, ctx, n_local,
         row = jnp.stack([
             ctx.psum_trials(jnp.sum(per_trial[i], dtype=jnp.int32))
             for i in range(REC_WIDTH)])
-    return new_pack, hist1_next, unsettled, row
+    wrow = None
+    if cfg.witness:
+        from ..state import (WIT_COINED, WIT_DECIDED, WIT_KILLED, WIT_P0,
+                             WIT_P1, WIT_V0, WIT_V1, WIT_WIDTH,
+                             WIT_WRITTEN, WIT_X)
+        k = cfg.witness_nodes
+        witb = _witb_base(bool(cfg.record))
+        # node-axis psum: only the (real-lane) tile owning each watched id
+        # contributed, so the sum IS the value
+        pa = ctx.psum_nodes(
+            partsA[:, _WITA_BASE:_WITA_BASE + _WITA_PER_NODE * k])
+        wb = ctx.psum_nodes(partsB[:, witb:witb + _WITB_PER_NODE * k])
+        # watched-trial selection by GLOBAL id, then the trial-axis psum —
+        # mirrors state.witness_select's mesh discipline
+        wt = jnp.asarray(cfg.witness_trials, jnp.int32)
+        t_oh = (ctx.trial_ids(T)[None, :] == wt[:, None]).astype(jnp.int32)
+        pa_sel = ctx.psum_trials(t_oh @ pa)                   # [W, 2k]
+        wb_sel = ctx.psum_trials(t_oh @ wb)                   # [W, 6k]
+        W = len(cfg.witness_trials)
+        wrow = jnp.zeros((W, k, WIT_WIDTH), jnp.int32)
+        wrow = (wrow
+                .at[:, :, WIT_P0].set(pa_sel[:, 0::2])
+                .at[:, :, WIT_P1].set(pa_sel[:, 1::2])
+                .at[:, :, WIT_X].set(wb_sel[:, 0::6])
+                .at[:, :, WIT_DECIDED].set(wb_sel[:, 1::6])
+                .at[:, :, WIT_KILLED].set(wb_sel[:, 2::6])
+                .at[:, :, WIT_COINED].set(wb_sel[:, 3::6])
+                .at[:, :, WIT_V0].set(wb_sel[:, 4::6])
+                .at[:, :, WIT_V1].set(wb_sel[:, 5::6])
+                .at[:, :, WIT_WRITTEN].set(1))
+    return new_pack, hist1_next, unsettled, row, wrow
 
 
 def run_packed_slice(cfg, state, faults, base_key, from_round, until_round,
-                     ctx=None, recorder=None):
+                     ctx=None, recorder=None, witness=None):
     """The packed while-loop, generalized over (mesh ctx, round bounds).
 
     At most ``until_round - from_round`` rounds from ``from_round`` (both
@@ -711,15 +824,21 @@ def run_packed_slice(cfg, state, faults, base_key, from_round, until_round,
     FUSED regime gets full round history with no demotion and no host
     round trips.  ``recorder`` threads an existing buffer across slices
     (None builds a fresh one snapshotting ``state`` into row 0); the
-    filled buffer is appended to the return.
+    filled buffer is appended to the return.  cfg.witness threads
+    ``witness`` identically (appended after the recorder when both ride):
+    the kernels' per-tile witness partials land in the same buffer the
+    XLA regimes fill, with no demotion.
     """
     from ..ops.collectives import SINGLE
-    from ..state import new_recorder, recorder_write
+    from ..state import (new_recorder, new_witness, recorder_write,
+                         witness_write)
 
     ctx = SINGLE if ctx is None else ctx
     n_local = state.x.shape[-1]
     if cfg.record and recorder is None:
         recorder = new_recorder(cfg, state, ctx)
+    if cfg.witness and witness is None:
+        witness = new_witness(cfg, state, ctx)
     pack = pack_state(state, faults.faulty)
     cr = (_pad_cr(faults, pack.shape[1])
           if cfg.fault_model == "crash_at_round" else None)
@@ -737,38 +856,39 @@ def run_packed_slice(cfg, state, faults, base_key, from_round, until_round,
         r, pack, hist1 = carry[0], carry[1], carry[2]
         if cfg.fault_model == "crash_at_round":
             hist1 = sent_hist_from_pack(cfg, pack, cr, r, ctx)
-        new_pack, hist1_next, unsettled, row = packed_round(
+        new_pack, hist1_next, unsettled, row, wrow = packed_round(
             cfg, pack, faults, base_key, r, hist1, ctx, n_local,
             n_equiv=n_equiv)
         if hist1_next is None:
             hist1_next = hist1              # recomputed next iteration
         out = (r + 1, new_pack, hist1_next,
                ctx.psum_trials(jnp.sum(unsettled)))
+        i = 4
         if cfg.record:
-            out = out + (recorder_write(carry[4], r, row),)
+            out = out + (recorder_write(carry[i], r, row),)
+            i += 1
+        if cfg.witness:
+            out = out + (witness_write(carry[i], r, wrow),)
         return out
 
     carry = (jnp.asarray(from_round, jnp.int32), pack, hist1, unsettled0)
     if cfg.record:
         carry = carry + (recorder,)
+    if cfg.witness:
+        carry = carry + (witness,)
     out = jax.lax.while_loop(cond, body, carry)
     r, pack = out[0], out[1]
-    if cfg.record:
-        return r, unpack_state(pack, n_local), out[4]
-    return r, unpack_state(pack, n_local)
+    return (r, unpack_state(pack, n_local), *out[4:])
 
 
 def run_packed(cfg, state, faults, base_key):
     """Single-device fast path for sim.run_consensus: run_packed_slice
     from /start with an unbounded slice.  Bit-identical to the generic
-    loop.  With cfg.record, returns the filled flight recorder too."""
+    loop.  With cfg.record / cfg.witness, returns the filled flight
+    recorder / witness buffer too."""
     from ..sim import start_state
 
     state = start_state(cfg, state)
     out = run_packed_slice(cfg, state, faults, base_key,
                            jnp.int32(1), jnp.int32(cfg.max_rounds + 2))
-    if cfg.record:
-        r, fin, rec = out
-        return r - 1, fin, rec
-    r, fin = out
-    return r - 1, fin
+    return (out[0] - 1, *out[1:])

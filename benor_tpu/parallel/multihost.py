@@ -161,7 +161,8 @@ def run_consensus_multihost(cfg: SimConfig, state: NetState,
     (sweep.summarize_final) or gather with
     jax.experimental.multihost_utils.process_allgather(..., tiled=True).
     Under cfg.record the (replicated) flight recorder is appended as a
-    third output, like every other runner.
+    third output, like every other runner; under cfg.witness the
+    (replicated) witness buffer follows it.
     """
     meshlib.check_divisible(cfg.trials, cfg.n_nodes, mesh)
     _check_global(state, faults, (cfg.trials, cfg.n_nodes))
@@ -172,7 +173,7 @@ def run_consensus_multihost(cfg: SimConfig, state: NetState,
 def run_consensus_slice_multihost(cfg: SimConfig, state: NetState,
                                   faults: FaultSpec, base_key: jax.Array,
                                   mesh: Mesh, from_round, until_round,
-                                  recorder=None):
+                                  recorder=None, witness=None):
     """Mid-run observability (cfg.poll_rounds) on a process-spanning mesh.
 
     Counterpart of sharded.run_consensus_slice_sharded with global inputs
@@ -185,7 +186,9 @@ def run_consensus_slice_multihost(cfg: SimConfig, state: NetState,
 
     Under cfg.record the (replicated) flight recorder threads through
     like every other slice primitive: pass the previous slice's buffer,
-    None starts a fresh one; the filled buffer is the third output."""
+    None starts a fresh one; the filled buffer is the third output.  The
+    witness buffer (cfg.witness) threads the same way, appended after
+    the recorder when both are armed."""
     meshlib.check_divisible(cfg.trials, cfg.n_nodes, mesh)
     _check_global(state, faults, (cfg.trials, cfg.n_nodes))
     args = (state, faults, base_key, jnp.int32(from_round),
@@ -195,6 +198,11 @@ def run_consensus_slice_multihost(cfg: SimConfig, state: NetState,
             from ..state import new_recorder
             recorder = new_recorder(cfg, state)
         args = args + (recorder,)
+    if cfg.witness:
+        if witness is None:
+            from ..state import new_witness
+            witness = new_witness(cfg, state)
+        args = args + (witness,)
     return sharded._compiled_slice(cfg, mesh)(*args)
 
 

@@ -74,26 +74,24 @@ def _local_run(cfg: SimConfig, fresh: bool, state: NetState,
     so the round loop exists ONCE.  With cfg.record the flight recorder
     is created in-shard (its rows are psum-globalized, so every shard
     holds the identical replicated buffer) and returned as a third
-    output.
+    output; with cfg.witness the witness buffer follows it, same
+    replication argument.
     """
     if fresh:
         state = start_state(cfg, state)
     out = _local_slice(cfg, state, faults, base_key, from_round,
                        jnp.int32(cfg.max_rounds + 1))
-    if cfg.record:
-        r, state, recorder = out
-        return r - 1, state, recorder
-    r, state = out
-    return r - 1, state
+    return (out[0] - 1, *out[1:])
 
 
 @functools.lru_cache(maxsize=None)
 def _compiled(cfg: SimConfig, mesh: Mesh, fresh: bool = True):
     sspec = meshlib.STATE_SPEC
-    # the flight recorder (cfg.record) is a replicated extra output: its
-    # rows are psum/pmax-globalized before every write, so each shard
-    # computes the identical buffer
-    out_specs = (P(), sspec) + ((P(),) if cfg.record else ())
+    # the flight recorder (cfg.record) and the witness buffer
+    # (cfg.witness) are replicated extra outputs: their rows are
+    # psum/pmax-globalized before every write, so each shard computes the
+    # identical buffer
+    out_specs = (P(), sspec) + (P(),) * (cfg.record + cfg.witness)
     fn = shard_map(
         functools.partial(_local_run, cfg, fresh),
         mesh=mesh,
@@ -131,7 +129,8 @@ def run_consensus_sharded(cfg: SimConfig, state: NetState, faults: FaultSpec,
 
 def _local_slice_packed(cfg: SimConfig, state: NetState, faults: FaultSpec,
                         base_key: jax.Array, from_round: jax.Array,
-                        until_round: jax.Array, recorder=None):
+                        until_round: jax.Array, recorder=None,
+                        witness=None):
     """The fused-round fast path of _local_slice: the PACKED per-lane
     word is the while-loop carry (the sharded counterpart of
     pallas_round.run_packed).
@@ -146,12 +145,13 @@ def _local_slice_packed(cfg: SimConfig, state: NetState, faults: FaultSpec,
     from ..ops.pallas_round import run_packed_slice
 
     return run_packed_slice(cfg, state, faults, base_key, from_round,
-                            until_round, MESH_CTX, recorder=recorder)
+                            until_round, MESH_CTX, recorder=recorder,
+                            witness=witness)
 
 
 def _local_slice(cfg: SimConfig, state: NetState, faults: FaultSpec,
                  base_key: jax.Array, from_round: jax.Array,
-                 until_round: jax.Array, recorder=None):
+                 until_round: jax.Array, recorder=None, witness=None):
     """Per-shard slice body: at most ``until_round - from_round`` rounds.
 
     The sharded counterpart of sim.run_consensus_slice (same contract:
@@ -168,11 +168,13 @@ def _local_slice(cfg: SimConfig, state: NetState, faults: FaultSpec,
 
     With cfg.record the flight recorder threads through (created fresh
     when ``recorder`` is None) and is returned as a third output —
-    replicated, since every row write is psum-globalized first.
+    replicated, since every row write is psum-globalized first.  The
+    witness buffer (cfg.witness) threads identically, appended after the
+    recorder when both ride.
     """
     from ..ops.tally import pallas_round_active
     from ..sim import warn_debug_demotes_pallas
-    from ..state import new_recorder
+    from ..state import new_recorder, new_witness
 
     ctx = MESH_CTX
     pallas = pallas_round_active(cfg)
@@ -181,22 +183,31 @@ def _local_slice(cfg: SimConfig, state: NetState, faults: FaultSpec,
     if pallas and not cfg.debug:
         return _local_slice_packed(cfg, state, faults, base_key,
                                    from_round, until_round,
-                                   recorder=recorder)
+                                   recorder=recorder, witness=witness)
     if cfg.record and recorder is None:
         recorder = new_recorder(cfg, state, ctx)
+    if cfg.witness and witness is None:
+        witness = new_witness(cfg, state, ctx)
 
     def body(carry):
         r, st = carry[0], carry[1]
+        i = 3
+        rec = wit = None
         if cfg.record:
-            st, rec = benor_round(cfg, st, faults, base_key, r, ctx,
-                                  recorder=carry[3])
+            rec = carry[i]
+            i += 1
+        if cfg.witness:
+            wit = carry[i]
+        out = benor_round(cfg, st, faults, base_key, r, ctx,
+                          recorder=rec, witness=wit)
+        if cfg.record or cfg.witness:
+            st, *extras = out
         else:
-            st = benor_round(cfg, st, faults, base_key, r, ctx)
+            st, extras = out, []
         if cfg.debug:
             from ..utils.tracing import emit_round_event
             emit_round_event(st, ctx)
-        out = (r + 1, st, all_settled(st, ctx))
-        return out + ((rec,) if cfg.record else ())
+        return (r + 1, st, all_settled(st, ctx), *extras)
 
     def cond(carry):
         r, settled = carry[0], carry[2]
@@ -205,18 +216,19 @@ def _local_slice(cfg: SimConfig, state: NetState, faults: FaultSpec,
     carry = (from_round.astype(jnp.int32), state, all_settled(state, ctx))
     if cfg.record:
         carry = carry + (recorder,)
+    if cfg.witness:
+        carry = carry + (witness,)
     out = jax.lax.while_loop(cond, body, carry)
-    if cfg.record:
-        return out[0], out[1], out[3]
-    return out[0], out[1]
+    return (out[0], out[1], *out[3:])
 
 
 @functools.lru_cache(maxsize=None)
 def _compiled_slice(cfg: SimConfig, mesh: Mesh):
     sspec = meshlib.STATE_SPEC
-    # under cfg.record the recorder is a replicated extra INPUT (so poll
-    # slices keep filling one buffer) and extra output
-    rec = (P(),) if cfg.record else ()
+    # under cfg.record / cfg.witness each armed buffer is a replicated
+    # extra INPUT (so poll slices keep filling one buffer) and extra
+    # output, recorder first
+    rec = (P(),) * (cfg.record + cfg.witness)
     fn = shard_map(
         functools.partial(_local_slice, cfg),
         mesh=mesh,
@@ -230,15 +242,15 @@ def _compiled_slice(cfg: SimConfig, mesh: Mesh):
 def run_consensus_slice_sharded(cfg: SimConfig, state: NetState,
                                 faults: FaultSpec, base_key: jax.Array,
                                 mesh: Mesh, from_round, until_round,
-                                recorder=None):
+                                recorder=None, witness=None):
     """Mid-run observability (cfg.poll_rounds) under a device mesh.
 
-    Same semantics as sim.run_consensus_slice (including the recorder
-    threading under cfg.record: pass the previous slice's buffer, None
-    starts a fresh one); because every random draw is keyed on global
-    (trial, node, round) ids, a sliced sharded run is bit-identical to
-    the one-shot sharded run AND to the single-device run for any mesh
-    shape (tests/test_parallel.py pins both).
+    Same semantics as sim.run_consensus_slice (including the recorder /
+    witness threading under cfg.record / cfg.witness: pass the previous
+    slice's buffers, None starts fresh ones); because every random draw
+    is keyed on global (trial, node, round) ids, a sliced sharded run is
+    bit-identical to the one-shot sharded run AND to the single-device
+    run for any mesh shape (tests/test_parallel.py pins both).
     """
     meshlib.check_divisible(cfg.trials, cfg.n_nodes, mesh)
     state, faults = shard_inputs(state, faults, mesh)
@@ -249,6 +261,11 @@ def run_consensus_slice_sharded(cfg: SimConfig, state: NetState,
             from ..state import new_recorder
             recorder = new_recorder(cfg, state)
         args = args + (recorder,)
+    if cfg.witness:
+        if witness is None:
+            from ..state import new_witness
+            witness = new_witness(cfg, state)
+        args = args + (witness,)
     return _compiled_slice(cfg, mesh)(*args)
 
 
@@ -263,7 +280,9 @@ def resume_consensus_sharded(cfg: SimConfig, state: NetState,
     ``next_round``); it is traced, so resumes at different rounds share one
     compiled executable.  Under cfg.record a FRESH (re-entry) recorder is
     appended as a third output — rows before ``from_round`` stay
-    unwritten (utils/metrics.py renders gapped buffers by round index)."""
+    unwritten (utils/metrics.py renders gapped buffers by round index);
+    cfg.witness appends a fresh witness buffer after it, same gap
+    semantics."""
     meshlib.check_divisible(cfg.trials, cfg.n_nodes, mesh)
     state, faults = shard_inputs(state, faults, mesh)
     return _compiled(cfg, mesh, fresh=False)(state, faults, base_key,

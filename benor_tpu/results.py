@@ -38,6 +38,11 @@ Studies beyond the presets:
                     EVERY 1 <= F < N/2 (even quorum), livelock past 1/2,
                     and ONE equivocator kills agreement at any N.  The
                     sharp counterpart of the soft 'disagreement' curve.
+                    Both safety studies auto-rerun every violating point
+                    with the witness recorder armed (_witness_rerun) and
+                    attach the invariant auditor's verdict + a
+                    witness_*.json bundle pinpointing (trial, round,
+                    node, tallies) — see benor_tpu/audit.py.
   oracle_parity   — oracle <-> scheduler distribution parity (SURVEY
                     hard-part 1): within the reference contract the
                     event-loop asynchrony is tally-invisible (alive ==
@@ -195,6 +200,49 @@ def margin_sweep(n: int, trials: int, seed: int = 0, f_frac: float = 0.40,
     return rows
 
 
+def _witness_rerun(cfg: SimConfig, initial_values, faults, tag: str,
+                   out_dir=None, verbose=True) -> Dict:
+    """Forensic auto-rerun of an agreement-violating safety point.
+
+    When a safety study reports ``disagree_frac > 0`` the aggregate says
+    only THAT agreement broke; this reruns the same (config, seed) point
+    with the witness recorder armed (first few trials, both ends of the
+    node-id range — where the camps and fault masks live), machine-checks
+    the Ben-Or invariants (benor_tpu/audit.py) and dumps the witness
+    bundle as JSON so the violation is pinpointed to (trial, round, node
+    ids, tallies).  The rerun is bit-identical to the original point
+    (witnessing never moves a random stream), so the evidence is OF the
+    violating run, not of a lookalike.  Returns the summary dict the
+    study row embeds; the bundle also renders as Perfetto trace slices
+    via utils/metrics.export_chrome_trace(witness=...).
+    """
+    import jax
+
+    from . import audit
+    from .sim import run_consensus
+
+    wcfg = cfg.replace(
+        **audit.default_witness_overrides(cfg.trials, cfg.n_nodes))
+    state = init_state(wcfg, initial_values, faults)
+    out = run_consensus(wcfg, state, faults, jax.random.key(wcfg.seed))
+    bundle = audit.WitnessBundle.from_run(wcfg, out[-1], faults=faults,
+                                          label=tag)
+    report = audit.audit_witness(bundle)
+    summary: Dict = {"audit_ok": report.ok,
+                     "n_violations": len(report.violations)}
+    if report.violations:
+        summary["first_violation"] = report.violations[0].to_dict()
+    if out_dir:
+        path = os.path.join(out_dir, f"witness_{tag}.json")
+        audit.save_bundle(path, bundle, report)
+        summary["bundle"] = path
+    if verbose:
+        print(f"    {report.summary()}"
+              + (f" -> {summary['bundle']}" if "bundle" in summary else ""),
+              flush=True)
+    return summary
+
+
 #: Split-adversary strengths for the disagreement study — spaced to frame
 #: the sharp safety phase transition (s_c ~ 0.45 at f = 0.25: below it the
 #: quorum overlap still forces enough starved-class messages through to
@@ -207,7 +255,7 @@ STRENGTHS = (0.0, 0.25, 0.4, 0.45, 0.5, 0.75, 1.0)
 
 def disagreement_sweep(n: int, trials: int, seed: int = 0,
                        f_frac: float = 0.25, strengths=STRENGTHS,
-                       verbose=True) -> List[Dict]:
+                       verbose=True, out_dir=None) -> List[Dict]:
     # The s=0 control is the same static config as balanced_curve's f=0.25
     # point, so inside generate() its executable comes from the jit cache
     # and the "duplicate" run costs one cached dispatch, not a compile.
@@ -218,13 +266,21 @@ def disagreement_sweep(n: int, trials: int, seed: int = 0,
                         scheduler="biased" if s > 0 else "uniform",
                         adversary_strength=s, path="histogram", seed=seed,
                         **_flagship_flags())
+        faults = FaultSpec.none(trials, n)
         pt = run_point(cfg, initial_values=_balanced(trials, n),
-                       faults=FaultSpec.none(trials, n))
-        rows.append({"strength": s, **pt.to_dict()})
+                       faults=faults)
+        row = {"strength": s, **pt.to_dict()}
         if verbose:
             print(f"  s={s}: disagree={pt.disagree_frac:.3f} "
                   f"decided={pt.decided_frac:.3f} mean_k={pt.mean_k:.2f}",
                   flush=True)
+        if pt.disagree_frac > 0:
+            # agreement broke: auto-rerun with witnessing and pin WHICH
+            # nodes decided WHICH value on WHAT quorum evidence
+            row["witness_audit"] = _witness_rerun(
+                cfg, _balanced(trials, n), faults,
+                f"disagreement_s{s}", out_dir, verbose)
+        rows.append(row)
     return rows
 
 
@@ -238,7 +294,7 @@ def _even_quorum_f(n: int, frac: float) -> int:
 
 
 def safety_violation(n: int, trials: int, seed: int = 0,
-                     verbose=True) -> List[Dict]:
+                     verbose=True, out_dir=None) -> List[Dict]:
     """Agreement violation under the PARTITIONED count-controlling
     adversary (scheduler='targeted') — r3 VERDICT item 3.
 
@@ -250,17 +306,32 @@ def safety_violation(n: int, trials: int, seed: int = 0,
     and the run livelocks.  The final rows put one equivocator in the
     population: agreement dies at ANY N (the count > F rule has no
     Byzantine safety margin at all).
+
+    Every violating row auto-reruns with the witness recorder armed
+    (_witness_rerun) and embeds the audit verdict — the minimal (trial,
+    round, node, tallies) witness of its agreement break; bundles land in
+    ``out_dir`` when given.
     """
     rows = []
+
+    def _row(cfg, faults, extra, tag):
+        pt = run_point(cfg, initial_values=_balanced(trials, n),
+                       faults=faults)
+        row = {**extra, **pt.to_dict()}
+        if pt.disagree_frac > 0:
+            row["witness_audit"] = _witness_rerun(
+                cfg, _balanced(trials, n), faults, tag, out_dir, verbose)
+        rows.append(row)
+        return pt
+
     for frac in (0.0, 0.01, 0.1, 0.25, 0.4, 0.49):
         f = _even_quorum_f(n, frac) if frac else 0
         cfg = SimConfig(n_nodes=n, n_faulty=f, trials=trials, max_rounds=16,
                         delivery="quorum", scheduler="targeted",
                         path="histogram", seed=seed)
-        pt = run_point(cfg, initial_values=_balanced(trials, n),
-                       faults=FaultSpec.none(trials, n))
-        rows.append({"f": f, "f_frac": round(f / n, 4),
-                     "fault_model": "crash", **pt.to_dict()})
+        pt = _row(cfg, FaultSpec.none(trials, n),
+                  {"f": f, "f_frac": round(f / n, 4),
+                   "fault_model": "crash"}, f"targeted_f{f}")
         if verbose:
             print(f"  f={f:,}: disagree={pt.disagree_frac:.3f} "
                   f"decided={pt.decided_frac:.3f}", flush=True)
@@ -269,10 +340,9 @@ def safety_violation(n: int, trials: int, seed: int = 0,
     cfg = SimConfig(n_nodes=n, n_faulty=f_half, trials=trials, max_rounds=16,
                     delivery="quorum", scheduler="targeted",
                     path="histogram", seed=seed)
-    pt = run_point(cfg, initial_values=_balanced(trials, n),
-                   faults=FaultSpec.none(trials, n))
-    rows.append({"f": f_half, "f_frac": round(f_half / n, 4),
-                 "fault_model": "crash", **pt.to_dict()})
+    pt = _row(cfg, FaultSpec.none(trials, n),
+              {"f": f_half, "f_frac": round(f_half / n, 4),
+               "fault_model": "crash"}, f"targeted_f{f_half}")
     if verbose:
         print(f"  f={f_half:,} (past 1/2): decided={pt.decided_frac:.3f} "
               f"(livelock)", flush=True)
@@ -285,10 +355,10 @@ def safety_violation(n: int, trials: int, seed: int = 0,
         cfg = SimConfig(n_nodes=n, n_faulty=f, trials=trials, max_rounds=16,
                         delivery="quorum", scheduler="targeted",
                         path="histogram", seed=seed)
-        pt = run_point(cfg, initial_values=_balanced(trials, n),
-                       faults=FaultSpec.none(trials, n))
-        rows.append({"f": f, "f_frac": round(f / n, 4),
-                     "fault_model": f"crash ({label})", **pt.to_dict()})
+        pt = _row(cfg, FaultSpec.none(trials, n),
+                  {"f": f, "f_frac": round(f / n, 4),
+                   "fault_model": f"crash ({label})"},
+                  f"targeted_odd_f{f}")
         if verbose:
             print(f"  f={f:,} ({label}): disagree={pt.disagree_frac:.3f}",
                   flush=True)
@@ -296,10 +366,9 @@ def safety_violation(n: int, trials: int, seed: int = 0,
     cfg = SimConfig(n_nodes=n, n_faulty=1, trials=trials, max_rounds=16,
                     delivery="quorum", scheduler="targeted",
                     fault_model="equivocate", path="histogram", seed=seed)
-    pt = run_point(cfg, initial_values=_balanced(trials, n),
-                   faults=FaultSpec.first_f(cfg))
-    rows.append({"f": 1, "f_frac": round(1 / n, 7),
-                 "fault_model": "equivocate", **pt.to_dict()})
+    pt = _row(cfg, FaultSpec.first_f(cfg),
+              {"f": 1, "f_frac": round(1 / n, 7),
+               "fault_model": "equivocate"}, "targeted_equivocate_f1")
     if verbose:
         print(f"  ONE equivocator: disagree={pt.disagree_frac:.3f}",
               flush=True)
@@ -644,10 +713,12 @@ def generate(out_dir: str = "RESULTS", n_large: int = 1_000_000,
                             for k, v in cc.items()}
 
     print("disagreement vs adversary strength (f=0.25):", flush=True)
-    out["disagreement"] = disagreement_sweep(n_large, trials_large, seed)
+    out["disagreement"] = disagreement_sweep(n_large, trials_large, seed,
+                                             out_dir=out_dir)
 
     print("safety violation under the targeted adversary:", flush=True)
-    out["safety_violation"] = safety_violation(n_large, trials_large, seed)
+    out["safety_violation"] = safety_violation(n_large, trials_large, seed,
+                                               out_dir=out_dir)
 
     print("equivocation: the N > 3F bound at scale:", flush=True)
     out["equivocation"] = equivocation_threshold(n_large, trials_large, seed)
@@ -792,7 +863,11 @@ def _write_markdown(out_dir: str, out: Dict) -> None:
             "quorum admits no perfect phase-1 tie, no \"?\" voters can be "
             "manufactured, and the attack weakens to N ≤ 3F + 1. "
             "The final row arms ONE equivocator: the decide rule has no "
-            "Byzantine safety margin at any N.",
+            "Byzantine safety margin at any N.  Every violating row was "
+            "auto-rerun with the witness recorder armed and machine-"
+            "checked by the invariant auditor (benor_tpu/audit.py); the "
+            "pinpointed (trial, round, node, tallies) witness bundles "
+            "sit next to this file as `witness_*.json`.",
             "",
             "| F | fault model | disagree | decided | mean k |",
             "|---|---|---|---|---|",

@@ -22,7 +22,8 @@ import jax.numpy as jnp
 
 from .config import SimConfig
 from .models.benor import all_settled, benor_round
-from .state import FaultSpec, NetState, init_state, new_recorder
+from .state import (FaultSpec, NetState, init_state, new_recorder,
+                    new_witness)
 
 #: One warning per process for the debug-demotes-pallas perf cliff.
 _debug_demotion_warned = False
@@ -58,28 +59,44 @@ def start_state(cfg: SimConfig, state: NetState) -> NetState:
     return NetState(x=state.x, decided=state.decided, k=k, killed=state.killed)
 
 
+def _carry_extras(cfg: SimConfig, carry, offset: int = 2):
+    """Split a loop carry's optional tail — (recorder?, witness?) in that
+    fixed order, present iff the matching flag is set — into named slots.
+    ``offset`` is where the tail starts (after the mandatory entries)."""
+    recorder = witness = None
+    i = offset
+    if cfg.record:
+        recorder = carry[i]
+        i += 1
+    if cfg.witness:
+        witness = carry[i]
+    return recorder, witness
+
+
 def _run_body(cfg: SimConfig, faults: FaultSpec, base_key: jax.Array, carry,
               dyn=None, ctx=None):
-    """One while-loop iteration.  ``carry`` is (r, state) — or
-    (r, state, recorder) when cfg.record, the flight-recorder buffer
-    riding the carry so every executed round writes its row on device.
+    """One while-loop iteration.  ``carry`` is (r, state) plus the
+    optional observability tail — the flight-recorder buffer when
+    cfg.record, then the witness buffer when cfg.witness — riding the
+    carry so every executed round writes its row(s) on device.
     ``ctx`` (ShardCtx or None=single-device) is threaded into the round
     kernel AND the debug callback, so a shard_map'd caller of
     run_consensus_traced gets one psum-globalized event per round instead
     of per-shard duplicates."""
     from .ops.collectives import SINGLE
     ctx = SINGLE if ctx is None else ctx
-    if cfg.record:
-        r, state, recorder = carry
-        state, recorder = benor_round(cfg, state, faults, base_key, r,
-                                      ctx, dyn=dyn, recorder=recorder)
+    r, state = carry[0], carry[1]
+    recorder, witness = _carry_extras(cfg, carry)
+    out = benor_round(cfg, state, faults, base_key, r, ctx, dyn=dyn,
+                      recorder=recorder, witness=witness)
+    if cfg.record or cfg.witness:
+        state, *extras = out
     else:
-        r, state = carry
-        state = benor_round(cfg, state, faults, base_key, r, ctx, dyn=dyn)
+        state, extras = out, []
     if cfg.debug:  # per-round host callback (SURVEY §5.1); zero cost if off
         from .utils.tracing import emit_round_event
         emit_round_event(state, ctx if ctx is not SINGLE else None)
-    return (r + 1, state, recorder) if cfg.record else (r + 1, state)
+    return (r + 1, state, *extras)
 
 
 def _run_cond(cfg: SimConfig, carry, ctx=None):
@@ -95,7 +112,8 @@ def run_consensus(cfg: SimConfig, state: NetState, faults: FaultSpec,
     """Run from /start to termination or round cap.
 
     Returns (rounds_executed, final_state) — plus the filled
-    flight-recorder buffer as a third element when ``cfg.record`` is set.
+    flight-recorder buffer when ``cfg.record`` is set, plus the filled
+    witness buffer when ``cfg.witness`` is set (in that order).
     jit-compiled once per config (SimConfig is static/hashable); the loop
     is on-device, zero host round trips per round.  In the fused-kernel
     regime (tally.pallas_round_active) the loop carries the PACKED
@@ -144,7 +162,8 @@ def run_consensus_traced(cfg: SimConfig, state: NetState, faults: FaultSpec,
     embedded under shard_map: tallies, the termination predicate AND the
     cfg.debug round events then psum-globalize instead of emitting
     per-shard duplicates.  Returns (rounds, state), with the filled
-    flight recorder appended when cfg.record.
+    flight recorder appended when cfg.record and the filled witness
+    buffer when cfg.witness (recorder first when both).
     """
     from .ops.tally import pallas_round_active
 
@@ -156,26 +175,27 @@ def run_consensus_traced(cfg: SimConfig, state: NetState, faults: FaultSpec,
     carry = (jnp.int32(1), state)
     if cfg.record:
         carry = carry + (new_recorder(cfg, state, ctx),)
+    if cfg.witness:
+        carry = carry + (new_witness(cfg, state, ctx),)
     out = jax.lax.while_loop(
         functools.partial(_run_cond, cfg, ctx=ctx),
         functools.partial(_run_body, cfg, faults, base_key, dyn=dyn,
                           ctx=ctx),
         carry)
-    if cfg.record:
-        r, state, recorder = out
-        return r - 1, state, recorder
-    r, state = out
-    return r - 1, state
+    return (out[0] - 1, *out[1:])
 
 
 def resume_consensus(cfg: SimConfig, state: NetState, faults: FaultSpec,
-                     base_key: jax.Array, from_round: int, recorder=None):
+                     base_key: jax.Array, from_round: int, recorder=None,
+                     witness=None):
     """Re-enter the round loop from a checkpointed round index (SURVEY §5.4).
 
     With cfg.record, pass the checkpointed run's ``recorder`` to keep
     filling it (None starts a fresh buffer whose rows before
     ``from_round`` stay zero except the re-entry snapshot in row 0) and
-    the return gains the recorder as a third element."""
+    the return gains the recorder as a third element.  cfg.witness
+    threads ``witness`` the same way (appended after the recorder when
+    both are on)."""
     from .ops.tally import pallas_round_active
 
     pallas = pallas_round_active(cfg)
@@ -188,32 +208,27 @@ def resume_consensus(cfg: SimConfig, state: NetState, faults: FaultSpec,
         out = run_packed_slice(cfg, state, faults, base_key,
                                jnp.int32(from_round),
                                jnp.int32(cfg.max_rounds + 2),
-                               recorder=recorder)
-        if cfg.record:
-            r, state, recorder = out
-            return r - 1, state, recorder
-        r, state = out
-        return r - 1, state
+                               recorder=recorder, witness=witness)
+        return (out[0] - 1, *out[1:])
     carry = (jnp.int32(from_round), state)
     if cfg.record:
-        if recorder is None:
-            recorder = new_recorder(cfg, state)
-        carry = carry + (recorder,)
+        carry = carry + (new_recorder(cfg, state) if recorder is None
+                         else recorder,)
+    if cfg.witness:
+        carry = carry + (new_witness(cfg, state) if witness is None
+                         else witness,)
     out = jax.lax.while_loop(
         functools.partial(_run_cond, cfg),
         functools.partial(_run_body, cfg, faults, base_key),
         carry)
-    if cfg.record:
-        r, state, recorder = out
-        return r - 1, state, recorder
-    r, state = out
-    return r - 1, state
+    return (out[0] - 1, *out[1:])
 
 
 @functools.partial(jax.jit, static_argnums=0)
 def run_consensus_slice(cfg: SimConfig, state: NetState, faults: FaultSpec,
                         base_key: jax.Array, from_round: jax.Array,
-                        until_round: jax.Array, recorder=None):
+                        until_round: jax.Array, recorder=None,
+                        witness=None):
     """At most ``until_round - from_round`` rounds of the compiled loop.
 
     The slice primitive behind mid-run observability (cfg.poll_rounds):
@@ -234,7 +249,8 @@ def run_consensus_slice(cfg: SimConfig, state: NetState, faults: FaultSpec,
     With cfg.record, ``recorder`` threads the flight-recorder buffer
     across slices (None builds a fresh one, row 0 snapshotting ``state``)
     and the filled buffer is appended to the return — slice-by-slice
-    filling is bit-identical to the one-shot run's recorder.
+    filling is bit-identical to the one-shot run's recorder.  cfg.witness
+    threads ``witness`` identically (appended last when both are on).
     """
     from .ops.tally import pallas_round_active
 
@@ -244,22 +260,21 @@ def run_consensus_slice(cfg: SimConfig, state: NetState, faults: FaultSpec,
     if pallas and not cfg.debug:
         from .ops.pallas_round import run_packed_slice
         return run_packed_slice(cfg, state, faults, base_key,
-                                from_round, until_round, recorder=recorder)
+                                from_round, until_round, recorder=recorder,
+                                witness=witness)
     carry = (jnp.int32(from_round), state)
     if cfg.record:
-        if recorder is None:
-            recorder = new_recorder(cfg, state)
-        carry = carry + (recorder,)
+        carry = carry + (new_recorder(cfg, state) if recorder is None
+                         else recorder,)
+    if cfg.witness:
+        carry = carry + (new_witness(cfg, state) if witness is None
+                         else witness,)
 
     def cond(carry):
         return _run_cond(cfg, carry) & (carry[0] < until_round)
 
-    out = jax.lax.while_loop(
+    return jax.lax.while_loop(
         cond, functools.partial(_run_body, cfg, faults, base_key), carry)
-    if cfg.record:
-        return out
-    r, state = out
-    return r, state
 
 
 def simulate(cfg: SimConfig, initial_values, faulty_list=None,
@@ -270,7 +285,8 @@ def simulate(cfg: SimConfig, initial_values, faulty_list=None,
     (launchNodes.ts:8); ``crash_rounds`` is required for
     fault_model='crash_at_round'; pass ``faults`` directly for fully
     per-trial specs.  With cfg.record the filled flight recorder is
-    appended: (rounds, state, faults, recorder).
+    appended: (rounds, state, faults, recorder); with cfg.witness the
+    filled witness buffer is appended after it.
     """
     if faults is None:
         if faulty_list is None:
@@ -278,8 +294,5 @@ def simulate(cfg: SimConfig, initial_values, faulty_list=None,
         faults = FaultSpec.from_faulty_list(cfg, faulty_list, crash_rounds)
     state = init_state(cfg, initial_values, faults)
     base_key = jax.random.key(cfg.seed)
-    if cfg.record:
-        rounds, final, recorder = run_consensus(cfg, state, faults, base_key)
-        return rounds, final, faults, recorder
-    rounds, final = run_consensus(cfg, state, faults, base_key)
-    return rounds, final, faults
+    out = run_consensus(cfg, state, faults, base_key)
+    return (out[0], out[1], faults, *out[2:])

@@ -239,6 +239,121 @@ def new_recorder(cfg: SimConfig, state: NetState, ctx=None) -> jax.Array:
     return rec.at[0].set(row0)
 
 
+# --------------------------------------------------------------------------
+# Witness recorder (SimConfig.witness_trials / witness_nodes): the
+# on-device PER-NODE forensic trace behind benor_tpu/audit.py.
+#
+# Where the flight recorder (above) keeps network-global aggregates, the
+# witness keeps, for every watched (trial, node) pair, the full per-round
+# evidence chain — committed value, decided/killed bits, coin-commit bit,
+# and the proposal/vote tallies that justified the transition — written
+# inside the compiled while-loop via dynamic_update_slice, in EVERY regime
+# (traced XLA, fused pallas via per-tile witness partials, sliced
+# poll_rounds, batched dynamic-F sweep, sharded mesh).  Extra HBM:
+# (max_rounds + 1) * W * k * WIT_WIDTH * 4 bytes.  Row 0 is the
+# post-/start snapshot; row r the watched lanes at the END of round r.
+# --------------------------------------------------------------------------
+
+#: Witness columns, per watched (trial, node) per round.
+WIT_X = 0        # committed protocol value (VAL0 | VAL1 | VALQ)
+WIT_DECIDED = 1  # decided bit (node.ts:100,103)
+WIT_KILLED = 2   # killed bit (crash / crash_at_round / stop)
+WIT_COINED = 3   # lane committed a coin flip this round (node.ts:111)
+WIT_P0 = 4       # proposal-phase tally for 0 (node.ts:63-69 input)
+WIT_P1 = 5       # proposal-phase tally for 1
+WIT_V0 = 6       # vote-phase tally for 0 (the decide evidence, node.ts:99)
+WIT_V1 = 7       # vote-phase tally for 1 (node.ts:102)
+WIT_WRITTEN = 8  # 1 on every written row (the unwritten-row sentinel)
+WIT_WIDTH = 9
+
+#: Column names, index-aligned with the WIT_* constants — the single
+#: source of truth for every host-side renderer (audit.witness_rows).
+WIT_COLUMNS = ("x", "decided", "killed", "coined", "p0", "p1", "v0", "v1",
+               "written")
+
+
+def witness_node_ids(cfg: SimConfig) -> np.ndarray:
+    """The k watched GLOBAL node ids -> int32 [witness_nodes], sorted.
+
+    First ceil(k/2) + last floor(k/2) ids: both ends of the id range,
+    which is where the forensically interesting populations live — the
+    canonical fault masks mark the FIRST F lanes faulty
+    (FaultSpec.first_f) while the targeted adversary's value camps sit at
+    the TOP of the range (ops/tally.py:targeted_counts).  k == n_nodes
+    watches every node.  Static (a pure function of the config), so the
+    gather indices bake into the trace."""
+    k, n = cfg.witness_nodes, cfg.n_nodes
+    lo = (k + 1) // 2
+    hi = k - lo
+    return np.asarray(list(range(lo)) + list(range(n - hi, n)), np.int32)
+
+
+def witness_select(cfg: SimConfig, arr: jax.Array, ctx=None) -> jax.Array:
+    """Gather the watched (trial, node) entries of a [T, N] field ->
+    int32 [W, k], mesh-globalized.
+
+    One-hot masked reduction over GLOBAL ids: under a mesh each shard
+    contributes only the watched entries it owns (its local one-hots are
+    zero elsewhere) and the psum over every axis leaves the identical
+    [W, k] block on all shards — the witness analog of the recorder's
+    psum-before-write discipline."""
+    from .ops.collectives import SINGLE
+    ctx = SINGLE if ctx is None else ctx
+    T, N = arr.shape
+    wt = jnp.asarray(cfg.witness_trials, jnp.int32)           # [W]
+    wn = jnp.asarray(witness_node_ids(cfg), jnp.int32)        # [k]
+    t_oh = (ctx.trial_ids(T)[None, :] == wt[:, None]).astype(jnp.int32)
+    n_oh = (ctx.node_ids(N)[None, :] == wn[:, None]).astype(jnp.int32)
+    out = jnp.einsum("wt,tn,kn->wk", t_oh, arr.astype(jnp.int32), n_oh)
+    return ctx.psum_all(out)
+
+
+def witness_snapshot_row(cfg: SimConfig, x: jax.Array, decided: jax.Array,
+                         killed: jax.Array, ctx=None) -> jax.Array:
+    """Row 0 (post-/start snapshot): state fields only, no tallies/coins
+    yet -> int32 [W, k, WIT_WIDTH] with the written sentinel set."""
+    fields = [witness_select(cfg, f, ctx)
+              for f in (x, decided, killed)]
+    zero = jnp.zeros_like(fields[0])
+    one = jnp.ones_like(fields[0])
+    return jnp.stack(fields + [zero] * 5 + [one], axis=-1)
+
+
+def witness_round_row(cfg: SimConfig, x: jax.Array, decided: jax.Array,
+                      killed: jax.Array, coined: jax.Array,
+                      p0: jax.Array, p1: jax.Array,
+                      v0: jax.Array, v1: jax.Array, ctx=None) -> jax.Array:
+    """Full end-of-round witness row -> int32 [W, k, WIT_WIDTH].
+
+    ``x``/``decided``/``killed`` are the committed post-round fields;
+    ``coined`` marks lanes that committed a coin flip; ``p0``/``p1`` and
+    ``v0``/``v1`` are the per-lane proposal / vote tallies the round's
+    transitions were justified by (cast to int32 — the CF samplers hand
+    them over as integral f32)."""
+    fields = [witness_select(cfg, f, ctx)
+              for f in (x, decided, killed, coined, p0, p1, v0, v1)]
+    return jnp.stack(fields + [jnp.ones_like(fields[0])], axis=-1)
+
+
+def witness_write(witness: jax.Array, r: jax.Array,
+                  row: jax.Array) -> jax.Array:
+    """Write one [W, k, WIT_WIDTH] row at (traced) round index ``r``."""
+    return jax.lax.dynamic_update_slice(
+        witness, row[None], (jnp.asarray(r, jnp.int32), jnp.int32(0),
+                             jnp.int32(0), jnp.int32(0)))
+
+
+def new_witness(cfg: SimConfig, state: NetState, ctx=None) -> jax.Array:
+    """Fresh [max_rounds + 1, W, k, WIT_WIDTH] int32 buffer with row 0 set
+    to the snapshot of ``state``.  Traceable and mesh-safe, like
+    new_recorder."""
+    wit = jnp.zeros((cfg.max_rounds + 1, len(cfg.witness_trials),
+                     cfg.witness_nodes, WIT_WIDTH), jnp.int32)
+    row0 = witness_snapshot_row(cfg, state.x, state.decided, state.killed,
+                                ctx)
+    return wit.at[0].set(row0)
+
+
 def init_state(cfg: SimConfig, initial_values, faults: FaultSpec) -> NetState:
     """Build the T x N state arrays from per-node initial values.
 

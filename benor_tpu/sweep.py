@@ -56,12 +56,20 @@ class SweepPoint:
     #: [max_rounds + 1, state.REC_WIDTH], row r = network at end of round
     #: r (state.REC_COLUMNS names the columns); None when record is off.
     round_history: Optional[np.ndarray] = None
+    #: Witness trace (cfg.witness): int32
+    #: [max_rounds + 1, W, k, state.WIT_WIDTH] per-node forensic rows for
+    #: the watched (trial, node) pairs (state.WIT_COLUMNS names the
+    #: columns; benor_tpu/audit.py machine-checks them); None when the
+    #: witness is off.
+    witness: Optional[np.ndarray] = None
 
     def to_dict(self) -> Dict:
         d = dataclasses.asdict(self)
         d["k_hist"] = self.k_hist.tolist()
         if self.round_history is not None:
             d["round_history"] = self.round_history.tolist()
+        if self.witness is not None:
+            d["witness"] = self.witness.tolist()
         return d
 
 
@@ -168,7 +176,8 @@ def run_point(cfg: SimConfig, initial_values=None, faulty_list=None,
     base_key = jax.random.key(cfg.seed)
 
     # compile (cached across calls with the same static cfg); under
-    # cfg.record the run returns the flight recorder as a third output
+    # cfg.record / cfg.witness the run returns the flight recorder /
+    # witness buffer as extra outputs (recorder first)
     out = run_consensus(cfg, state, faults, base_key)
     int(out[0])  # completion barrier
     t0 = time.perf_counter()
@@ -176,7 +185,12 @@ def run_point(cfg: SimConfig, initial_values=None, faulty_list=None,
     rounds = int(out[0])  # completion barrier inside the timed window
     seconds = time.perf_counter() - t0
     final = out[1]
-    history = np.asarray(out[2]) if cfg.record else None
+    idx = 2
+    history = None
+    if cfg.record:
+        history = np.asarray(out[idx])
+        idx += 1
+    wit = np.asarray(out[idx], np.int32) if cfg.witness else None
 
     dec, mk, ones, khist, disagree = summarize_final(
         final, faults.faulty, cfg.max_rounds)
@@ -187,7 +201,7 @@ def run_point(cfg: SimConfig, initial_values=None, faulty_list=None,
         k_hist=np.asarray(khist).astype(np.int64), ones_frac=float(ones),
         seconds=seconds,
         trials_per_sec=cfg.trials / seconds if seconds > 0 else float("inf"),
-        disagree_frac=float(disagree), round_history=history)
+        disagree_frac=float(disagree), round_history=history, witness=wit)
 
 
 def rounds_vs_f(base_cfg: SimConfig, f_values: Sequence[int],
@@ -382,16 +396,15 @@ def run_curve_batched(base_cfg: SimConfig, f_values: Sequence[int],
             # Under cfg.record each point's flight recorder joins the
             # executable's outputs right before the (unfetched) final
             # state — [B, R, REC_WIDTH] per dyn bucket, filled on device
-            # inside the same vmapped loop.
+            # inside the same vmapped loop.  cfg.witness appends each
+            # point's witness buffer after it the same way.
             if key[0] == "dyn":
                 def runner(states, faults, dyn, bk, _cfg=rep):
                     def one(s, fl, d):
                         out = run_consensus_traced(_cfg, s, fl, bk, d)
                         r, fin = out[0], out[1]
                         summ = _summarize_inline(_cfg, r, fin, fl)
-                        if _cfg.record:
-                            summ = summ + (out[2],)
-                        return summ + (fin,)
+                        return summ + tuple(out[2:]) + (fin,)
                     return jax.vmap(one, in_axes=(0, 0, 0))(
                         states, faults, dyn)
                 args = (b["states"], b["faults"], b["dyn"], base_key)
@@ -400,9 +413,7 @@ def run_curve_batched(base_cfg: SimConfig, f_values: Sequence[int],
                     out = run_consensus(_cfg, state, faults, bk)
                     r, fin = out[0], out[1]
                     summ = _summarize_inline(_cfg, r, fin, faults)
-                    if _cfg.record:
-                        summ = summ + (out[2],)
-                    return summ + (fin,)
+                    return summ + tuple(out[2:]) + (fin,)
                 args = (b["states"], b["faults"], base_key)
             t0 = time.perf_counter()
             with warnings.catch_warnings():
@@ -442,7 +453,11 @@ def _assemble_points(cfgs, raw, secs) -> List[SweepPoint]:
     points = []
     for cfg_f, vals, s in zip(cfgs, raw, secs):
         r, dec, mk, ones, khist, dis, *rest = vals
-        history = np.asarray(rest[0], np.int32) if rest else None
+        history = wit = None
+        if cfg_f.record:
+            history = np.asarray(rest.pop(0), np.int32)
+        if cfg_f.witness:
+            wit = np.asarray(rest.pop(0), np.int32)
         points.append(SweepPoint(
             n_nodes=cfg_f.n_nodes, n_faulty=cfg_f.n_faulty,
             trials=cfg_f.trials, coin_mode=cfg_f.coin_mode,
@@ -451,7 +466,7 @@ def _assemble_points(cfgs, raw, secs) -> List[SweepPoint]:
             k_hist=np.asarray(khist).astype(np.int64),
             ones_frac=float(ones), seconds=s,
             trials_per_sec=(cfg_f.trials / s if s > 0 else float("inf")),
-            disagree_frac=float(dis), round_history=history))
+            disagree_frac=float(dis), round_history=history, witness=wit))
     return points
 
 

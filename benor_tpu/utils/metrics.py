@@ -287,7 +287,8 @@ def export_prometheus(path: str, registry: MetricsRegistry = None,
 
 def export_chrome_trace(path: str, registry: MetricsRegistry = None,
                         round_history=None,
-                        rounds_label: str = "consensus") -> int:
+                        rounds_label: str = "consensus",
+                        witness=None) -> int:
     """Write a Chrome-trace/Perfetto JSON file; returns the event count.
 
     Timer spans land on pid 0 / tid "host" as complete ("X") events at
@@ -295,10 +296,16 @@ def export_chrome_trace(path: str, registry: MetricsRegistry = None,
     buffer) lands on tid "rounds" with a SYNTHETIC 1 ms-per-round
     timescale — the recorder is filled on device with no per-round host
     timestamps (that is the point) — each slice carrying its full
-    telemetry row in ``args``.  Counters/gauges become metadata counter
-    events.  Open in https://ui.perfetto.dev or chrome://tracing;
-    ``jax.profiler.trace`` captures of the same run sit alongside as
-    separate tracks when loaded together.
+    telemetry row in ``args``.  ``witness`` (an audit.WitnessBundle, or
+    a witness buffer paired with its watched ids as ``(buffer,
+    trial_ids, node_ids)``) adds one track per watched (trial, node)
+    lane on the same synthetic timescale, each round-slice carrying the
+    lane's full evidence row (value, decided/killed/coined bits, p/v
+    tallies) — the flight recorder's aggregates and the per-node
+    forensics line up round for round.  Counters/gauges become metadata
+    counter events.  Open in https://ui.perfetto.dev or
+    chrome://tracing; ``jax.profiler.trace`` captures of the same run
+    sit alongside as separate tracks when loaded together.
     """
     registry = REGISTRY if registry is None else registry
     events = []
@@ -333,6 +340,26 @@ def export_chrome_trace(path: str, registry: MetricsRegistry = None,
                 "ph": "X", "pid": 0, "tid": "rounds",
                 "ts": r * 1000.0, "dur": 1000.0,
                 "args": {k: v for k, v in row.items() if k != "round"},
+            })
+    if witness is not None:
+        from ..audit import witness_rows
+        if hasattr(witness, "buffer"):              # a WitnessBundle
+            buf, tids, nids = (witness.buffer, witness.trial_ids,
+                               witness.node_ids)
+        else:
+            buf, tids, nids = witness
+        for row in witness_rows(buf, tids, nids):
+            r = row["round"]
+            events.append({
+                "name": (f"x={row['x']}"
+                         + (" decided" if row["decided"] else "")
+                         + (" killed" if row["killed"] else "")
+                         + (" coin" if row["coined"] else "")),
+                "ph": "X", "pid": 0,
+                "tid": f"witness t{row['trial']} n{row['node']}",
+                "ts": r * 1000.0, "dur": 1000.0,
+                "args": {k: v for k, v in row.items()
+                         if k not in ("round", "trial", "node")},
             })
     with open(path, "w") as fh:
         json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, fh)

@@ -1,0 +1,444 @@
+"""Witness traces + protocol invariant auditor (ISSUE 3).
+
+Acceptance contract:
+  * the witness buffer is bit-identical across the traced, fused-pallas,
+    sliced (poll_rounds), batched-sweep and sharded regimes on one seed;
+  * witness=off runs are bit-identical in results AND compile counts to
+    pre-feature behavior (the utils/compile_counter discipline
+    tests/test_flight_recorder.py pins for ``record``);
+  * a seeded equivocator run produces a PINPOINTED agreement-violation
+    witness (trial, round, node ids, tallies); clean 'reference' and
+    'textbook' runs audit clean across all five regimes;
+  * the TpuNetwork surface (get_witness) and the bundle schema hold.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from benor_tpu.audit import (WitnessBundle, audit_point, audit_witness,
+                             save_bundle, witness_rows)
+from benor_tpu.config import SimConfig
+from benor_tpu.sim import (run_consensus, run_consensus_slice, simulate,
+                           start_state)
+from benor_tpu.state import (WIT_COINED, WIT_DECIDED, WIT_KILLED, WIT_P0,
+                             WIT_P1, WIT_V0, WIT_V1, WIT_WIDTH,
+                             WIT_WRITTEN, WIT_X, FaultSpec, init_state,
+                             witness_node_ids)
+from benor_tpu.sweep import balanced_inputs
+
+T, N = 8, 24
+
+#: The cross-path fixture (same doctrine as tests/test_flight_recorder.py):
+#: count-controlling adversary + common coin — every regime shares EVERY
+#: random bit, so full witness buffers must be bit-identical, not just
+#: invariant-equivalent.
+ADV = dict(n_nodes=N, n_faulty=4, trials=T, delivery="quorum",
+           scheduler="adversarial", coin_mode="common", path="histogram",
+           max_rounds=12, seed=3, witness_trials=(0, 3), witness_nodes=6)
+
+
+def _adv_inputs():
+    cfg = SimConfig(**ADV)
+    faults = FaultSpec.none(T, N)
+    state = init_state(cfg, balanced_inputs(T, N), faults)
+    return cfg, state, faults, jax.random.key(ADV["seed"])
+
+
+def _slice_all(cfg, state, faults, key, chunk):
+    """Drive run_consensus_slice to termination in ``chunk``-round steps,
+    threading one witness buffer across slices — the poll_rounds shape."""
+    st = start_state(cfg, state)
+    r, wit = jnp.int32(1), None
+    while True:
+        r_next, st, wit = run_consensus_slice(cfg, st, faults, key, r,
+                                              r + chunk, None, wit)
+        if int(r_next) == int(r) or int(r_next) > cfg.max_rounds:
+            break
+        r = r_next
+    return st, wit
+
+
+def test_witness_identical_across_all_regimes():
+    """The acceptance pin: one seed, five regimes, one witness buffer."""
+    from benor_tpu.parallel import make_mesh, run_consensus_sharded
+    from benor_tpu.sweep import run_curve_batched
+
+    cfg, state, faults, key = _adv_inputs()
+    r, fin, wit = run_consensus(cfg, state, faults, key)
+    wit = np.asarray(wit)
+    assert int(r) >= 2                     # multi-round, or the pin is vacuous
+    assert wit.shape == (cfg.max_rounds + 1, 2, 6, WIT_WIDTH)
+
+    # fused pallas round (bit-identical here: delivered counts + common coin)
+    cfg_p = cfg.replace(use_pallas_round=True)
+    from benor_tpu.ops.tally import pallas_round_active
+    assert pallas_round_active(cfg_p)
+    rp, finp, witp = run_consensus(cfg_p, state, faults, key)
+    assert int(rp) == int(r)
+    np.testing.assert_array_equal(wit, np.asarray(witp))
+    np.testing.assert_array_equal(np.asarray(fin.x), np.asarray(finp.x))
+
+    # sliced (poll_rounds shape), both compute paths
+    for c, chunk in ((cfg, 3), (cfg_p, 2)):
+        fin_s, wit_s = _slice_all(c, state, faults, key, chunk)
+        np.testing.assert_array_equal(wit, np.asarray(wit_s))
+
+    # batched dynamic-F sweep (the adversarial curve is a dyn bucket)
+    cb = run_curve_batched(cfg.replace(n_faulty=0), [4, 6],
+                           initial_values=balanced_inputs(T, N),
+                           faults_for=lambda c: FaultSpec.none(T, N))
+    np.testing.assert_array_equal(wit, cb.points[0].witness)
+
+    # sharded mesh (multiple shapes; rows psum-globalized before the write)
+    for shape in ((2, 4), (1, 8), (4, 1)):
+        rs, fs, wit_m = run_consensus_sharded(cfg, state, faults, key,
+                                              make_mesh(*shape))
+        assert int(rs) == int(r)
+        np.testing.assert_array_equal(wit, np.asarray(wit_m),
+                                      err_msg=str(shape))
+
+
+def test_witness_off_results_and_compile_count():
+    """witness=off must be indistinguishable from a build without the
+    feature: bit-identical results to witness=on, and exactly ONE backend
+    compile for the run (the flag is static), measured by the
+    jax.monitoring hook — the same discipline the flight recorder pins."""
+    from benor_tpu.utils.compile_counter import count_backend_compiles
+
+    # max_rounds=18 keeps this shape distinct from the flight recorder's
+    # 26/5/5/16 pin so the witness-off compile can't hit its jit cache
+    base = dict(n_nodes=26, n_faulty=5, trials=5, delivery="quorum",
+                scheduler="uniform", max_rounds=18, seed=77)
+    cfg_off = SimConfig(**base)
+    cfg_on = SimConfig(witness_trials=(0, 2), witness_nodes=4, **base)
+    faults = FaultSpec.from_faulty_list(cfg_off, [True] * 5 + [False] * 21)
+    state = init_state(cfg_off, [i % 2 for i in range(26)], faults)
+    key = jax.random.key(cfg_off.seed)
+
+    with count_backend_compiles() as cc:
+        r0, fin0 = run_consensus(cfg_off, state, faults, key)
+        int(r0)
+    assert cc.count == 1, cc.count
+
+    r1, fin1, _wit = run_consensus(cfg_on, state, faults, key)
+    assert int(r0) == int(r1)
+    for leaf in ("x", "decided", "k", "killed"):
+        np.testing.assert_array_equal(np.asarray(getattr(fin0, leaf)),
+                                      np.asarray(getattr(fin1, leaf)))
+
+
+def test_witness_row_semantics():
+    """Row invariants on the forced-tie fixture: row 0 snapshots the
+    balanced inputs, round 1 is an all-coin round with tied proposal
+    tallies and zero vote counts (everyone voted \"?\"), and the decide
+    round's evidence clears the bar."""
+    cfg, state, faults, key = _adv_inputs()
+    r, fin, wit = run_consensus(cfg, state, faults, key)
+    wit, rounds = np.asarray(wit), int(r)
+    ids = witness_node_ids(cfg)
+    assert list(ids) == [0, 1, 2, 21, 22, 23]    # both ends of the range
+
+    assert (wit[:rounds + 1, :, :, WIT_WRITTEN] == 1).all()
+    assert (wit[rounds + 1:] == 0).all()         # unwritten tail stays zero
+    # row 0: the post-/start snapshot — interleaved balanced inputs
+    np.testing.assert_array_equal(wit[0, 0, :, WIT_X], ids % 2)
+    assert (wit[0, :, :, WIT_DECIDED] == 0).all()
+    assert (wit[0, :, :, [WIT_P0, WIT_P1, WIT_V0, WIT_V1]] == 0).all()
+    # round 1: perfect tie -> every watched lane coins, zero vote counts
+    assert (wit[1, :, :, WIT_COINED] == 1).all()
+    np.testing.assert_array_equal(wit[1, :, :, WIT_P0],
+                                  wit[1, :, :, WIT_P1])
+    assert (wit[1, :, :, [WIT_V0, WIT_V1]] == 0).all()
+    # decide round: every watched lane decided with > F evidence
+    last = wit[rounds]
+    assert (last[:, :, WIT_DECIDED] == 1).all()
+    v = np.where(last[:, :, WIT_X] == 0, last[:, :, WIT_V0],
+                 last[:, :, WIT_V1])
+    assert (v > cfg.n_faulty).all()
+
+
+@pytest.mark.parametrize("rule", ["reference", "textbook"])
+def test_audit_clean_across_all_regimes(rule):
+    """Honest runs (reference contract: crash faults pinned to F, so
+    alive == quorum) must audit clean in every regime, both rules."""
+    from benor_tpu.parallel import make_mesh, run_consensus_sharded
+    from benor_tpu.sweep import run_curve_batched
+
+    base = dict(n_nodes=16, n_faulty=4, trials=4, delivery="quorum",
+                scheduler="uniform", path="histogram", max_rounds=16,
+                seed=5, rule=rule, witness_trials=(0, 2), witness_nodes=6)
+    cfg = SimConfig(**base)
+    faults = FaultSpec.first_f(cfg)
+    state = init_state(cfg, [i % 2 for i in range(16)], faults)
+    key = jax.random.key(cfg.seed)
+
+    buffers = {}
+    r, fin, buffers["traced"] = run_consensus(cfg, state, faults, key)
+    _, buffers["sliced"] = _slice_all(cfg, state, faults, key, 2)
+    _, _, buffers["sharded"] = run_consensus_sharded(cfg, state, faults,
+                                                     key, make_mesh(2, 2))
+    cb = run_curve_batched(cfg.replace(n_faulty=0), [4],
+                           initial_values=np.asarray(
+                               [[i % 2 for i in range(16)]] * 4, np.int8))
+    buffers["batched"] = cb.points[0].witness
+    # the fused-pallas regime shares the adversarial fixture's witness
+    # checks via test_witness_identical_across_all_regimes; audit it on
+    # the count-controlling adversary where its bits match the XLA loop
+    acfg = SimConfig(**{**ADV, "use_pallas_round": True, "rule": rule})
+    afaults = FaultSpec.none(T, N)
+    astate = init_state(acfg, balanced_inputs(T, N), afaults)
+    _, _, buffers["pallas"] = run_consensus(acfg, astate, afaults,
+                                            jax.random.key(ADV["seed"]))
+
+    for regime, buf in buffers.items():
+        c, fl = (acfg, afaults) if regime == "pallas" else (cfg, faults)
+        report = audit_witness(WitnessBundle.from_run(
+            c, buf, faults=fl, label=f"{rule}/{regime}"))
+        assert report.ok, (regime, [v.message for v in report.violations])
+        assert report.checks["irrevocability"] > 0
+        assert report.checks["quorum_evidence"] > 0
+
+
+def test_audit_catches_seeded_equivocator():
+    """One equivocator under the targeted adversary splits agreement at
+    any N (tests/test_equivocate.py scenarios): the auditor must emit a
+    pinpointed agreement-violation witness — trial, round, the two node
+    ids, and the > F tallies both decisions were justified by."""
+    n = 16
+    cfg = SimConfig(n_nodes=n, n_faulty=1, trials=4, delivery="quorum",
+                    scheduler="targeted", fault_model="equivocate",
+                    path="histogram", max_rounds=16, seed=0,
+                    witness_trials=(0, 1, 2, 3), witness_nodes=n)
+    report, bundle = audit_point(
+        cfg, initial_values=balanced_inputs(4, n), label="equivocator")
+    assert not report.ok
+    agr = [v for v in report.violations if v.invariant == "agreement"]
+    assert agr, [v.invariant for v in report.violations]
+    # every watched trial violates, each with a minimal witness
+    assert {v.trial for v in agr} == {0, 1, 2, 3}
+    for v in agr:
+        assert len(v.nodes) == 2
+        a, b = v.detail["node_a"], v.detail["node_b"]
+        assert a["value"] == 0 and b["value"] == 1
+        assert a["v0"] > cfg.n_faulty and b["v1"] > cfg.n_faulty
+        # the equivocator (node 0, faulty) is never blamed for agreement
+        assert 0 not in v.nodes
+    # ONLY agreement breaks: each camp's decide evidence is individually
+    # sound (that is the attack — the rule has no Byzantine margin)
+    assert {v.invariant for v in report.violations} == {"agreement"}
+
+
+def test_audit_validity_and_killed_silence():
+    """Unanimous inputs arm the validity check (clean here); a
+    crash_at_round run exercises killed-silence on real kills."""
+    cfg = SimConfig(n_nodes=12, n_faulty=3, trials=2, delivery="quorum",
+                    scheduler="uniform", path="histogram", max_rounds=32,
+                    seed=2, witness_trials=(0, 1), witness_nodes=12)
+    report, _ = audit_point(cfg, initial_values=np.ones((2, 12), np.int8),
+                            faults=FaultSpec.none(2, 12))
+    assert report.ok and report.checks["validity"] > 0
+
+    ccfg = cfg.replace(fault_model="crash_at_round", witness_nodes=6)
+    crash = [2, 3, 0] + [0] * 9
+    report2, bundle2 = audit_point(
+        ccfg, faults=FaultSpec.first_f(ccfg, crash_rounds=crash))
+    assert report2.ok
+    # the watched killed lane really recorded its kill
+    buf = np.asarray(bundle2.buffer)
+    assert (buf[3:, :, 0, WIT_KILLED][buf[3:, 0, 0, WIT_WRITTEN] > 0]
+            == 1).all()
+
+
+def test_audit_flags_forged_evidence():
+    """The auditor is not a rubber stamp: corrupting a clean witness must
+    produce quorum-evidence / irrevocability violations."""
+    cfg = SimConfig(n_nodes=16, n_faulty=4, trials=2, delivery="quorum",
+                    scheduler="uniform", path="histogram", max_rounds=16,
+                    seed=5, witness_trials=(0, 1), witness_nodes=4)
+    report, bundle = audit_point(cfg)
+    assert report.ok
+    buf = np.array(bundle.buffer)
+    rounds = np.nonzero(buf[:, 0, 0, WIT_WRITTEN] > 0)[0]
+    # find a watched lane that decides mid-history (the first watched
+    # nodes are birth-crashed under the default first-F fault mask)
+    rd = ki = None
+    for k in range(buf.shape[2]):
+        for r in rounds[1:]:
+            if buf[r, 0, k, WIT_DECIDED] and \
+                    not buf[r - 1, 0, k, WIT_DECIDED]:
+                rd, ki = r, k
+                break
+        if rd is not None:
+            break
+    assert rd is not None
+    forged = buf.copy()
+    forged[rd, 0, ki, [WIT_V0, WIT_V1]] = cfg.n_faulty  # tally under the bar
+    rep = audit_witness(WitnessBundle(
+        buffer=forged, trial_ids=bundle.trial_ids,
+        node_ids=bundle.node_ids, rule=cfg.rule, n_faulty=cfg.n_faulty,
+        n_nodes=cfg.n_nodes))
+    assert any(v.invariant == "quorum_evidence" for v in rep.violations)
+
+    # append one forged post-termination row in which the lane un-decides
+    assert rounds[-1] + 1 < buf.shape[0]
+    revoked = buf.copy()
+    revoked[rounds[-1] + 1] = revoked[rounds[-1]]
+    revoked[rounds[-1] + 1, 0, ki, WIT_DECIDED] = 0
+    rep2 = audit_witness(WitnessBundle(
+        buffer=revoked, trial_ids=bundle.trial_ids,
+        node_ids=bundle.node_ids, rule=cfg.rule, n_faulty=cfg.n_faulty,
+        n_nodes=cfg.n_nodes))
+    assert any(v.invariant == "irrevocability" for v in rep2.violations)
+
+
+def test_audit_freeze_off_coin_and_failstop_population():
+    """Two checker-side regressions.  (1) With freeze_decided=False a
+    decided lane keeps participating and legally re-coins on a later tie
+    — only the frozen contract forbids coins after decide.  (2) Fail-stop
+    lanes (crash/crash_at_round) follow the protocol until death, so
+    from_run must keep them in the agreement/validity population; only
+    the lying models (byzantine/equivocate) carry a faulty mask."""
+    buf = np.zeros((4, 1, 1, WIT_WIDTH), np.int64)
+    buf[:3, :, :, WIT_WRITTEN] = 1
+    buf[:, :, :, WIT_X] = 1
+    buf[1:, :, :, WIT_DECIDED] = 1          # decides 1 at round 1 on v1=2
+    buf[1, :, :, WIT_V1] = 2
+    buf[2, :, :, WIT_COINED] = 1            # ...then coins on a 1-1 tie
+    buf[2, :, :, [WIT_V0, WIT_V1]] = 1
+    common = dict(buffer=buf, trial_ids=np.array([0]),
+                  node_ids=np.array([0]), rule="reference", n_faulty=1,
+                  n_nodes=4)
+    assert audit_witness(WitnessBundle(freeze_decided=False,
+                                       **common)).ok
+    frozen = audit_witness(WitnessBundle(freeze_decided=True, **common))
+    assert any(v.invariant == "quorum_evidence"
+               for v in frozen.violations)
+
+    # a snapshot-decided lane (fresh-buffer resume: decided in row 0,
+    # tallies never witnessed) still counts for agreement, but the
+    # violation must not fabricate quorum evidence from the zeroed row
+    buf2 = np.zeros((4, 1, 2, WIT_WIDTH), np.int64)
+    buf2[:2, :, :, WIT_WRITTEN] = 1
+    buf2[:, :, 0, WIT_DECIDED] = 1          # lane 0: decided 0 pre-window
+    buf2[1:, :, 1, [WIT_X, WIT_DECIDED]] = 1
+    buf2[1, :, 1, WIT_V1] = 2               # lane 1: decides 1 on v1=2
+    rep = audit_witness(WitnessBundle(
+        buffer=buf2, trial_ids=np.array([0]), node_ids=np.array([0, 1]),
+        rule="reference", n_faulty=1, n_nodes=4))
+    agr = [v for v in rep.violations if v.invariant == "agreement"]
+    assert agr and agr[0].detail["node_a"]["v0"] is None
+    assert "pre-dates the witness window" in agr[0].message
+    assert "v0=0" not in agr[0].message
+
+    shape_only = np.zeros((17, 1, 4, WIT_WIDTH), np.int64)
+    base = dict(n_nodes=12, n_faulty=3, trials=2, delivery="quorum",
+                scheduler="uniform", max_rounds=16, seed=1,
+                witness_trials=(0,), witness_nodes=4)
+    for model, excluded in (("crash", False), ("crash_at_round", False),
+                            ("byzantine", True), ("equivocate", True)):
+        cfg = SimConfig(fault_model=model, **base)
+        faults = (FaultSpec.first_f(cfg, crash_rounds=[2, 3, 4] + [0] * 9)
+                  if model == "crash_at_round" else FaultSpec.first_f(cfg))
+        b = WitnessBundle.from_run(cfg, shape_only, faults=faults)
+        assert (b.faulty is not None) == excluded, model
+        if excluded:
+            assert b.faulty[0, 0] and not b.faulty[0, -1]
+
+
+def test_tpu_network_get_witness():
+    """TpuNetwork.get_witness(): the parity-API surface, live under
+    poll_rounds slicing and loud when the witness is off — the
+    get_round_history contract."""
+    from benor_tpu.backends.tpu import TpuNetwork
+
+    cfg = SimConfig(n_nodes=10, n_faulty=2, trials=4, delivery="quorum",
+                    scheduler="uniform", seed=1, max_rounds=16,
+                    poll_rounds=2, witness_trials=(0, 1), witness_nodes=4)
+    net = TpuNetwork(cfg, [1] * 10, [True] * 2 + [False] * 8)
+    seen = []
+    net.start(on_slice=lambda: seen.append(len(net.get_witness())))
+    rows = net.get_witness()
+    n_written = net.rounds_executed + 1
+    assert len(rows) == n_written * 2 * 4
+    assert rows[0] == {"round": 0, "trial": 0, "node": 0, "x": 1,
+                       "decided": 0, "killed": 1, "coined": 0,
+                       "p0": 0, "p1": 0, "v0": 0, "v1": 0}
+    assert seen and seen[0] <= len(rows)    # grew live between slices
+
+    # one-shot (no poll) path fills it too; witness off raises
+    cfg1 = cfg.replace(poll_rounds=0)
+    net1 = TpuNetwork(cfg1, [1] * 10, [True] * 2 + [False] * 8)
+    net1.start()
+    assert net1.get_witness() == rows
+    net0 = TpuNetwork(cfg1.replace(witness_trials=None, witness_nodes=0),
+                      [1] * 10, [True] * 2 + [False] * 8)
+    net0.start()
+    with pytest.raises(ValueError, match="witness_trials"):
+        net0.get_witness()
+
+
+def test_simulate_arity_and_config_guards():
+    """simulate() appends the witness after the recorder; config rejects
+    malformed witness settings and oracle backends."""
+    cfg = SimConfig(n_nodes=10, n_faulty=2, trials=2, delivery="quorum",
+                    scheduler="uniform", seed=9, record=True,
+                    witness_trials=(1,), witness_nodes=2)
+    rounds, final, faults, rec, wit = simulate(
+        cfg, [1] * 10, [True] * 2 + [False] * 8)
+    assert np.asarray(wit).shape == (cfg.max_rounds + 1, 1, 2, WIT_WIDTH)
+    with pytest.raises(ValueError, match="witness_nodes"):
+        SimConfig(n_nodes=4, n_faulty=0, witness_trials=(0,))
+    with pytest.raises(ValueError, match="witness_trials"):
+        SimConfig(n_nodes=4, n_faulty=0, witness_nodes=2)
+    with pytest.raises(ValueError, match="witness_trials"):
+        SimConfig(n_nodes=4, n_faulty=0, trials=2, witness_trials=(5,),
+                  witness_nodes=2)
+    with pytest.raises(ValueError, match="WITNESS_MAX_NODES"):
+        SimConfig(n_nodes=100, n_faulty=0, witness_trials=(0,),
+                  witness_nodes=40)
+    with pytest.raises(ValueError, match="backend"):
+        SimConfig(n_nodes=4, n_faulty=0, backend="express",
+                  witness_trials=(0,), witness_nodes=2)
+
+
+def test_witness_bundle_schema():
+    """Saved bundles must validate against tools/witness_bundle_schema.json
+    (the CI contract results.py's witness_*.json artifacts ride on)."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+    try:
+        from tools.check_metrics_schema import check_witness_bundle
+    finally:
+        sys.path.pop(0)
+    import json
+    import tempfile
+
+    cfg = SimConfig(n_nodes=12, n_faulty=3, trials=2, delivery="quorum",
+                    scheduler="uniform", path="histogram", max_rounds=16,
+                    seed=1, witness_trials=(0,), witness_nodes=4)
+    report, bundle = audit_point(cfg, label="schema")
+    with tempfile.NamedTemporaryFile("r", suffix=".json") as fh:
+        save_bundle(fh.name, bundle, report)
+        doc = json.load(open(fh.name))
+    assert check_witness_bundle(doc) == []
+    # the cross-field pin actually bites
+    doc["trial_ids"] = [0, 1]
+    assert check_witness_bundle(doc)
+
+
+def test_witness_rows_rendering():
+    """witness_rows: one dict per written (round, trial, node), skipping
+    unwritten gap rows — the shared renderer contract."""
+    cfg, state, faults, key = _adv_inputs()
+    r, fin, wit = run_consensus(cfg, state, faults, key)
+    rows = witness_rows(np.asarray(wit), cfg.witness_trials,
+                        witness_node_ids(cfg))
+    assert len(rows) == (int(r) + 1) * 2 * 6
+    assert {row["round"] for row in rows} == set(range(int(r) + 1))
+    assert all(set(row) == {"round", "trial", "node", "x", "decided",
+                            "killed", "coined", "p0", "p1", "v0", "v1"}
+               for row in rows)

@@ -92,6 +92,43 @@ def check_schema(detail: dict, schema_path: str = SCHEMA_PATH) -> List[str]:
     return errors
 
 
+WITNESS_SCHEMA_PATH = os.path.join(HERE, "witness_bundle_schema.json")
+
+
+def check_witness_bundle(bundle: dict,
+                         schema_path: str = WITNESS_SCHEMA_PATH
+                         ) -> List[str]:
+    """Validate a witness-bundle document (benor_tpu/audit.py:save_bundle,
+    results.py's witness_*.json artifacts) against
+    tools/witness_bundle_schema.json; returns the error list (empty = ok).
+    Beyond the schema, pins the cross-field facts the auditor relies on:
+    the buffer's witness axes must match the declared watched ids and the
+    column count must match the declared column names."""
+    errors = []
+    with open(schema_path) as fh:
+        schema = json.load(fh)
+    _validate(bundle, schema, "$", errors)
+    if errors:
+        return errors
+    buf = bundle["buffer"]
+    W, k = len(bundle["trial_ids"]), len(bundle["node_ids"])
+    cols = len(bundle["columns"])
+    for r, row in enumerate(buf):
+        if len(row) != W:
+            errors.append(f"$.buffer[{r}]: {len(row)} trials != "
+                          f"{W} declared trial_ids")
+            break
+        if any(len(lane) != k for lane in row):
+            errors.append(f"$.buffer[{r}]: lane count != {k} declared "
+                          f"node_ids")
+            break
+        if any(len(v) != cols for lane in row for v in lane):
+            errors.append(f"$.buffer[{r}]: entry width != {cols} "
+                          f"declared columns")
+            break
+    return errors
+
+
 def headline_bytes(detail: dict) -> int:
     """Size of the stdout headline bench.py would emit for this record.
 
@@ -121,6 +158,14 @@ def main(argv=None) -> int:
     path = argv[0] if argv else os.path.join(REPO, "BENCH_DETAIL.json")
     with open(path) as fh:
         detail = json.load(fh)
+    if "buffer" in detail and "trial_ids" in detail:
+        # a witness bundle, not a bench record — validate as one
+        errors = check_witness_bundle(detail)
+        for e in errors:
+            print(f"FAIL {e}", file=sys.stderr)
+        print(f"{os.path.basename(path)}: witness bundle "
+              f"{'OK' if not errors else 'INVALID'}")
+        return 1 if errors else 0
     errors = check_schema(detail) + check_headline(detail)
     for e in errors:
         print(f"FAIL {e}", file=sys.stderr)
