@@ -76,7 +76,7 @@ def emit(obj) -> None:
 _DETAIL_KEYS = ("curve", "pallas_check", "pallas_hist_check",
                 "pallas_equiv_check", "pallas_weak_coin_check",
                 "pallas_round_check", "pallas_demoted",
-                "batched_sweep_check", "flight_recorder")
+                "batched_sweep_check", "flight_recorder", "lint")
 
 
 def _split_headline(out: dict) -> tuple[dict, dict]:
@@ -1169,6 +1169,29 @@ def _labels(mode: str, platform: str) -> tuple[str, str]:
     return metric, "trials/s"
 
 
+def _lint_check() -> dict:
+    """benorlint over the shipped package (benor_tpu/analysis): the lint
+    verdict rides every sweep-mode bench artifact, so a capture taken
+    from a tree with tracer-hygiene / layout / config-parity findings is
+    visibly dirty (``lint_ok`` headline bool; full accounting in the
+    sidecar's ``lint`` blob)."""
+    from benor_tpu.analysis import run_lint
+
+    rep = run_lint()
+    return {
+        "ok": rep.ok,
+        "findings": len(rep.findings),
+        "counts": rep.counts(),
+        "suppressed": dict(rep.suppressed),
+        "suppressed_total": sum(rep.suppressed.values()),
+        "files": rep.files,
+        "elapsed_s": round(rep.elapsed_s, 3),
+        # enough of each finding to act on without re-running the linter
+        "first": [f"{f.location()}: [{f.rule}] {f.message}"
+                  for f in rep.findings[:5]],
+    }
+
+
 def main() -> None:
     mode = os.environ.get("BENCH_MODE", "sweep")
     platform, fallback = acquire_platform()
@@ -1190,6 +1213,16 @@ def main() -> None:
             "fallback_cpu": fallback,
             "error": f"{type(e).__name__}: {e}",
         }
+    if "curve" in out:
+        # sweep-mode success: attach the static-analysis gate (error and
+        # pallas-mode records carry no sidecar, so no lint blob either)
+        try:
+            out["lint"] = _lint_check()
+        except Exception as e:  # noqa: BLE001 — the gate must not kill the run
+            out["lint"] = {"ok": False, "findings": -1,
+                           "error": f"{type(e).__name__}: {e}"}
+        out["lint_ok"] = bool(out["lint"].get("ok"))
+        log(f"bench: lint check {out['lint']}")
     # BENCH_METRICS_PATH: dump the unified metrics registry (compile
     # counts/durations, probe accounting, timed spans) as JSON-lines —
     # best-effort, off by default so driver artifacts don't grow

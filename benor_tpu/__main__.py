@@ -16,10 +16,16 @@ Subcommands:
                                                      (benor_tpu/audit.py),
                                                      dump the bundle
   preset NAME                                        a BASELINE.json config
+  lint   [--format json|text] [--root DIR]           benorlint static
+                                                     analysis over the
+                                                     package tree
+                                                     (benor_tpu/analysis);
+                                                     exit 2 on findings
 
 Observability: `--record` (sweep) fills the on-device flight recorder;
-`--metrics-out PATH` (sweep/coins/trace/audit) dumps the unified metrics
-registry (JSON-lines, or Prometheus textfile with a .prom extension).
+`--metrics-out PATH` (sweep/coins/trace/audit/lint) dumps the unified
+metrics registry (JSON-lines, or Prometheus textfile with a .prom
+extension).
 """
 
 from __future__ import annotations
@@ -375,6 +381,14 @@ def _results(args) -> int:
     return 0
 
 
+def _lint(args) -> int:
+    """benorlint over the package tree: tracer hygiene, kernel column
+    layouts, five-regime config parity (benor_tpu/analysis).  Exit 0 =
+    clean, 2 = findings — same CI-gateable convention as `audit`."""
+    from .analysis.cli import main as lint_main
+    return lint_main(args)
+
+
 def _preset(args) -> int:
     from .sweep import baseline_configs, run_point
     cfgs = baseline_configs()
@@ -511,6 +525,20 @@ def main(argv=None) -> int:
     p = sub.add_parser("preset", help="run a BASELINE.json preset config")
     p.add_argument("name")
 
+    li = sub.add_parser("lint",
+                        help="benorlint static analysis (tracer hygiene, "
+                             "kernel column layouts, five-regime config "
+                             "parity); exit 2 on findings")
+    li.add_argument("--root", default=None,
+                    help="package root to lint (default: the benor_tpu "
+                         "package directory)")
+    li.add_argument("--format", choices=("text", "json"), default="text",
+                    help="report format (json is schema-pinned by "
+                         "tools/check_metrics_schema.py)")
+    li.add_argument("--out", metavar="PATH",
+                    help="write the report to this file instead of stdout")
+    _add_obs_args(li, record=False)
+
     r = sub.add_parser("results",
                        help="generate RESULTS/ (curves + presets artifact)")
     r.add_argument("--out", default="RESULTS")
@@ -526,23 +554,26 @@ def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     # bare `python -m benor_tpu [-n N -f F ...]` == the start.ts demo
     if not argv or argv[0] not in ("demo", "sweep", "coins", "preset",
-                                   "results", "trace", "audit", "-h",
-                                   "--help"):
+                                   "results", "trace", "audit", "lint",
+                                   "-h", "--help"):
         argv = ["demo"] + argv
     args = ap.parse_args(argv)
     _honor_platform_env()
-    if getattr(args, "metrics_out", None):
+    if getattr(args, "metrics_out", None) and args.cmd != "lint":
         # feed the unified registry's compile counters from the first
-        # compile on (the jax.monitoring listener must precede them)
+        # compile on (the jax.monitoring listener must precede them).
+        # lint is exempt: a pure-AST pass compiles nothing, and the
+        # analyzer's no-jax contract must hold with --metrics-out too.
         from .utils.compile_counter import install
         install()
-    # the event-loop oracle backends never touch a JAX backend — don't
-    # spend a probe (or a fallback) on them
-    if not (args.cmd == "demo" and args.backend in ("express", "native")):
+    # the event-loop oracle backends and the (pure-AST) linter never
+    # touch a JAX backend — don't spend a probe (or a fallback) on them
+    if not (args.cmd == "lint" or
+            (args.cmd == "demo" and args.backend in ("express", "native"))):
         _ensure_live_backend()
     return {"demo": _demo, "sweep": _sweep, "coins": _coins,
             "preset": _preset, "results": _results,
-            "trace": _trace, "audit": _audit}[args.cmd](args)
+            "trace": _trace, "audit": _audit, "lint": _lint}[args.cmd](args)
 
 
 if __name__ == "__main__":

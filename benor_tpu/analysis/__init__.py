@@ -1,0 +1,56 @@
+"""benorlint — project-native static analysis for the benor_tpu tree.
+
+An AST-based rule framework (visitor core + registry + ``Finding``
+objects with file:line anchors and fix hints + ``# benorlint:
+allow-<rule>`` pragma suppression) with three rule families, each
+grounded in a silent-corruption class THIS repo has already had to
+manage by hand.  ``python -m benor_tpu lint`` runs them all (exit 2 on
+findings); tests/test_lint.py keeps the shipped tree lint-clean in
+tier-1.
+
+Rule families -> the incident each one prevents:
+
+**Tracer hygiene** (rules_tracer.py) — the PR-1 recompile/host-sync
+hazard class.  The batched dynamic-F sweep exists because static config
+reached compiled code in the wrong places; the flipside is DYNAMIC
+values reaching host Python.  ``host-sync`` flags ``.item()`` /
+``int()``/``float()`` on tracer params / ``np.asarray`` inside any
+function reachable from a jit/pallas_call/shard_map boundary;
+``host-rng`` flags ``np.random.*`` (non-reproducible across mesh
+shapes — ops/rng.py's fold_in contract); ``traced-branch`` flags Python
+``if``/``while`` on jnp expressions; ``dtype-drift`` flags 64-bit
+dtypes off state.py's int32 discipline; ``donate-argnums`` flags jit
+entrypoints that take donated-size [T, N] buffers undonated;
+``rng-fold`` enforces the one-fold_in-chain-per-use key discipline
+(never an arithmetic index product); ``broad-except`` flags handlers
+that would eat Mosaic lowering failures indistinguishably.
+
+**Kernel column layout** (rules_layout.py) — the PR-2/PR-3 incident.
+The flight recorder (PR 2) and the witness traces (PR 3) each appended
+hand-numbered partial columns to the fused round kernels' per-tile
+reduction buffer (``_RP_* = 5..11``, ``_WITA_BASE = 4`` — bare ints
+nothing cross-checked): one off-by-one and two features silently share
+a column IN ONE REGIME ONLY.  The constants are now declarative layout
+tables (state.REC_LAYOUT / WIT_LAYOUT, ops/pallas_round.py's
+PROP/VOTE/RECORD tables + witness field tuples) that kernels and
+checker both consume; ``layout-overlap`` proves ranges disjoint and
+dense, ``layout-parity`` proves the tables agree across files and fit
+PARTIAL_COLS at WITNESS_MAX_NODES, ``layout-outspec`` forbids bare
+physical-width literals in out_spec shapes.
+
+**Five-regime config parity** (rules_config.py) — the threading burden
+every observability PR paid: a SimConfig field consumed in sim.py had
+to be hand-carried through the sweep, fused-round, sharded and
+multihost regimes, and a forgotten regime still ran, silently
+feature-less.  ``config-parity`` makes the omission a lint failure (or
+a reviewed PARITY_ALLOWLIST entry with the delegation argument).
+
+The framework is stdlib-only and reads every table by PARSING source —
+linting never imports (or executes) the modules under inspection.
+"""
+
+from .cli import LintReport, default_root, run_lint
+from .core import Finding, Project, RULES, run_rules, rule
+
+__all__ = ["Finding", "LintReport", "Project", "RULES", "default_root",
+           "rule", "run_lint", "run_rules"]

@@ -1,0 +1,241 @@
+"""benorlint core: sources, pragma suppression, findings, rule registry.
+
+Dependency-free (stdlib ``ast`` only): the linter must run in any
+environment that can parse the package — including CI images without a
+live accelerator — and must never import the modules it inspects (an
+import would execute jax backend setup; a PARSE cannot).
+
+The moving parts:
+
+  * ``Source``   — one parsed file: text, AST, and its pragma map.
+  * ``Project``  — every ``.py`` file under the package root, plus the
+    cross-module function index and traced-reachability set that the
+    tracer-hygiene rules consume (built in ``visitors.py``).
+  * ``Finding``  — one diagnostic: rule, file:line:col, message, fix hint.
+  * ``@rule``    — registry decorator; ``run_rules`` executes every
+    registered rule over a Project and applies pragma suppression.
+
+Pragma syntax (the escape hatch for INTENTIONAL rule exceptions):
+
+    # benorlint: allow-<rule> — one-line justification
+
+On a code line it suppresses that rule's findings on that line; on a
+comment-only line it covers the rest of its comment block and the first
+code line after it (so a multi-line justification can sit directly above
+the flagged statement).  Suppressions are counted per rule and reported
+— an allow pragma is visible forever, not silent.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Callable, Dict, List, Optional, Tuple
+
+#: ``# benorlint: allow-<rule>[, allow-<rule>...] — justification``
+_PRAGMA_RE = re.compile(r"benorlint:\s*(allow-[a-z0-9,\s-]+)")
+_ALLOW_RE = re.compile(r"allow-([a-z0-9-]+)")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One diagnostic, anchored to a source location."""
+
+    rule: str
+    path: str          # repo/package-relative path
+    line: int
+    col: int
+    message: str
+    hint: str = ""
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Source:
+    """One parsed python file + its pragma map."""
+
+    def __init__(self, path: str, rel: str, text: str) -> None:
+        self.path = path
+        self.rel = rel
+        self.text = text
+        self.tree = ast.parse(text, filename=path)
+        self.lines = text.splitlines()
+        #: line (1-based) -> set of rule names allowed on that line
+        self.pragmas: Dict[int, set] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _PRAGMA_RE.search(line)
+            if not m:
+                continue
+            rules = set(_ALLOW_RE.findall(m.group(1)))
+            if not rules:
+                continue
+            self.pragmas.setdefault(i, set()).update(rules)
+            if line.lstrip().startswith("#"):
+                # comment-only pragma: cover the rest of the comment
+                # block and the first code line after it
+                j = i + 1
+                while j <= len(self.lines) and (
+                        not self.lines[j - 1].strip()
+                        or self.lines[j - 1].lstrip().startswith("#")):
+                    self.pragmas.setdefault(j, set()).update(rules)
+                    j += 1
+                self.pragmas.setdefault(j, set()).update(rules)
+
+    def allows(self, rule: str, line: int) -> bool:
+        return rule in self.pragmas.get(line, ())
+
+
+class Project:
+    """Every parsed source under one package root, plus the shared
+    analyses (function index, traced-reachability) rules consume."""
+
+    def __init__(self, root: str) -> None:
+        self.root = os.path.abspath(root)
+        self.sources: Dict[str, Source] = {}          # rel path -> Source
+        #: files that failed to parse, as findings (a broken file must
+        #: surface as a diagnostic, not crash the run off the 0/2
+        #: exit contract)
+        self.errors: List[Finding] = []
+        for dirpath, dirnames, filenames in os.walk(self.root):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in ("__pycache__",))
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, fn)
+                rel = os.path.relpath(full, self.root).replace(os.sep, "/")
+                with open(full, encoding="utf-8") as fh:
+                    text = fh.read()
+                try:
+                    self.sources[rel] = Source(full, rel, text)
+                except SyntaxError as e:
+                    self.errors.append(Finding(
+                        "parse-error", rel, e.lineno or 1,
+                        (e.offset or 1) - 1,
+                        f"file does not parse: {e.msg}",
+                        hint="benorlint analyzes the AST; fix the "
+                             "syntax error first"))
+        from .visitors import build_index
+        # module/import index + the traced-reachability set (visitors.py)
+        self.index = build_index(self)
+
+    def source(self, rel: str) -> Optional[Source]:
+        return self.sources.get(rel)
+
+
+@dataclasses.dataclass
+class Rule:
+    name: str
+    family: str      # 'tracer' | 'layout' | 'config'
+    doc: str
+    check: Callable[["Project"], List[Finding]]
+
+
+#: name -> Rule, in registration order.
+RULES: "Dict[str, Rule]" = {}
+
+
+def rule(name: str, family: str, doc: str):
+    """Register a rule.  The wrapped function takes a Project and returns
+    a list of Findings (pragma suppression is applied by run_rules)."""
+    def wrap(fn):
+        if name in RULES:
+            raise ValueError(f"duplicate rule {name!r}")
+        RULES[name] = Rule(name=name, family=family, doc=doc, check=fn)
+        return fn
+    return wrap
+
+
+def run_rules(project: Project, names=None
+              ) -> Tuple[List[Finding], Dict[str, int]]:
+    """Run the (selected) rules -> (active findings, suppressed counts).
+
+    A finding is suppressed when its file carries a matching
+    ``# benorlint: allow-<rule>`` pragma on the finding's line (or the
+    comment block directly above it).  Findings are deduplicated by
+    (rule, location, message) first, and each deduped finding is counted
+    once, active or suppressed.  (Distinct messages at one location are
+    distinct findings — config-parity anchors one finding per missing
+    regime at the field's first sim.py use.)"""
+    # rule modules register on import; import them here so a bare
+    # ``from .core import run_rules`` is enough to get the full set
+    from . import rules_config, rules_layout, rules_tracer  # noqa: F401
+
+    active: List[Finding] = list(project.errors)
+    suppressed: Dict[str, int] = {}
+    for name, r in RULES.items():
+        if names is not None and name not in names:
+            continue
+        seen = set()
+        for f in r.check(project):
+            key = (f.rule, f.path, f.line, f.col, f.message)
+            if key in seen:
+                continue
+            seen.add(key)
+            src = project.source(f.path)
+            if src is not None and src.allows(f.rule, f.line):
+                suppressed[f.rule] = suppressed.get(f.rule, 0) + 1
+            else:
+                active.append(f)
+    active.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return active, suppressed
+
+
+# --------------------------------------------------------------------------
+# Small shared AST helpers (used by every rule family)
+# --------------------------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``jax.lax.while_loop`` for an Attribute/Name chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def literal_assign(source: Source, name: str):
+    """The literal value of a module-level ``NAME = <literal>`` assignment
+    (ast.literal_eval'd), or None when absent / not a pure literal.
+
+    This is how the layout checker reads the declarative column tables:
+    by PARSING them, never by importing the modules that own them — which
+    also forces the tables to stay machine-readable pure literals."""
+    for node in source.tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets
+                       if isinstance(t, ast.Name)]
+        elif isinstance(node, ast.AnnAssign) and node.value is not None \
+                and isinstance(node.target, ast.Name):
+            targets = [node.target.id]
+        if name in targets:
+            try:
+                return ast.literal_eval(node.value)
+            except (ValueError, TypeError):
+                return None
+    return None
+
+
+def assign_line(source: Source, name: str) -> int:
+    """Line of a module-level assignment to ``name`` (1 when absent)."""
+    for node in source.tree.body:
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == name
+                for t in node.targets):
+            return node.lineno
+        if isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name) and \
+                node.target.id == name:
+            return node.lineno
+    return 1

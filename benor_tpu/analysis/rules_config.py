@@ -1,0 +1,126 @@
+"""Five-regime config parity: a SimConfig field the driver consumes must
+be visible in every compiled regime (or be allowlisted with a reason).
+
+The incident this rule owns: PRs 1-3 each added a SimConfig field
+(DynParams' f-axis, ``record``, ``witness_trials``) that had to be
+hand-threaded through FIVE separately-compiled regimes — the traced XLA
+loop (sim.py), the batched dynamic-F sweep (sweep.py), the fused pallas
+round (ops/pallas_round.py), the sharded mesh runner
+(parallel/sharded.py) and the multi-host runner (parallel/multihost.py).
+A regime that silently ignores a field still runs and still agrees with
+itself; only a cross-regime comparison (or a user) notices.  This rule
+makes the omission a LINT failure instead: every field ``sim.py`` reads
+off ``cfg`` must be referenced in each regime file, or carry an
+allowlist entry saying why that regime legitimately never sees it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from .core import Finding, Project, rule
+
+SIM_FILE = "sim.py"
+CONFIG_FILE = "config.py"
+
+#: The compiled regimes that must keep parity with sim.py's consumption.
+REGIME_FILES = ("sweep.py", "ops/pallas_round.py", "parallel/sharded.py",
+                "parallel/multihost.py")
+
+#: (field, regime-file) -> why that regime legitimately never references
+#: the field.  Every entry is a REVIEWED delegation argument, not an
+#: escape hatch: the reason names the code that covers the regime.
+PARITY_ALLOWLIST = {
+    ("debug", "sweep.py"):
+        "the sweep drives run_consensus/run_consensus_traced, which "
+        "apply the debug demotion before any regime dispatch",
+    ("debug", "ops/pallas_round.py"):
+        "sim.py / parallel/sharded.py demote debug configs to the XLA "
+        "loop before the fused round is ever entered",
+    ("debug", "parallel/multihost.py"):
+        "multihost delegates the whole loop to sharded._compiled, whose "
+        "_local_slice handles the debug demotion",
+    ("seed", "ops/pallas_round.py"):
+        "compiled regimes receive base_key; jax.random.key(cfg.seed) "
+        "happens once at the harness boundary (sweep.run_point)",
+    ("seed", "parallel/sharded.py"):
+        "same as the fused round: the sharded runner takes the derived "
+        "base_key, never the raw seed",
+    ("seed", "parallel/multihost.py"):
+        "same as the sharded runner; every process derives the identical "
+        "base_key from cfg.seed at its own harness boundary",
+    ("max_rounds", "parallel/multihost.py"):
+        "the round loop (and its cap) lives in sharded._local_slice; "
+        "multihost only builds global inputs and dispatches to it",
+}
+
+
+def _simconfig_fields(project: Project) -> Set[str]:
+    """SimConfig dataclass field names + property names, from the AST of
+    config.py (never from an import)."""
+    src = project.source(CONFIG_FILE)
+    fields: Set[str] = set()
+    if src is None:
+        return fields
+    for node in src.tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == "SimConfig":
+            for item in node.body:
+                if isinstance(item, ast.AnnAssign) and \
+                        isinstance(item.target, ast.Name):
+                    fields.add(item.target.id)
+                elif isinstance(item, ast.FunctionDef) and any(
+                        isinstance(d, ast.Name) and d.id == "property"
+                        for d in item.decorator_list):
+                    fields.add(item.name)
+    return fields
+
+
+def _attr_uses(project: Project, rel: str, fields: Set[str],
+               receiver: str = None) -> Dict[str, int]:
+    """field -> first line where ``<receiver>.<field>`` is read in
+    ``rel``; any receiver name when ``receiver`` is None."""
+    src = project.source(rel)
+    uses: Dict[str, int] = {}
+    if src is None:
+        return uses
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Attribute) or \
+                node.attr not in fields:
+            continue
+        if receiver is not None and not (
+                isinstance(node.value, ast.Name) and
+                node.value.id == receiver):
+            continue
+        if node.attr not in uses or node.lineno < uses[node.attr]:
+            uses[node.attr] = node.lineno
+    return uses
+
+
+@rule("config-parity", "config",
+      "SimConfig fields consumed in sim.py must reach every regime")
+def check_config_parity(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    fields = _simconfig_fields(project)
+    if not fields or project.source(SIM_FILE) is None:
+        return findings
+    consumed = _attr_uses(project, SIM_FILE, fields, receiver="cfg")
+    regime_refs = {rel: _attr_uses(project, rel, fields)
+                   for rel in REGIME_FILES if project.source(rel)}
+    for field in sorted(consumed):
+        for rel, refs in regime_refs.items():
+            if field in refs:
+                continue
+            if (field, rel) in PARITY_ALLOWLIST:
+                continue
+            findings.append(Finding(
+                "config-parity", SIM_FILE, consumed[field], 0,
+                f"SimConfig.{field} is consumed by the driver (sim.py) "
+                f"but never referenced in the {rel} regime — a "
+                f"recorder-style feature that silently skips a regime "
+                f"still runs and still agrees with itself",
+                hint=f"thread the field through {rel}, or add "
+                     f"('{field}', '{rel}') to "
+                     f"analysis.rules_config.PARITY_ALLOWLIST with the "
+                     f"delegation argument"))
+    return findings

@@ -1,0 +1,272 @@
+"""Kernel column-layout rules: prove the declarative layout tables are
+internally disjoint, agree across files, and size every out_spec.
+
+This family owns the exact bug surface PR 2 (flight recorder) and PR 3
+(witness traces) managed by hand: the fused pallas round emits telemetry
+and witness data as EXTRA COLUMNS of a [tiles, T, PARTIAL_COLS] per-tile
+partial buffer, and nothing at runtime notices two features landing on
+the same column — the numbers are merely silently wrong in one regime.
+The tables these rules parse (state.REC_LAYOUT / WIT_LAYOUT,
+ops/pallas_round.PROP_PARTIAL_LAYOUT / VOTE_PARTIAL_LAYOUT /
+VOTE_RECORD_LAYOUT / WITNESS_*_FIELDS) are the same literals the kernels
+derive their indices from, so a layout the checker accepts is the layout
+the kernels ship.
+
+Tables are read by PARSING the source (core.literal_assign) — never by
+importing it — so the rules also run over fixture trees in tests and
+force the tables to stay machine-readable pure literals.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from .core import (Finding, Project, assign_line, dotted_name,
+                   literal_assign, rule)
+
+#: Where each table lives, package-root-relative.
+STATE_FILE = "state.py"
+KERNEL_FILE = "ops/pallas_round.py"
+CONFIG_FILE = "config.py"
+
+_STATE_TABLES = ("REC_LAYOUT", "WIT_LAYOUT")
+_KERNEL_TABLES = ("PROP_PARTIAL_LAYOUT", "VOTE_PARTIAL_LAYOUT",
+                  "VOTE_RECORD_LAYOUT")
+
+
+def _table(project: Project, rel: str, name: str
+           ) -> Tuple[Optional[dict], int, List[Finding]]:
+    """(table, line, findings): parse one layout table; a missing or
+    non-literal table is itself a finding (deleting the table must not
+    silently disable the checker)."""
+    src = project.source(rel)
+    if src is None:
+        return None, 1, []          # file outside this lint root
+    table = literal_assign(src, name)
+    line = assign_line(src, name)
+    if table is None:
+        return None, line, [Finding(
+            "layout-overlap", rel, line, 0,
+            f"machine-readable layout table {name} is missing (or no "
+            f"longer a pure literal) — the kernels and the layout "
+            f"checker both consume it",
+            hint=f"declare {name} as a literal name -> (base, width) "
+                 f"dict at module level")]
+    if not isinstance(table, dict) or not all(
+            isinstance(v, tuple) and len(v) == 2 and
+            all(isinstance(x, int) for x in v) for v in table.values()):
+        return None, line, [Finding(
+            "layout-overlap", rel, line, 0,
+            f"layout table {name} must map name -> (base, width) int "
+            f"pairs",
+            hint="see state.REC_LAYOUT for the shape")]
+    return table, line, []
+
+
+def _by_base(table: dict) -> List[Tuple[str, int, int]]:
+    return sorted(((n, b, w) for n, (b, w) in table.items()),
+                  key=lambda t: t[1])
+
+
+def _check_ranges(rel: str, line: int, label: str, entries,
+                  start: int) -> List[Finding]:
+    """Disjoint + contiguous from ``start`` (positional renderers and
+    the kernels' emission order both index columns densely)."""
+    findings = []
+    expect = start
+    for name, base, width in entries:
+        if width < 1:
+            findings.append(Finding(
+                "layout-overlap", rel, line, 0,
+                f"{label}[{name!r}] has width {width} < 1"))
+            continue
+        if base < expect:
+            findings.append(Finding(
+                "layout-overlap", rel, line, 0,
+                f"{label}[{name!r}] at columns [{base}, {base + width}) "
+                f"overlaps the previous entry (next free column is "
+                f"{expect})",
+                hint="re-base the column block; the derived indices "
+                     "follow the table automatically"))
+        elif base > expect:
+            findings.append(Finding(
+                "layout-overlap", rel, line, 0,
+                f"{label} has a gap before {name!r}: columns "
+                f"[{expect}, {base}) are unassigned — positional "
+                f"consumers (REC_COLUMNS zips, kernel emission order) "
+                f"would mis-align",
+                hint="keep the table dense from its start column"))
+        expect = max(expect, base + width)
+    return findings
+
+
+@rule("layout-overlap", "layout",
+      "layout-table column ranges must be disjoint and dense")
+def check_layout_overlap(project: Project) -> List[Finding]:
+    findings = []
+    for rel, names in ((STATE_FILE, _STATE_TABLES),
+                       (KERNEL_FILE, _KERNEL_TABLES)):
+        if project.source(rel) is None:
+            continue
+        tables = {}
+        for name in names:
+            table, line, errs = _table(project, rel, name)
+            findings += errs
+            if table is not None:
+                tables[name] = (table, line)
+        for name, (table, line) in tables.items():
+            start = 0
+            if name == "VOTE_RECORD_LAYOUT" and \
+                    "VOTE_PARTIAL_LAYOUT" in tables:
+                # the recorder block bases directly after the vote
+                # kernel's base partials — a gap or overlap between the
+                # two is the PR-2 hand-assignment bug
+                base_tab = tables["VOTE_PARTIAL_LAYOUT"][0]
+                start = max(b + w for b, w in base_tab.values())
+            findings += _check_ranges(rel, line, name, _by_base(table),
+                                      start)
+    return findings
+
+
+@rule("layout-parity", "layout",
+      "recorder/witness layouts must agree across state.py and the "
+      "kernels")
+def check_layout_parity(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    if project.source(STATE_FILE) is None or \
+            project.source(KERNEL_FILE) is None:
+        return findings
+    rec, rec_line, e1 = _table(project, STATE_FILE, "REC_LAYOUT")
+    wit, wit_line, e2 = _table(project, STATE_FILE, "WIT_LAYOUT")
+    vote, _, e3 = _table(project, KERNEL_FILE, "VOTE_PARTIAL_LAYOUT")
+    vrec, vrec_line, e4 = _table(project, KERNEL_FILE,
+                                 "VOTE_RECORD_LAYOUT")
+    prop, _, e5 = _table(project, KERNEL_FILE, "PROP_PARTIAL_LAYOUT")
+    # missing tables are reported by layout-overlap; don't double up
+    if any((rec is None, wit is None, vote is None, vrec is None,
+            prop is None)):
+        return findings
+
+    ksrc = project.source(KERNEL_FILE)
+    pf = literal_assign(ksrc, "WITNESS_PROP_FIELDS")
+    vf = literal_assign(ksrc, "WITNESS_VOTE_FIELDS")
+    pc = literal_assign(ksrc, "PARTIAL_COLS")
+    for name, val in (("WITNESS_PROP_FIELDS", pf),
+                      ("WITNESS_VOTE_FIELDS", vf),
+                      ("PARTIAL_COLS", pc)):
+        if val is None:
+            findings.append(Finding(
+                "layout-parity", KERNEL_FILE,
+                assign_line(ksrc, name), 0,
+                f"{name} is missing (or not a pure literal)",
+                hint="the witness field tuples and the physical column "
+                     "width must be machine-readable"))
+    if pf is None or vf is None or pc is None:
+        return findings
+
+    def extent(*tabs):
+        return max(b + w for t in tabs for b, w in t.values())
+
+    # 1. the vote kernel's recorder block is state.REC_LAYOUT, column
+    #    for column, in the same order
+    rec_cols = [n for n, _, _ in _by_base(rec)]
+    vrec_cols = [n for n, _, _ in _by_base(vrec)]
+    if rec_cols != vrec_cols:
+        findings.append(Finding(
+            "layout-parity", KERNEL_FILE, vrec_line, 0,
+            f"VOTE_RECORD_LAYOUT columns {vrec_cols} != state.REC_LAYOUT "
+            f"columns {rec_cols}: the kernel would emit telemetry rows "
+            f"the host renderers mis-label",
+            hint="keep both tables name-identical and base-ordered"))
+    rec_width = extent(rec)
+    vrec_width = extent(vrec, vote) - extent(vote)
+    if rec_width != vrec_width:
+        findings.append(Finding(
+            "layout-parity", STATE_FILE, rec_line, 0,
+            f"state.REC_WIDTH ({rec_width}) != the vote kernel's "
+            f"recorder block width ({vrec_width}): packed_round would "
+            f"assemble rows of the wrong shape",
+            hint="add/remove the column in BOTH layout tables"))
+
+    # 2. the witness field tuples cover state.WIT_LAYOUT exactly, minus
+    #    the host-set "written" sentinel
+    wit_names = set(wit)
+    kernel_names = set(pf) | set(vf) | {"written"}
+    if len(pf) + len(vf) + 1 != len(set(pf) | set(vf)) + 1 or \
+            wit_names != kernel_names:
+        missing = sorted(wit_names - kernel_names)
+        extra = sorted(kernel_names - wit_names)
+        findings.append(Finding(
+            "layout-parity", STATE_FILE, wit_line, 0,
+            f"WIT_LAYOUT columns and the kernels' witness fields "
+            f"disagree (not emitted by any kernel: {missing}; emitted "
+            f"but undeclared: {extra})",
+            hint="WITNESS_PROP_FIELDS + WITNESS_VOTE_FIELDS + "
+                 "{'written'} must equal state.WIT_LAYOUT's names"))
+    wit_width = extent(wit)
+    if wit_width != len(pf) + len(vf) + 1:
+        findings.append(Finding(
+            "layout-parity", STATE_FILE, wit_line, 0,
+            f"state.WIT_WIDTH ({wit_width}) != kernel witness fields + "
+            f"sentinel ({len(pf) + len(vf) + 1})",
+            hint="the witness row assembly indexes by WIT_LAYOUT; the "
+                 "kernels emit per-field columns — widths must match"))
+
+    # 3. base + per-node witness blocks fit the physical partial width
+    #    for the largest watchable node count
+    csrc = project.source(CONFIG_FILE)
+    max_nodes = literal_assign(csrc, "WITNESS_MAX_NODES") \
+        if csrc is not None else None
+    if max_nodes is not None:
+        prop_need = extent(prop) + len(pf) * max_nodes
+        vote_need = extent(vote, vrec) + len(vf) * max_nodes
+        for label, need in (("proposal", prop_need), ("vote", vote_need)):
+            if need > pc:
+                findings.append(Finding(
+                    "layout-parity", KERNEL_FILE,
+                    assign_line(ksrc, "PARTIAL_COLS"), 0,
+                    f"the {label} kernel needs {need} partial columns "
+                    f"at WITNESS_MAX_NODES={max_nodes} but PARTIAL_COLS "
+                    f"is {pc}: the witness blocks would run off the "
+                    f"buffer",
+                    hint="shrink config.WITNESS_MAX_NODES or widen "
+                         "PARTIAL_COLS (and re-check VMEM cost)"))
+    return findings
+
+
+@rule("layout-outspec", "layout",
+      "kernel out_specs must be sized by PARTIAL_COLS, not a literal")
+def check_layout_outspec(project: Project) -> List[Finding]:
+    """A bare ``128`` in a partial-buffer shape is how the next column
+    rework silently diverges from the declared layout: the shape keeps
+    compiling while the tables move.  Every partial shape must reference
+    the PARTIAL_COLS name."""
+    findings = []
+    src = project.source(KERNEL_FILE)
+    if src is None:
+        return findings
+    pc = literal_assign(src, "PARTIAL_COLS")
+    if pc is None:
+        return findings          # layout-parity already reports this
+
+    def scan(sub: ast.AST, where: str):
+        for node in ast.walk(sub):
+            if isinstance(node, ast.Constant) and node.value == pc:
+                findings.append(Finding(
+                    "layout-outspec", KERNEL_FILE, node.lineno,
+                    node.col_offset,
+                    f"bare literal {pc} in {where}: size partial-buffer "
+                    f"shapes with PARTIAL_COLS so out_specs follow the "
+                    f"declared layout",
+                    hint=f"replace {pc} with PARTIAL_COLS"))
+
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.FunctionDef) and \
+                node.name in ("_part", "_partial_cols"):
+            scan(node, f"{node.name}()")
+        elif isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name and name.split(".")[-1] == "ShapeDtypeStruct":
+                scan(node, "a pallas out_shape")
+    return findings

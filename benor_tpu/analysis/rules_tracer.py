@@ -1,0 +1,347 @@
+"""Tracer-hygiene rules: host syncs, host RNG, traced branches, dtype
+drift, donation, and PRNG-key discipline.
+
+Every rule here is grounded in a hazard this repo actually has to manage
+(see analysis/__init__ for the incident map).  The traced-function scope
+comes from visitors.build_index: a host sync in harness code is a
+completion barrier; the SAME call inside a jit/pallas/shard_map-reachable
+function is a per-round device round-trip or a trace error.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from .core import Finding, Project, dotted_name, rule
+from .visitors import _canonical
+
+#: jnp-style numpy namespaces whose calls mark a traced (device) value.
+_TRACED_NS = ("jnp.", "jax.numpy.", "lax.", "jax.lax.")
+
+#: 64-bit dtype spellings — off the int32 state discipline (state.py):
+#: with jax's default x64-disabled config these silently truncate back to
+#: 32 bits; with x64 enabled they double the packed-word width and break
+#: ops/pallas_round.pack_state's bit layout.  Either way: drift.
+_WIDE_DTYPES = {"jnp.int64", "jnp.uint64", "jnp.float64",
+                "np.int64", "np.uint64", "np.float64",
+                "numpy.int64", "numpy.uint64", "numpy.float64"}
+
+#: jax.random samplers (NOT the key combinators fold_in/split/key).
+_SAMPLERS = {"uniform", "normal", "bernoulli", "randint", "bits",
+             "choice", "permutation", "gamma", "beta", "exponential",
+             "categorical"}
+
+#: Parameter names that are donated-size device buffers in this codebase:
+#: the [T, N] state pytree and the preallocated telemetry buffers.
+_DONATABLE = {"state", "states", "recorder", "witness"}
+
+
+def _parents(tree: ast.AST) -> Dict[int, ast.AST]:
+    out: Dict[int, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            out[id(child)] = node
+    return out
+
+
+def _canon(project: Project, rel: str, name: str) -> str:
+    """Alias-canonical dotted name — ONE resolver (visitors._canonical)
+    serves both the reachability analysis and every rule's matching, so
+    the two can never disagree about what a name refers to."""
+    idx = project.index
+    return _canonical(idx.module_of[rel], idx, name)
+
+
+def _is_np(project: Project, rel: str, name: str) -> bool:
+    return _canon(project, rel, name).startswith("numpy.")
+
+
+def _traced_walk(project: Project):
+    """(FuncInfo, node) pairs over every traced function's subtree.
+
+    Each node is yielded ONCE, attributed to its innermost traced
+    function (nested defs are visited before their parents, whose walks
+    then skip the already-claimed subtree) — so one violation is one
+    finding, named after the function that actually contains it."""
+    seen = set()
+    for info in sorted(project.index.traced,
+                       key=lambda f: (f.rel, -f.node.lineno)):
+        for node in ast.walk(info.node):
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            yield info, node
+
+
+def _guarded_by_isinstance(parents: Dict[int, ast.AST], node: ast.AST,
+                           name: str) -> bool:
+    """True when an ancestor If/IfExp tests ``isinstance(name, ...)`` —
+    the static-vs-traced dispatch idiom (ops/sampling.static_m)."""
+    cur = node
+    while id(cur) in parents:
+        cur = parents[id(cur)]
+        if isinstance(cur, (ast.If, ast.IfExp)):
+            for sub in ast.walk(cur.test):
+                if isinstance(sub, ast.Call) and \
+                        isinstance(sub.func, ast.Name) and \
+                        sub.func.id == "isinstance" and sub.args and \
+                        isinstance(sub.args[0], ast.Name) and \
+                        sub.args[0].id == name:
+                    return True
+    return False
+
+
+@rule("host-sync", "tracer",
+      "host synchronization inside a traced function")
+def check_host_sync(project: Project) -> List[Finding]:
+    findings = []
+    parent_cache: Dict[str, Dict[int, ast.AST]] = {}
+    for info, node in _traced_walk(project):
+        if not isinstance(node, ast.Call):
+            continue
+        # x.item(): the canonical device->host sync
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "item" and not node.args:
+            findings.append(Finding(
+                "host-sync", info.rel, node.lineno, node.col_offset,
+                f".item() inside traced function {info.name!r} forces a "
+                f"device->host sync per call",
+                hint="keep the value on device (jnp scalar) or fetch it "
+                     "once outside the jit boundary"))
+            continue
+        name = dotted_name(node.func)
+        # np.asarray / np.array on a tracer materializes on host
+        if name and _is_np(project, info.rel, name) and \
+                _canon(project, info.rel, name).split(".")[-1] in \
+                ("asarray", "array"):
+            findings.append(Finding(
+                "host-sync", info.rel, node.lineno, node.col_offset,
+                f"np.{name.split('.')[-1]}() inside traced function "
+                f"{info.name!r} pulls its operand to the host",
+                hint="use jnp.asarray, or pragma when the operand is "
+                     "static config-only data that constant-folds"))
+            continue
+        # int()/float()/bool() on a parameter of the traced function
+        if isinstance(node.func, ast.Name) and \
+                node.func.id in ("int", "float", "bool") and \
+                len(node.args) == 1:
+            arg = node.args[0]
+            target = arg
+            if isinstance(target, ast.Subscript):
+                target = target.value
+            if isinstance(target, ast.Name) and \
+                    target.id in info.params:
+                parents = parent_cache.setdefault(
+                    info.rel, _parents(project.source(info.rel).tree))
+                if _guarded_by_isinstance(parents, node, target.id):
+                    continue    # static-vs-traced dispatch idiom
+                findings.append(Finding(
+                    "host-sync", info.rel, node.lineno, node.col_offset,
+                    f"{node.func.id}() on parameter "
+                    f"{target.id!r} of traced function {info.name!r} "
+                    f"is a concretization sync (TracerConversionError "
+                    f"under jit, a blocking fetch otherwise)",
+                    hint="thread the value as a traced scalar, or make "
+                         "it a static argument"))
+    return findings
+
+
+@rule("host-rng", "tracer",
+      "host-side numpy RNG (non-reproducible across mesh shapes)")
+def check_host_rng(project: Project) -> List[Finding]:
+    findings = []
+    for rel, src in project.sources.items():
+        for node in ast.walk(src.tree):
+            name = dotted_name(node) if isinstance(node, ast.Attribute) \
+                else None
+            if not name:
+                continue
+            canon = _canon(project, rel, name)
+            if canon.startswith("numpy.random") and \
+                    not isinstance(node.ctx, ast.Store):
+                findings.append(Finding(
+                    "host-rng", rel, node.lineno, node.col_offset,
+                    "np.random draws do not key on (seed, round, phase, "
+                    "trial, node) and cannot reproduce across mesh "
+                    "shapes (ops/rng.py contract)",
+                    hint="derive draws from jax.random.fold_in chains, "
+                         "or pragma seeded host-side input generation"))
+    # one finding per chain: np.random.default_rng yields nested
+    # Attribute nodes ("np.random", "np.random.default_rng") that share
+    # a start location
+    uniq = {}
+    for f in findings:
+        uniq[(f.path, f.line, f.col)] = f
+    return list(uniq.values())
+
+
+@rule("traced-branch", "tracer",
+      "Python control flow on a traced value")
+def check_traced_branch(project: Project) -> List[Finding]:
+    findings = []
+    for info, node in _traced_walk(project):
+        if not isinstance(node, (ast.If, ast.While)):
+            continue
+        offender = None
+        for sub in ast.walk(node.test):
+            if isinstance(sub, ast.Call):
+                name = dotted_name(sub.func)
+                if name:
+                    canon = _canon(project, info.rel, name)
+                    if canon.startswith(("jax.numpy.", "jax.lax.")) or \
+                            name.startswith(_TRACED_NS):
+                        offender = name
+                        break
+                if isinstance(sub.func, ast.Attribute) and \
+                        sub.func.attr in ("any", "all") and not sub.args:
+                    offender = f".{sub.func.attr}()"
+                    break
+        if offender is not None:
+            kw = "if" if isinstance(node, ast.If) else "while"
+            findings.append(Finding(
+                "traced-branch", info.rel, node.lineno, node.col_offset,
+                f"Python `{kw}` on a traced expression ({offender}) in "
+                f"{info.name!r}: under jit this is a ConcretizationError "
+                f"(or a silent host sync outside it)",
+                hint="use jnp.where / lax.cond / lax.while_loop"))
+    return findings
+
+
+@rule("dtype-drift", "tracer",
+      "64-bit dtype off the int32 state discipline")
+def check_dtype_drift(project: Project) -> List[Finding]:
+    findings = []
+    for info, node in _traced_walk(project):
+        if not isinstance(node, ast.Attribute):
+            continue
+        name = dotted_name(node)
+        if name in _WIDE_DTYPES:
+            findings.append(Finding(
+                "dtype-drift", info.rel, node.lineno, node.col_offset,
+                f"{name} in traced function {info.name!r}: the state "
+                f"discipline is int32 (state.py) — with x64 disabled "
+                f"this silently truncates, with it enabled it breaks "
+                f"the packed-word layout (ops/pallas_round.pack_state)",
+                hint="use an int32/float32 dtype on device; 64-bit "
+                     "belongs to host-side summaries only"))
+    return findings
+
+
+@rule("donate-argnums", "tracer",
+      "jit entrypoint takes donated-size buffers without donate_argnums")
+def check_donate(project: Project) -> List[Finding]:
+    findings = []
+    idx = project.index
+    for rel, src in project.sources.items():
+        module = idx.module_of[rel]
+        for node in ast.walk(src.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            for dec in node.decorator_list:
+                if not isinstance(dec, ast.Call):
+                    continue
+                name = dotted_name(dec.func)
+                if not name:
+                    continue
+                canon = _canon(project, rel, name)
+                is_jit = canon.split(".")[-1] == "jit"
+                if canon.split(".")[-1] == "partial" and dec.args:
+                    inner = dotted_name(dec.args[0])
+                    is_jit = bool(inner) and _canon(
+                        project, rel, inner).split(".")[-1] == "jit"
+                if not is_jit:
+                    continue
+                kwargs = {k.arg for k in dec.keywords}
+                if kwargs & {"donate_argnums", "donate_argnames"}:
+                    continue
+                info = idx.funcs.get((module, node.name))
+                big = [p for p in (info.params if info else ())
+                       if p in _DONATABLE]
+                if big:
+                    findings.append(Finding(
+                        "donate-argnums", rel, dec.lineno,
+                        dec.col_offset,
+                        f"jit entrypoint {node.name!r} takes the "
+                        f"donated-size buffer(s) {', '.join(big)} "
+                        f"without donate_argnums: input and loop carry "
+                        f"stay live together (2x HBM at [T, N] scale)",
+                        hint="add donate_argnums/donate_argnames, or "
+                             "pragma entrypoints whose operands are "
+                             "intentionally re-used by the caller"))
+    return findings
+
+
+@rule("rng-fold", "tracer",
+      "PRNG key use off the chained fold_in discipline")
+def check_rng_fold(project: Project) -> List[Finding]:
+    findings = []
+    for rel, src in project.sources.items():
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if not name:
+                continue
+            canon = _canon(project, rel, name)
+            if canon.endswith("random.fold_in") and len(node.args) >= 2:
+                for sub in ast.walk(node.args[1]):
+                    if isinstance(sub, ast.BinOp) and \
+                            isinstance(sub.op, ast.Mult):
+                        findings.append(Finding(
+                            "rng-fold", rel, node.lineno,
+                            node.col_offset,
+                            "fold_in of an arithmetic index product: "
+                            "flat ids like trial*N+node overflow int32 "
+                            "at 1M x 1M scale — fold each component in "
+                            "its own chained fold_in (ops/rng.py)",
+                            hint="fold_in(fold_in(key, trial), node)"))
+                        break
+    # sampling straight from the run's base_key (no per-round/phase fold)
+    for info, node in _traced_walk(project):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        name = dotted_name(node.func)
+        if not name:
+            continue
+        canon = _canon(project, info.rel, name)
+        parts = canon.split(".")
+        if len(parts) >= 2 and parts[-2] == "random" and \
+                parts[-1] in _SAMPLERS:
+            key_arg = node.args[0]
+            if isinstance(key_arg, ast.Name) and \
+                    key_arg.id == "base_key" and \
+                    key_arg.id in info.params:
+                findings.append(Finding(
+                    "rng-fold", info.rel, node.lineno, node.col_offset,
+                    f"jax.random.{parts[-1]} drawn directly from the "
+                    f"run's base_key in {info.name!r}: every call site "
+                    f"shares one stream (ops/rng.py requires exactly "
+                    f"one fold_in chain per use)",
+                    hint="key on (round, phase, ids) via "
+                         "rng.round_key/grid_keys before sampling"))
+    return findings
+
+
+@rule("broad-except", "tracer",
+      "broad exception handler (silently eats Mosaic/XLA failures)")
+def check_broad_except(project: Project) -> List[Finding]:
+    findings = []
+    for rel, src in project.sources.items():
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            broad = node.type is None or (
+                isinstance(node.type, ast.Name) and
+                node.type.id in ("Exception", "BaseException"))
+            if broad:
+                findings.append(Finding(
+                    "broad-except", rel, node.lineno, node.col_offset,
+                    "except Exception swallows kernel-lowering and "
+                    "backend failures indistinguishably from real "
+                    "errors (the demotion-policy bugs of results.py's "
+                    "probe history)",
+                    hint="catch the specific exception, or pragma "
+                         "best-effort boundaries with a justification"))
+    return findings

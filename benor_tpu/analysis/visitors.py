@@ -1,0 +1,259 @@
+"""Cross-module function index + traced-reachability analysis.
+
+The tracer-hygiene rules (rules_tracer.py) need to know which functions
+execute UNDER A JAX TRACE — i.e. are reachable from a ``jax.jit`` /
+``pallas_call`` / ``shard_map`` / ``lax.while_loop``-family boundary —
+because a host sync that is fine in harness code (``int(out[0])`` as a
+completion barrier in sweep.run_point) is a bug inside a compiled loop.
+
+This is a deliberately conservative STATIC approximation:
+
+  seeds        functions decorated with ``jax.jit`` (incl. the
+               ``functools.partial(jax.jit, ...)`` idiom), and functions
+               passed — directly or via ``functools.partial`` — into a
+               trace boundary call (jit, pallas_call, shard_map, vmap,
+               pmap, and the lax control-flow combinators).
+  propagation  a call from a traced function marks the callee traced,
+               resolved through each module's import-alias table (plain
+               names, one-level ``alias.name`` attributes, and relative
+               imports); nested ``def``s of a traced function are traced.
+  host escape  functions handed to ``jax.debug.callback`` /
+               ``jax.pure_callback`` / ``io_callback`` run on the HOST by
+               construction and are never marked, even when the callback
+               registration happens inside a traced function.
+
+Unresolvable calls (methods on unknown receivers, dynamic dispatch) are
+skipped — the analysis under-approximates rather than guessing, so its
+findings stay actionable.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Project, dotted_name
+
+#: Bare callables that open a trace (matched by name alone — the repo
+#: imports shard_map under this name, and jit/vmap read unambiguously).
+_BARE_BOUNDARIES = {"jit", "pallas_call", "shard_map", "vmap", "pmap"}
+
+#: lax control-flow combinators: matched as ``lax.<name>`` /
+#: ``jax.lax.<name>`` (never by bare name — loop bodies are commonly
+#: local functions called ``cond``).
+_LAX_BOUNDARIES = {"while_loop", "scan", "cond", "fori_loop", "switch",
+                   "map", "associative_scan"}
+
+#: Registering a function here hands it to the HOST runtime.
+_HOST_SINKS = {"callback", "pure_callback", "io_callback"}
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    module: str                  # dotted module, e.g. benor_tpu.ops.rng
+    name: str
+    rel: str                     # source path relative to the root
+    node: ast.AST                # FunctionDef / AsyncFunctionDef
+    params: Tuple[str, ...]
+
+
+class Index:
+    """Function defs, import aliases, and the traced set for one Project."""
+
+    def __init__(self) -> None:
+        self.funcs: Dict[Tuple[str, str], FuncInfo] = {}
+        self.aliases: Dict[str, Dict[str, str]] = {}   # module -> alias map
+        self.module_of: Dict[str, str] = {}            # rel path -> module
+        self.traced: List[FuncInfo] = []
+        self._traced_ids: Set[int] = set()
+        self._host_ids: Set[int] = set()
+
+    def is_traced(self, node: ast.AST) -> bool:
+        return id(node) in self._traced_ids
+
+
+def _module_name(root_pkg: str, rel: str) -> str:
+    parts = rel[:-3].split("/")                        # strip .py
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join([root_pkg] + parts) if parts else root_pkg
+
+
+def _collect_aliases(module: str, tree: ast.Module) -> Dict[str, str]:
+    """alias -> dotted target, from every import at any depth."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                base = module.split(".")
+                base = base[:len(base) - node.level]
+                src = ".".join(base + ([node.module] if node.module else []))
+            else:
+                src = node.module or ""
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                out[a.asname or a.name] = f"{src}.{a.name}" if src else a.name
+    return out
+
+
+def _params(node) -> Tuple[str, ...]:
+    a = node.args
+    names = [p.arg for p in
+             getattr(a, "posonlyargs", []) + a.args + a.kwonlyargs]
+    for extra in (a.vararg, a.kwarg):
+        if extra is not None:
+            names.append(extra.arg)
+    return tuple(names)
+
+
+def _canonical(source_module: str, idx: "Index", name: str) -> str:
+    """Resolve the first component of a dotted name through the module's
+    alias table: ``pl.pallas_call`` -> ``jax.experimental.pallas.pallas_call``."""
+    head, _, rest = name.partition(".")
+    target = idx.aliases.get(source_module, {}).get(head)
+    if target is None:
+        return name
+    return f"{target}.{rest}" if rest else target
+
+
+def _is_boundary(module: str, idx: "Index", func_node: ast.AST) -> bool:
+    name = dotted_name(func_node)
+    if name is None:
+        return False
+    canon = _canonical(module, idx, name)
+    last = canon.split(".")[-1]
+    if last in _BARE_BOUNDARIES:
+        return True
+    parts = canon.split(".")
+    return (last in _LAX_BOUNDARIES and len(parts) >= 2
+            and parts[-2] == "lax")
+
+
+def _is_partial(module: str, idx: "Index", node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_name(node.func)
+    if name is None:
+        return False
+    return _canonical(module, idx, name).split(".")[-1] == "partial"
+
+
+def resolve_call(idx: "Index", module: str,
+                 func_node: ast.AST) -> Optional[FuncInfo]:
+    """FuncInfo for a call target, through the alias table; None when the
+    target is not a statically resolvable project function."""
+    name = dotted_name(func_node)
+    if name is None:
+        return None
+    if "." not in name:
+        alias = idx.aliases.get(module, {}).get(name)
+        if alias and "." in alias:
+            mod, _, fn = alias.rpartition(".")
+            return idx.funcs.get((mod, fn))
+        return idx.funcs.get((module, name))
+    head, _, rest = name.partition(".")
+    if "." in rest:                  # method chains / deep attrs: skip
+        return None
+    target = idx.aliases.get(module, {}).get(head)
+    if target is None:
+        return None
+    return idx.funcs.get((target, rest))
+
+
+def _callable_args(call: ast.Call):
+    """The argument expressions of a call that may carry function refs."""
+    return list(call.args) + [k.value for k in call.keywords]
+
+
+def build_index(project: Project) -> Index:
+    """Build the function index, alias tables and traced set."""
+    idx = Index()
+    root_pkg = project.root.rstrip("/").split("/")[-1]
+
+    for rel, src in project.sources.items():
+        module = _module_name(root_pkg, rel)
+        idx.module_of[rel] = module
+        idx.aliases[module] = _collect_aliases(module, src.tree)
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                idx.funcs[(module, node.name)] = FuncInfo(
+                    module=module, name=node.name, rel=rel, node=node,
+                    params=_params(node))
+
+    def seed_from_ref(module: str, ref: ast.AST, seeds: list) -> None:
+        if _is_partial(module, idx, ref):
+            args = ref.args
+            if args:
+                seed_from_ref(module, args[0], seeds)
+            return
+        if isinstance(ref, (ast.Name, ast.Attribute)):
+            info = resolve_call(idx, module, ref)
+            if info is not None:
+                seeds.append(info)
+
+    seeds: List[FuncInfo] = []
+    for rel, src in project.sources.items():
+        module = idx.module_of[rel]
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    ref = dec.func if isinstance(dec, ast.Call) else dec
+                    name = dotted_name(ref)
+                    if name is None:
+                        continue
+                    canon = _canonical(module, idx, name)
+                    if canon.split(".")[-1] in _BARE_BOUNDARIES:
+                        seeds.append(idx.funcs[(module, node.name)])
+                    elif canon.split(".")[-1] == "partial" and \
+                            isinstance(dec, ast.Call) and dec.args:
+                        inner = dotted_name(dec.args[0])
+                        if inner and _canonical(module, idx, inner) \
+                                .split(".")[-1] in _BARE_BOUNDARIES:
+                            seeds.append(idx.funcs[(module, node.name)])
+            elif isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                canon = _canonical(module, idx, name) if name else ""
+                if canon.split(".")[-1] in _HOST_SINKS:
+                    for ref in _callable_args(node):
+                        info = None
+                        if isinstance(ref, (ast.Name, ast.Attribute)):
+                            info = resolve_call(idx, module, ref)
+                        if info is not None:
+                            idx._host_ids.add(id(info.node))
+                elif _is_boundary(module, idx, node.func):
+                    for ref in _callable_args(node):
+                        seed_from_ref(module, ref, seeds)
+
+    # worklist propagation: traced -> callees, partial targets, nested defs
+    work = list(seeds)
+    while work:
+        info = work.pop()
+        if id(info.node) in idx._traced_ids or \
+                id(info.node) in idx._host_ids:
+            continue
+        idx._traced_ids.add(id(info.node))
+        idx.traced.append(info)
+        for node in ast.walk(info.node):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not info.node:
+                nested = idx.funcs.get((info.module, node.name))
+                if nested is not None and nested.node is node:
+                    work.append(nested)
+            elif isinstance(node, ast.Call):
+                target = resolve_call(idx, info.module, node.func)
+                if target is not None:
+                    work.append(target)
+                if _is_partial(info.module, idx, node) and node.args:
+                    first = node.args[0]
+                    if isinstance(first, (ast.Name, ast.Attribute)):
+                        target = resolve_call(idx, info.module, first)
+                        if target is not None:
+                            work.append(target)
+    idx.traced.sort(key=lambda f: (f.rel, f.node.lineno))
+    return idx

@@ -62,34 +62,91 @@ from ..state import NetState
 
 _DEC, _KILL, _FAULT, _KSHIFT = 2, 3, 4, 5
 
-#: Flight-recorder partial columns emitted by the vote kernel when
-#: record=True (cols 0-4 are the historical histogram/settled partials).
-#: All are per-tile SUMS except _RP_MARGIN, a per-tile per-trial MAX
-#: (cross-tile combine = max).  _RP_KILL includes this shard's pad lanes
-#: (they carry the killed bit); packed_round subtracts the static pad
-#: count before the psum.
-_RP_DEC, _RP_KILL = 5, 6
-_RP_U0, _RP_U1, _RP_UQ = 7, 8, 9
-_RP_COIN, _RP_MARGIN = 10, 11
+#: Physical width of both kernels' [tiles, T, PARTIAL_COLS] per-tile
+#: reduction layout.  128 = one TPU lane register row; every out_spec and
+#: partial constructor below must be sized with THIS NAME (the static
+#: layout checker, analysis/rules_layout.py, flags bare literals) so the
+#: declared layouts and the shipped buffer shapes cannot drift apart.
+PARTIAL_COLS = 128
 
-#: Witness-partial layout (SimConfig.witness_trials / witness_nodes).
-#: Each watched global node id owns a block of per-tile partial columns —
-#: only the tile holding the (real, non-pad) lane contributes, so the
-#: cross-tile/cross-shard combine is a plain sum.  The proposal kernel
-#: emits 2 columns per watched node (p0, p1) starting at _WITA_BASE; the
-#: vote kernel emits 6 (x, decided, killed, coined, v0, v1) starting
-#: after its base + flight-recorder columns (see _witb_base).  The
-#: per-trial values ride the partial layout's [T] axis; packed_round
-#: selects the watched trials outside the kernel.
-_WITA_BASE = 4
-_WITA_PER_NODE = 2
-_WITB_PER_NODE = 6
+#: Per-tile partial-column layouts — name -> (base, width), pure literals
+#: (the layout checker PARSES these tables out of this file and proves:
+#: ranges disjoint, recorder block == state.REC_LAYOUT column-for-column,
+#: witness fields == state.WIT_LAYOUT minus the host-set sentinel, and
+#: base + per-node blocks for WITNESS_MAX_NODES watched nodes fit inside
+#: PARTIAL_COLS).  PR 2/3 assigned these columns by hand — the exact
+#: silent-corruption surface the checker now owns.
+#:
+#: Proposal kernel: vote-class histogram over honest live lanes + the
+#: tile's alive count; witness blocks (2 cols per watched node) follow.
+PROP_PARTIAL_LAYOUT = {
+    "vote_hist": (0, 3),    # cols 0-2: sent-vote class histogram 0/1/"?"
+    "alive": (3, 1),        # alive count (quorum gate / n_alive)
+}
+
+#: Vote kernel base partials: the NEXT round's proposal histogram + the
+#: loop predicate's settled/unsettled counts.
+VOTE_PARTIAL_LAYOUT = {
+    "next_hist": (0, 3),    # cols 0-2: next round's proposal histogram
+    "settled": (3, 1),
+    "unsettled": (4, 1),    # the while-loop predicate
+}
+
+#: Flight-recorder partials appended by the vote kernel when record=True,
+#: one column per state.REC_LAYOUT column IN REC COLUMN ORDER, based
+#: directly after the base partials.  All per-tile SUMS except
+#: tally_margin, a per-tile per-trial MAX (cross-tile combine = max).
+#: ``killed`` includes this shard's pad lanes (they carry the killed
+#: bit); packed_round subtracts the static pad count before the psum.
+VOTE_RECORD_LAYOUT = {
+    "decided": (5, 1),
+    "killed": (6, 1),
+    "undecided_0": (7, 1),
+    "undecided_1": (8, 1),
+    "undecided_q": (9, 1),
+    "coin_flips": (10, 1),
+    "tally_margin": (11, 1),
+}
+
+#: Witness-partial blocks (SimConfig.witness_trials / witness_nodes).
+#: Each watched global node id owns one column per field — only the tile
+#: holding the (real, non-pad) lane contributes, so the cross-tile/
+#: cross-shard combine is a plain sum.  The proposal kernel emits
+#: WITNESS_PROP_FIELDS per watched node starting at _WITA_BASE; the vote
+#: kernel emits WITNESS_VOTE_FIELDS starting after its base + (when
+#: record rides) flight-recorder columns (_witb_base).  The per-trial
+#: values ride the partial layout's [T] axis; packed_round selects the
+#: watched trials outside the kernel.  Field names are state.WIT_LAYOUT
+#: column names: together with the host-set "written" sentinel the two
+#: tuples must cover that table exactly (layout checker).
+WITNESS_PROP_FIELDS = ("p0", "p1")
+WITNESS_VOTE_FIELDS = ("x", "decided", "killed", "coined", "v0", "v1")
+
+
+def _extent(*layouts) -> int:
+    """One-past-the-last column of the union of layout tables."""
+    return max(b + w for lay in layouts for b, w in lay.values())
+
+
+_RP_DEC = VOTE_RECORD_LAYOUT["decided"][0]
+_RP_KILL = VOTE_RECORD_LAYOUT["killed"][0]
+_RP_U0 = VOTE_RECORD_LAYOUT["undecided_0"][0]
+_RP_U1 = VOTE_RECORD_LAYOUT["undecided_1"][0]
+_RP_UQ = VOTE_RECORD_LAYOUT["undecided_q"][0]
+_RP_COIN = VOTE_RECORD_LAYOUT["coin_flips"][0]
+_RP_MARGIN = VOTE_RECORD_LAYOUT["tally_margin"][0]
+
+_WITA_BASE = _extent(PROP_PARTIAL_LAYOUT)
+_WITA_PER_NODE = len(WITNESS_PROP_FIELDS)
+_WITB_PER_NODE = len(WITNESS_VOTE_FIELDS)
 
 
 def _witb_base(record: bool) -> int:
-    """First vote-kernel witness column: after the 5 base partials and,
-    when the flight recorder rides too, its 7 telemetry columns."""
-    return 5 + (7 if record else 0)
+    """First vote-kernel witness column: after the base partials and,
+    when the flight recorder rides too, its telemetry columns."""
+    if record:
+        return _extent(VOTE_PARTIAL_LAYOUT, VOTE_RECORD_LAYOUT)
+    return _extent(VOTE_PARTIAL_LAYOUT)
 
 
 def _witness_cols(scal_ref, shape, witness_ids, n_local, fields):
@@ -215,9 +272,10 @@ def _mixed_draws(m, scal_ref, scal2_ref, c0, c1, cq, ne, shape):
 
 
 def _partial_cols(t, cols):
-    """[T]-vectors -> the [1, T, 128] partial layout (col i = cols[i])."""
-    col = jax.lax.broadcasted_iota(jnp.int32, (1, t, 128), 2)
-    out = jnp.zeros((1, t, 128), jnp.int32)
+    """[T]-vectors -> the [1, T, PARTIAL_COLS] partial layout
+    (col i = cols[i])."""
+    col = jax.lax.broadcasted_iota(jnp.int32, (1, t, PARTIAL_COLS), 2)
+    out = jnp.zeros((1, t, PARTIAL_COLS), jnp.int32)
     for i, v in enumerate(cols):
         out = out + (col == i) * v[None, :, None]
     return out
@@ -477,7 +535,7 @@ def _lane(t):
 
 
 def _part(t):
-    return pl.BlockSpec((1, t, 128), lambda j: (j, 0, 0),
+    return pl.BlockSpec((1, t, PARTIAL_COLS), lambda j: (j, 0, 0),
                         memory_space=pltpu.VMEM)
 
 
@@ -546,8 +604,8 @@ def proposal_hist_pallas(base_key, r, phase, hist, pack, crash_round,
         functools.partial(_prop_hist_kernel, m, fault_model, freeze,
                           has_cr, counts_mode, camp_b0, camp_b1,
                           witness_ids, n_local),
-        out_shape=jax.ShapeDtypeStruct((np_total // TILE_N, T, 128),
-                                       jnp.int32),
+        out_shape=jax.ShapeDtypeStruct((np_total // TILE_N, T,
+                                        PARTIAL_COLS), jnp.int32),
         grid=(np_total // TILE_N,),
         in_specs=specs,
         out_specs=_part(T),
@@ -613,8 +671,8 @@ def vote_commit_pallas(base_key, r, phase, hist, pack, crash_round,
                           counts_mode, camp_b0, camp_b1, record,
                           witness_ids, n_local),
         out_shape=[jax.ShapeDtypeStruct((T, np_total), jnp.int32),
-                   jax.ShapeDtypeStruct((np_total // TILE_N, T, 128),
-                                        jnp.int32)],
+                   jax.ShapeDtypeStruct((np_total // TILE_N, T,
+                                         PARTIAL_COLS), jnp.int32)],
         grid=(np_total // TILE_N,),
         in_specs=specs,
         out_specs=[_lane(T), _part(T)],

@@ -155,6 +155,7 @@ def _flagship_probe(n: int) -> bool:
         r, _ = run_consensus(cfg, state, faults, jax.random.key(0))
         int(r)                                # force execution
         return True
+    # benorlint: allow-broad-except — non-Mosaic errors re-raise below
     except Exception as e:  # noqa: BLE001 — filtered re-raise below
         if not any(s in f"{type(e).__name__}: {e}"
                    for s in ("Mosaic", "mosaic", "pallas", "Pallas")):

@@ -106,6 +106,8 @@ def _run_cond(cfg: SimConfig, carry, ctx=None):
                                                 else ctx)
 
 
+# benorlint: allow-donate-argnums — run_point's compile-then-time double
+# call and every parity oracle re-invoke with the SAME state buffers
 @functools.partial(jax.jit, static_argnums=0)
 def run_consensus(cfg: SimConfig, state: NetState, faults: FaultSpec,
                   base_key: jax.Array):
@@ -224,6 +226,9 @@ def resume_consensus(cfg: SimConfig, state: NetState, faults: FaultSpec,
     return (out[0] - 1, *out[1:])
 
 
+# benorlint: allow-donate-argnums — poll loops re-pass the carried
+# recorder/witness buffers and backends snapshot the input state between
+# slices; donation would invalidate those caller-held arrays
 @functools.partial(jax.jit, static_argnums=0)
 def run_consensus_slice(cfg: SimConfig, state: NetState, faults: FaultSpec,
                         base_key: jax.Array, from_round: jax.Array,

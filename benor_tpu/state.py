@@ -164,24 +164,41 @@ class FaultSpec:
 # decided + killed + undecided classes sum to T*N >= 1).
 # --------------------------------------------------------------------------
 
-#: Recorder columns.  All network-global counts (summed over trials AND
-#: nodes) except REC_MARGIN — the tally-margin summary, sum over trials of
-#: the per-trial MAX |v0 - v1| vote margin over lanes that ran the vote
-#: phase (a max, not a sum, so int32 cannot overflow at N=1M x 1k trials;
-#: 0 everywhere = the count-controlling adversary's forced-tie livelock).
-REC_DECIDED = 0   # decided lanes (cumulative)
-REC_KILLED = 1    # killed lanes
-REC_UNDEC0 = 2    # live undecided lanes holding x=0
-REC_UNDEC1 = 3    # live undecided lanes holding x=1
-REC_UNDECQ = 4    # live undecided lanes holding "?"
-REC_COINS = 5     # lanes that committed a coin flip this round
-REC_MARGIN = 6    # tally-margin summary (see above); 0 on row 0
-REC_WIDTH = 7
+#: Recorder column layout — name -> (base, width), the machine-readable
+#: single source of truth that BOTH the runtime (the REC_* indices below,
+#: the host-side renderers in utils/metrics.py, the vote kernel's
+#: telemetry partials in ops/pallas_round.py) and the static layout
+#: checker (benor_tpu/analysis/rules_layout.py) consume.  Keep it a PURE
+#: LITERAL: the checker reads it by parsing this file, never by importing
+#: it.  All columns are network-global counts (summed over trials AND
+#: nodes) except tally_margin — the tally-margin summary, sum over trials
+#: of the per-trial MAX |v0 - v1| vote margin over lanes that ran the
+#: vote phase (a max, not a sum, so int32 cannot overflow at N=1M x 1k
+#: trials; 0 everywhere = the count-controlling adversary's forced-tie
+#: livelock; 0 on row 0).
+REC_LAYOUT = {
+    "decided": (0, 1),      # decided lanes (cumulative)
+    "killed": (1, 1),       # killed lanes
+    "undecided_0": (2, 1),  # live undecided lanes holding x=0
+    "undecided_1": (3, 1),  # live undecided lanes holding x=1
+    "undecided_q": (4, 1),  # live undecided lanes holding "?"
+    "coin_flips": (5, 1),   # lanes that committed a coin flip this round
+    "tally_margin": (6, 1),  # tally-margin summary (see above)
+}
 
-#: Column names, index-aligned with the REC_* constants — the single
-#: source of truth for every host-side renderer (utils/metrics.py).
-REC_COLUMNS = ("decided", "killed", "undecided_0", "undecided_1",
-               "undecided_q", "coin_flips", "tally_margin")
+REC_DECIDED = REC_LAYOUT["decided"][0]
+REC_KILLED = REC_LAYOUT["killed"][0]
+REC_UNDEC0 = REC_LAYOUT["undecided_0"][0]
+REC_UNDEC1 = REC_LAYOUT["undecided_1"][0]
+REC_UNDECQ = REC_LAYOUT["undecided_q"][0]
+REC_COINS = REC_LAYOUT["coin_flips"][0]
+REC_MARGIN = REC_LAYOUT["tally_margin"][0]
+REC_WIDTH = max(b + w for b, w in REC_LAYOUT.values())
+
+#: Column names, index-aligned with the REC_* constants — derived from
+#: the layout table so host-side renderers (utils/metrics.py) can never
+#: drift from the kernel emission order.
+REC_COLUMNS = tuple(sorted(REC_LAYOUT, key=lambda c: REC_LAYOUT[c][0]))
 
 
 def recorder_snapshot_row(x: jax.Array, decided: jax.Array,
@@ -254,22 +271,40 @@ def new_recorder(cfg: SimConfig, state: NetState, ctx=None) -> jax.Array:
 # post-/start snapshot; row r the watched lanes at the END of round r.
 # --------------------------------------------------------------------------
 
-#: Witness columns, per watched (trial, node) per round.
-WIT_X = 0        # committed protocol value (VAL0 | VAL1 | VALQ)
-WIT_DECIDED = 1  # decided bit (node.ts:100,103)
-WIT_KILLED = 2   # killed bit (crash / crash_at_round / stop)
-WIT_COINED = 3   # lane committed a coin flip this round (node.ts:111)
-WIT_P0 = 4       # proposal-phase tally for 0 (node.ts:63-69 input)
-WIT_P1 = 5       # proposal-phase tally for 1
-WIT_V0 = 6       # vote-phase tally for 0 (the decide evidence, node.ts:99)
-WIT_V1 = 7       # vote-phase tally for 1 (node.ts:102)
-WIT_WRITTEN = 8  # 1 on every written row (the unwritten-row sentinel)
-WIT_WIDTH = 9
+#: Witness column layout — name -> (base, width), per watched
+#: (trial, node) per round.  Same contract as REC_LAYOUT: a pure-literal
+#: machine-readable table that the runtime (WIT_* indices, the pallas
+#: witness partials, audit.witness_rows) and the static layout checker
+#: both consume.  Every name except the host-set ``written`` sentinel
+#: must be emitted by exactly one kernel witness block
+#: (ops/pallas_round.py WITNESS_PROP_FIELDS / WITNESS_VOTE_FIELDS) — the
+#: cross-file parity the checker proves.
+WIT_LAYOUT = {
+    "x": (0, 1),        # committed protocol value (VAL0 | VAL1 | VALQ)
+    "decided": (1, 1),  # decided bit (node.ts:100,103)
+    "killed": (2, 1),   # killed bit (crash / crash_at_round / stop)
+    "coined": (3, 1),   # lane committed a coin flip this round (node.ts:111)
+    "p0": (4, 1),       # proposal-phase tally for 0 (node.ts:63-69 input)
+    "p1": (5, 1),       # proposal-phase tally for 1
+    "v0": (6, 1),       # vote-phase tally for 0 (decide evidence, node.ts:99)
+    "v1": (7, 1),       # vote-phase tally for 1 (node.ts:102)
+    "written": (8, 1),  # 1 on every written row (unwritten-row sentinel)
+}
 
-#: Column names, index-aligned with the WIT_* constants — the single
-#: source of truth for every host-side renderer (audit.witness_rows).
-WIT_COLUMNS = ("x", "decided", "killed", "coined", "p0", "p1", "v0", "v1",
-               "written")
+WIT_X = WIT_LAYOUT["x"][0]
+WIT_DECIDED = WIT_LAYOUT["decided"][0]
+WIT_KILLED = WIT_LAYOUT["killed"][0]
+WIT_COINED = WIT_LAYOUT["coined"][0]
+WIT_P0 = WIT_LAYOUT["p0"][0]
+WIT_P1 = WIT_LAYOUT["p1"][0]
+WIT_V0 = WIT_LAYOUT["v0"][0]
+WIT_V1 = WIT_LAYOUT["v1"][0]
+WIT_WRITTEN = WIT_LAYOUT["written"][0]
+WIT_WIDTH = max(b + w for b, w in WIT_LAYOUT.values())
+
+#: Column names, index-aligned with the WIT_* constants — derived from
+#: the layout table (single source of truth for audit.witness_rows).
+WIT_COLUMNS = tuple(sorted(WIT_LAYOUT, key=lambda c: WIT_LAYOUT[c][0]))
 
 
 def witness_node_ids(cfg: SimConfig) -> np.ndarray:
@@ -285,6 +320,7 @@ def witness_node_ids(cfg: SimConfig) -> np.ndarray:
     k, n = cfg.witness_nodes, cfg.n_nodes
     lo = (k + 1) // 2
     hi = k - lo
+    # benorlint: allow-host-sync — static config-only math; constant-folds
     return np.asarray(list(range(lo)) + list(range(n - hi, n)), np.int32)
 
 

@@ -93,6 +93,9 @@ def summarize_final(final: NetState, faulty: jax.Array, max_rounds: int):
     return decided_frac, mean_k, ones_frac, k_hist, disagree_frac
 
 
+# benorlint: allow-donate-argnums — the trajectory tests replay the same
+# state through run_consensus to pin endpoint equality; donation would
+# poison that second use
 @functools.partial(jax.jit, static_argnums=(0, 4))
 def record_trajectory(cfg: SimConfig, state: NetState, faults: FaultSpec,
                       base_key: jax.Array, n_rounds: int):
@@ -142,6 +145,8 @@ def record_trajectory(cfg: SimConfig, state: NetState, faults: FaultSpec,
 
 def random_inputs(seed: int, trials: int, n: int) -> np.ndarray:
     """Per-trial random initial bits — the standard MC input distribution."""
+    # benorlint: allow-host-rng — seeded host-side INPUT generation, built
+    # once per sweep before any trace; protocol draws all use ops/rng.py
     return np.random.default_rng(seed).integers(
         0, 2, size=(trials, n), dtype=np.int8)
 

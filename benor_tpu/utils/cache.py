@@ -45,6 +45,7 @@ def enable_compile_cache(cache_dir: str | None = None) -> None:
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # benorlint: allow-broad-except — a cold cache only costs time
     except Exception as e:  # noqa: BLE001 — strictly best-effort
         print(f"[benor_tpu] compile cache unavailable: {e}",
               file=sys.stderr, flush=True)

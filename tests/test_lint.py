@@ -1,0 +1,494 @@
+"""benorlint (benor_tpu/analysis) — the static analyzer's own tests.
+
+Three layers, mirroring the analyzer's contract:
+
+  * FIXTURE tests: one seeded violation per rule in a synthetic package
+    tree, asserting the rule fires with the right file:line (including
+    an overlapping-column layout and a SimConfig field missing from the
+    sharded regime).
+  * MUTATION tests: copies of the REAL state.py / ops/pallas_round.py /
+    sharded.py with one layout column removed (every recorder column,
+    every witness field) or one config reference dropped — proving the
+    acceptance property that any single hand-edit of the kind PR 2/3
+    made by hand now fails the linter.
+  * SELF-CHECK: the shipped benor_tpu/ tree lints CLEAN (exit 0 via the
+    CLI), with exactly the documented pragma suppressions counted.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import benor_tpu
+from benor_tpu.analysis import Project, run_lint, run_rules
+from benor_tpu.analysis.cli import main as lint_main
+
+PKG_DIR = os.path.dirname(os.path.abspath(benor_tpu.__file__))
+REPO = os.path.dirname(PKG_DIR)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import check_metrics_schema  # noqa: E402
+
+
+def _write_pkg(tmp_path, files: dict) -> str:
+    root = tmp_path / "pkg"
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return str(root)
+
+
+def _findings(root, rules=None):
+    active, suppressed = run_rules(Project(root), names=rules)
+    return active, suppressed
+
+
+def _line_of(src: str, marker: str) -> int:
+    for i, line in enumerate(textwrap.dedent(src).splitlines(), start=1):
+        if marker in line:
+            return i
+    raise AssertionError(f"marker {marker!r} not in fixture")
+
+
+# --------------------------------------------------------------------------
+# fixture tests: one seeded violation per tracer rule, with file:line
+# --------------------------------------------------------------------------
+
+
+HOST_SYNC_SRC = """\
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def round_loop(cfg, state):
+        n = jnp.sum(state).item()      # MARK-item
+        host = np.asarray(state)       # MARK-asarray
+        return n + int(state)          # MARK-int
+"""
+
+
+def test_host_sync_fixture(tmp_path):
+    root = _write_pkg(tmp_path, {"mod.py": HOST_SYNC_SRC})
+    active, _ = _findings(root, rules=["host-sync"])
+    got = {(f.path, f.line) for f in active}
+    assert ("mod.py", _line_of(HOST_SYNC_SRC, "MARK-item")) in got
+    assert ("mod.py", _line_of(HOST_SYNC_SRC, "MARK-asarray")) in got
+    assert ("mod.py", _line_of(HOST_SYNC_SRC, "MARK-int")) in got
+    assert all(f.rule == "host-sync" for f in active)
+
+
+def test_host_sync_only_fires_in_traced_functions(tmp_path):
+    # the SAME .item() in plain harness code is a completion barrier,
+    # not a bug — reachability is what makes the rule usable
+    root = _write_pkg(tmp_path, {"mod.py": """\
+        import numpy as np
+
+        def harness(out):
+            return int(out[0]), np.asarray(out[1]).item()
+    """})
+    active, _ = _findings(root, rules=["host-sync"])
+    assert active == []
+
+
+HOST_RNG_SRC = """\
+    import numpy as np
+
+    def inputs(trials, n):
+        return np.random.default_rng(0).integers(   # MARK-rng
+            0, 2, size=(trials, n))
+"""
+
+
+def test_host_rng_fixture(tmp_path):
+    root = _write_pkg(tmp_path, {"gen.py": HOST_RNG_SRC})
+    active, _ = _findings(root, rules=["host-rng"])
+    assert [(f.path, f.line) for f in active] == \
+        [("gen.py", _line_of(HOST_RNG_SRC, "MARK-rng"))]
+
+
+TRACED_BRANCH_SRC = """\
+    import jax
+    import jax.numpy as jnp
+
+
+    @jax.jit
+    def step(x):
+        if jnp.any(x > 0):             # MARK-if
+            x = x - 1
+        while jnp.sum(x) > 0:          # MARK-while
+            x = x - 1
+        if x.shape[0] > 2:             # static shape branch: fine
+            x = x + 0
+        return x
+"""
+
+
+def test_traced_branch_fixture(tmp_path):
+    root = _write_pkg(tmp_path, {"mod.py": TRACED_BRANCH_SRC})
+    active, _ = _findings(root, rules=["traced-branch"])
+    got = sorted((f.path, f.line) for f in active)
+    assert got == [
+        ("mod.py", _line_of(TRACED_BRANCH_SRC, "MARK-if")),
+        ("mod.py", _line_of(TRACED_BRANCH_SRC, "MARK-while")),
+    ]
+
+
+DTYPE_SRC = """\
+    import jax
+    import jax.numpy as jnp
+
+
+    @jax.jit
+    def widen(x):
+        return x.astype(jnp.int64)     # MARK-64
+"""
+
+
+def test_dtype_drift_fixture(tmp_path):
+    root = _write_pkg(tmp_path, {"mod.py": DTYPE_SRC})
+    active, _ = _findings(root, rules=["dtype-drift"])
+    assert [(f.path, f.line) for f in active] == \
+        [("mod.py", _line_of(DTYPE_SRC, "MARK-64"))]
+
+
+DONATE_SRC = """\
+    import functools
+
+    import jax
+
+
+    @functools.partial(jax.jit, static_argnums=0)   # MARK-jit
+    def run(cfg, state):
+        return state
+
+
+    @functools.partial(jax.jit, static_argnums=0,
+                       donate_argnums=(1,))
+    def run_donated(cfg, state):
+        return state
+"""
+
+
+def test_donate_argnums_fixture(tmp_path):
+    root = _write_pkg(tmp_path, {"mod.py": DONATE_SRC})
+    active, _ = _findings(root, rules=["donate-argnums"])
+    assert [(f.path, f.line) for f in active] == \
+        [("mod.py", _line_of(DONATE_SRC, "MARK-jit"))]
+
+
+RNG_FOLD_SRC = """\
+    import jax
+
+
+    @jax.jit
+    def draws(base_key, trial, node, n):
+        k = jax.random.fold_in(base_key, trial * n + node)   # MARK-flat
+        u = jax.random.uniform(base_key)                     # MARK-raw
+        return k, u
+"""
+
+
+def test_rng_fold_fixture(tmp_path):
+    root = _write_pkg(tmp_path, {"mod.py": RNG_FOLD_SRC})
+    active, _ = _findings(root, rules=["rng-fold"])
+    got = sorted((f.path, f.line) for f in active)
+    assert got == [
+        ("mod.py", _line_of(RNG_FOLD_SRC, "MARK-flat")),
+        ("mod.py", _line_of(RNG_FOLD_SRC, "MARK-raw")),
+    ]
+
+
+BROAD_EXCEPT_SRC = """\
+    def best_effort():
+        try:
+            return 1
+        except Exception:              # MARK-broad
+            return None
+"""
+
+
+def test_broad_except_fixture(tmp_path):
+    root = _write_pkg(tmp_path, {"mod.py": BROAD_EXCEPT_SRC})
+    active, _ = _findings(root, rules=["broad-except"])
+    assert [(f.path, f.line) for f in active] == \
+        [("mod.py", _line_of(BROAD_EXCEPT_SRC, "MARK-broad"))]
+
+
+def test_nested_traced_def_reports_once(tmp_path):
+    # nested defs are walked under their own FuncInfo AND the parent's;
+    # run_rules dedups so one violation is one finding (and one pragma
+    # suppression counts once)
+    root = _write_pkg(tmp_path, {"mod.py": """\
+        import jax
+        import jax.numpy as jnp
+
+
+        @jax.jit
+        def outer(x):
+            def body(y):
+                return jnp.sum(y).item()
+            return body(x)
+    """})
+    active, _ = _findings(root, rules=["host-sync"])
+    assert len(active) == 1
+
+
+def test_syntax_error_is_a_finding_not_a_crash(tmp_path):
+    broken_root = _write_pkg(tmp_path, {"broken.py": "def f(:\n"})
+    active, _ = _findings(broken_root)
+    assert [f.rule for f in active] == ["parse-error"]
+    assert active[0].path == "broken.py"
+
+    class Args:
+        root = broken_root
+        format = "json"
+        out = None
+        metrics_out = None
+
+    assert lint_main(Args()) == 2       # the 0/2 contract holds
+
+
+def test_pragma_suppresses_and_is_counted(tmp_path):
+    root = _write_pkg(tmp_path, {"gen.py": """\
+        import numpy as np
+
+        def inputs(n):
+            # benorlint: allow-host-rng — seeded host-side input generation
+            return np.random.default_rng(0).integers(0, 2, size=n)
+    """})
+    active, suppressed = _findings(root, rules=["host-rng"])
+    assert active == []
+    assert suppressed == {"host-rng": 1}
+
+
+# --------------------------------------------------------------------------
+# layout rules: fixtures + mutations of the REAL tables
+# --------------------------------------------------------------------------
+
+
+def _layout_tree(tmp_path) -> str:
+    """A minimal package tree holding the real layout-bearing files."""
+    root = tmp_path / "pkg"
+    (root / "ops").mkdir(parents=True)
+    for rel in ("state.py", "config.py"):
+        shutil.copy(os.path.join(PKG_DIR, rel), root / rel)
+    shutil.copy(os.path.join(PKG_DIR, "ops", "pallas_round.py"),
+                root / "ops" / "pallas_round.py")
+    return str(root)
+
+
+_LAYOUT_RULES = ["layout-overlap", "layout-parity", "layout-outspec"]
+
+
+def _edit(root, rel, old, new, count=None):
+    p = os.path.join(root, rel)
+    with open(p) as fh:
+        text = fh.read()
+    assert old in text, f"{old!r} not found in {rel}"
+    with open(p, "w") as fh:
+        fh.write(text.replace(old, new) if count is None
+                 else text.replace(old, new, count))
+
+
+def test_layout_rules_clean_on_shipped_tables(tmp_path):
+    root = _layout_tree(tmp_path)
+    active, _ = _findings(root, rules=_LAYOUT_RULES)
+    assert active == []
+
+
+def test_layout_overlap_fixture(tmp_path):
+    # the seeded violation the issue asks for: two recorder partials on
+    # the same kernel column
+    root = _layout_tree(tmp_path)
+    _edit(root, "ops/pallas_round.py",
+          '"killed": (6, 1),', '"killed": (5, 1),')
+    active, _ = _findings(root, rules=["layout-overlap"])
+    assert any(f.rule == "layout-overlap"
+               and f.path == "ops/pallas_round.py"
+               and "overlaps" in f.message for f in active)
+
+
+@pytest.mark.parametrize("column", ["decided", "killed", "undecided_0",
+                                    "undecided_1", "undecided_q",
+                                    "coin_flips", "tally_margin"])
+def test_removing_any_recorder_column_fails(tmp_path, column):
+    # acceptance: removing any single _RP_-era column from
+    # VOTE_RECORD_LAYOUT must fail the linter
+    root = _layout_tree(tmp_path)
+    idx = {"decided": 5, "killed": 6, "undecided_0": 7, "undecided_1": 8,
+           "undecided_q": 9, "coin_flips": 10, "tally_margin": 11}[column]
+    _edit(root, "ops/pallas_round.py",
+          f'    "{column}": ({idx}, 1),\n', "", count=1)
+    active, _ = _findings(root, rules=_LAYOUT_RULES)
+    assert any(f.rule in ("layout-overlap", "layout-parity")
+               for f in active), f"dropping {column} went unnoticed"
+
+
+@pytest.mark.parametrize("field", ["p0", "p1", "x", "decided", "killed",
+                                   "coined", "v0", "v1"])
+def test_removing_any_witness_field_fails(tmp_path, field):
+    # acceptance: dropping a witness column from either kernel field
+    # tuple must fail the linter
+    root = _layout_tree(tmp_path)
+    old = f', "{field}"' if field in ("p1", "v1") else f'"{field}", '
+    _edit(root, "ops/pallas_round.py", old, "", count=1)
+    active, _ = _findings(root, rules=["layout-parity"])
+    assert any(f.rule == "layout-parity" and field in f.message
+               for f in active), \
+        f"dropping witness field {field} went unnoticed"
+
+
+def test_removing_wit_layout_row_fails(tmp_path):
+    root = _layout_tree(tmp_path)
+    _edit(root, "state.py", '    "v0": (6, 1),', "", count=1)
+    active, _ = _findings(root, rules=_LAYOUT_RULES)
+    assert any(f.path == "state.py" for f in active)
+
+
+def test_deleting_a_table_is_itself_a_finding(tmp_path):
+    root = _layout_tree(tmp_path)
+    _edit(root, "ops/pallas_round.py", "VOTE_RECORD_LAYOUT = {",
+          "VOTE_RECORD_LAYOUT_RENAMED = {", count=1)
+    active, _ = _findings(root, rules=["layout-overlap"])
+    assert any("missing" in f.message for f in active)
+
+
+def test_layout_outspec_fixture(tmp_path):
+    root = _layout_tree(tmp_path)
+    _edit(root, "ops/pallas_round.py",
+          "return pl.BlockSpec((1, t, PARTIAL_COLS)",
+          "return pl.BlockSpec((1, t, 128)", count=1)
+    active, _ = _findings(root, rules=["layout-outspec"])
+    assert len(active) == 1
+    assert active[0].path == "ops/pallas_round.py"
+    assert "PARTIAL_COLS" in active[0].hint
+
+
+def test_witness_budget_pinned_to_partial_cols(tmp_path):
+    # config.WITNESS_MAX_NODES is sized so the vote kernel's witness
+    # blocks fit PARTIAL_COLS; growing it past the budget must fail
+    root = _layout_tree(tmp_path)
+    _edit(root, "config.py", "WITNESS_MAX_NODES = 16",
+          "WITNESS_MAX_NODES = 32", count=1)
+    active, _ = _findings(root, rules=["layout-parity"])
+    assert any("PARTIAL_COLS" in f.message for f in active)
+
+
+# --------------------------------------------------------------------------
+# config parity: fixture + mutation of the real sharded regime
+# --------------------------------------------------------------------------
+
+
+def _parity_tree(tmp_path) -> str:
+    root = tmp_path / "pkg"
+    (root / "ops").mkdir(parents=True)
+    (root / "parallel").mkdir()
+    for rel in ("config.py", "sim.py", "sweep.py"):
+        shutil.copy(os.path.join(PKG_DIR, rel), root / rel)
+    for rel in ("ops/pallas_round.py", "parallel/sharded.py",
+                "parallel/multihost.py"):
+        shutil.copy(os.path.join(PKG_DIR, rel), os.path.join(root, rel))
+    return str(root)
+
+
+def test_config_parity_clean_on_shipped_tree(tmp_path):
+    active, _ = _findings(_parity_tree(tmp_path),
+                          rules=["config-parity"])
+    assert active == []
+
+
+def test_config_parity_field_missing_from_sharded(tmp_path):
+    # the issue's seeded violation: a SimConfig field the driver consumes
+    # vanishes from the sharded regime — the next recorder-style feature
+    # silently skipping a mesh
+    root = _parity_tree(tmp_path)
+    _edit(root, "parallel/sharded.py", "cfg.max_rounds", "(1 << 20)")
+    active, _ = _findings(root, rules=["config-parity"])
+    assert len(active) == 1
+    f = active[0]
+    assert f.rule == "config-parity" and f.path == "sim.py"
+    assert "max_rounds" in f.message and "parallel/sharded.py" in f.message
+
+
+def test_config_parity_new_consumed_field_fires_everywhere(tmp_path):
+    # a field sim.py starts consuming without threading it anywhere
+    root = _parity_tree(tmp_path)
+    _edit(root, "sim.py", "if cfg.record or cfg.witness:",
+          "if (cfg.record or cfg.witness) and not cfg.poll_rounds:",
+          count=1)
+    active, _ = _findings(root, rules=["config-parity"])
+    hits = [f for f in active if "poll_rounds" in f.message]
+    assert len(hits) == 4      # one per regime file, none allowlisted
+
+
+# --------------------------------------------------------------------------
+# self-check: the shipped tree is lint-clean, suppressions accounted
+# --------------------------------------------------------------------------
+
+
+def test_shipped_tree_lints_clean():
+    rep = run_lint()
+    assert rep.findings == [], rep.to_text()
+    # the documented intentional exceptions, and nothing else
+    assert rep.suppressed == {"host-sync": 1, "host-rng": 1,
+                              "donate-argnums": 3, "broad-except": 2}
+    assert rep.files >= 40
+
+
+def test_report_schema_and_cli_exit_codes(tmp_path):
+    class Args:
+        root = None
+        format = "json"
+        out = str(tmp_path / "report.json")
+        metrics_out = None
+
+    assert lint_main(Args()) == 0
+    with open(Args.out) as fh:
+        doc = json.load(fh)
+    assert check_metrics_schema.check_lint_report(doc) == []
+    assert doc["ok"] is True and doc["suppressed_total"] == 7
+
+    # a dirty tree exits 2 through the same entry point
+    dirty = _write_pkg(tmp_path, {"gen.py": HOST_RNG_SRC})
+
+    class Dirty(Args):
+        root = dirty
+        out = str(tmp_path / "dirty.json")
+
+    assert lint_main(Dirty()) == 2
+    with open(Dirty.out) as fh:
+        doc = json.load(fh)
+    assert check_metrics_schema.check_lint_report(doc) == []
+    assert doc["ok"] is False and doc["counts"] == {"host-rng": 1}
+
+
+def test_cli_subprocess_exit_0():
+    # the acceptance command, end to end: `python -m benor_tpu lint`
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "benor_tpu", "lint", "--format", "json"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["ok"] is True
+    assert check_metrics_schema.check_lint_report(doc) == []
+
+
+def test_lint_feeds_metrics_registry():
+    from benor_tpu.utils.metrics import REGISTRY
+    before = REGISTRY.counter("analysis.runs").value
+    rep = run_lint()
+    assert REGISTRY.counter("analysis.runs").value == before + 1
+    assert REGISTRY.counter("analysis.files").value >= rep.files
+    assert REGISTRY.counter("analysis.suppressed").value >= 7

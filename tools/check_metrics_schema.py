@@ -24,7 +24,10 @@ Run as a script over a committed capture (exit 0 = pass):
 
 or import from tests (tests/test_metrics_schema.py keeps this in tier-1,
 so a key that would re-trigger the truncation fails the suite before it
-ever reaches a driver run).
+ever reaches a driver run).  The script auto-detects the document kind:
+bench detail record, witness bundle (audit.save_bundle), or benorlint
+JSON report (``python -m benor_tpu lint --format json`` — validated by
+``check_lint_report`` against the inline ``LINT_REPORT_SCHEMA``).
 """
 
 from __future__ import annotations
@@ -89,6 +92,65 @@ def check_schema(detail: dict, schema_path: str = SCHEMA_PATH) -> List[str]:
         schema = json.load(fh)
     errors: List[str] = []
     _validate(detail, schema, "$", errors)
+    return errors
+
+
+#: Schema for `python -m benor_tpu lint --format json` documents
+#: (benor_tpu/analysis/cli.LintReport.to_dict).  Inline rather than a
+#: sidecar file: the report is small and the schema doubles as its
+#: documentation.  Pinned in tier-1 by tests/test_lint.py so a key
+#: rename breaks the suite before it breaks a CI consumer.
+LINT_REPORT_SCHEMA = {
+    "type": "object",
+    "required": ["version", "root", "ok", "files", "rules_run",
+                 "findings", "counts", "suppressed", "suppressed_total",
+                 "elapsed_s"],
+    "properties": {
+        "version": {"type": "integer"},
+        "root": {"type": "string"},
+        "ok": {"type": "boolean"},
+        "files": {"type": "integer"},
+        "rules_run": {"type": "array", "items": {"type": "string"}},
+        "findings": {
+            "type": "array",
+            "items": {
+                "type": "object",
+                "required": ["rule", "path", "line", "col", "message"],
+                "properties": {
+                    "rule": {"type": "string"},
+                    "path": {"type": "string"},
+                    "line": {"type": "integer"},
+                    "col": {"type": "integer"},
+                    "message": {"type": "string"},
+                    "hint": {"type": "string"},
+                },
+            },
+        },
+        "counts": {"type": "object"},
+        "suppressed": {"type": "object"},
+        "suppressed_total": {"type": "integer"},
+        "elapsed_s": {"type": "number"},
+    },
+}
+
+
+def check_lint_report(report: dict) -> List[str]:
+    """Validate a benorlint JSON report against LINT_REPORT_SCHEMA plus
+    the cross-field facts CI consumers rely on: per-rule counts must sum
+    to the findings list and ``ok`` must mean zero findings."""
+    errors: List[str] = []
+    _validate(report, LINT_REPORT_SCHEMA, "$", errors)
+    if errors:
+        return errors
+    n = len(report["findings"])
+    if report["ok"] != (n == 0):
+        errors.append(f"$.ok: {report['ok']} but {n} findings")
+    if sum(report["counts"].values()) != n:
+        errors.append(f"$.counts: sums to "
+                      f"{sum(report['counts'].values())}, "
+                      f"findings list has {n}")
+    if sum(report["suppressed"].values()) != report["suppressed_total"]:
+        errors.append("$.suppressed: does not sum to suppressed_total")
     return errors
 
 
@@ -164,6 +226,14 @@ def main(argv=None) -> int:
         for e in errors:
             print(f"FAIL {e}", file=sys.stderr)
         print(f"{os.path.basename(path)}: witness bundle "
+              f"{'OK' if not errors else 'INVALID'}")
+        return 1 if errors else 0
+    if "rules_run" in detail and "findings" in detail:
+        # a `benor_tpu lint --format json` report
+        errors = check_lint_report(detail)
+        for e in errors:
+            print(f"FAIL {e}", file=sys.stderr)
+        print(f"{os.path.basename(path)}: lint report "
               f"{'OK' if not errors else 'INVALID'}")
         return 1 if errors else 0
     errors = check_schema(detail) + check_headline(detail)
