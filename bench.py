@@ -76,7 +76,8 @@ def emit(obj) -> None:
 _DETAIL_KEYS = ("curve", "pallas_check", "pallas_hist_check",
                 "pallas_equiv_check", "pallas_weak_coin_check",
                 "pallas_round_check", "pallas_demoted",
-                "batched_sweep_check", "flight_recorder", "lint")
+                "batched_sweep_check", "flight_recorder", "perfscope",
+                "lint")
 
 
 def _split_headline(out: dict) -> tuple[dict, dict]:
@@ -113,6 +114,12 @@ def _split_headline(out: dict) -> tuple[dict, dict]:
         # upheld every Ben-Or invariant (benor_tpu/audit.py).
         head["recorder_ok"] = bool(fr.get("bit_equal_record_off_on"))
         head["audit_ok"] = bool(fr.get("audit_ok"))
+    ps = out.get("perfscope")
+    if isinstance(ps, dict):
+        # ONE compact bool: manifest complete + non-zero cost model +
+        # in-band vs the committed baseline (when comparable); the full
+        # per-regime PerfReports live in the sidecar's perfscope blob
+        head["perf_ok"] = bool(ps.get("ok"))
     head["detail_file"] = "BENCH_DETAIL.json"
     return head, detail
 
@@ -167,20 +174,14 @@ def _enable_compile_cache() -> None:
         log(f"bench: compile cache unavailable: {e}")
 
 
-#: Published HBM peak bandwidth per chip, bytes/s, keyed by substrings of
-#: jax Device.device_kind (lowercased).  Used only for the roofline estimate.
-_HBM_PEAK = [
-    ("v6", 1640e9), ("v5p", 2765e9), ("v5 lite", 819e9), ("v5e", 819e9),
-    ("v5", 2765e9), ("v4", 1228e9), ("v3", 900e9), ("v2", 700e9),
-]
-
-
 def _hbm_peak_for(device_kind: str):
-    kind = device_kind.lower()
-    for sub, bw in _HBM_PEAK:
-        if sub in kind:
-            return bw
-    return None
+    """Peak HBM bandwidth for the roofline estimate.  The table itself
+    moved to benor_tpu/perfscope/roofline.py (with a FLOPs twin) so the
+    per-regime PerfReports and this end-to-end estimate read the same
+    published numbers; lazy import because platform forcing must precede
+    any jax-importing module."""
+    from benor_tpu.perfscope.roofline import hbm_peak_for
+    return hbm_peak_for(device_kind)
 
 
 def _regimes(n, trials, fracs, max_rounds, seed, use_pallas_hist=False):
@@ -902,18 +903,16 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
 
     # Per-regime bytes-accessed from XLA's post-optimization cost model
     # (free: the executable cache is warm).  The estimate counts the
-    # while-loop body once, so bytes/round ~ 'bytes accessed'.
+    # while-loop body once, so bytes/round ~ 'bytes accessed'.  cost_of
+    # (benor_tpu/perfscope/instrument.py) owns the failure handling the
+    # old inline block did by hand: a backend without a cost model yields
+    # {} and ticks perfscope.cost_failures instead of killing the run.
+    from benor_tpu.perfscope import cost_of
     bytes_per_round = {}
     for name, cfg, state, faults in regimes:
-        try:
-            ca = run_consensus.lower(
-                cfg, state, faults, base_key).compile().cost_analysis()
-            if isinstance(ca, list):
-                ca = ca[0]
-            bytes_per_round[name] = float(ca.get("bytes accessed", 0.0))
-        except Exception as e:  # noqa: BLE001 — accounting must not kill the run
-            log(f"bench: cost_analysis unavailable for {name}: {e}")
-            bytes_per_round[name] = 0.0
+        ca = cost_of(run_consensus, cfg, state, faults, base_key,
+                     label=f"bench.{name}")
+        bytes_per_round[name] = float(ca.get("bytes accessed", 0.0))
 
     # Timed sweep: the whole regime set end-to-end, repeated BENCH_REPS
     # times.  NOTE: block_until_ready does not actually wait under the axon
@@ -1038,6 +1037,14 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
     except Exception as e:  # noqa: BLE001
         recorder_check = {"error": f"{type(e).__name__}: {e}"}
     log(f"bench: flight recorder check {recorder_check}")
+    try:
+        perfscope_check = _perfscope_check()
+    except Exception as e:  # noqa: BLE001 — accounting must not kill the run
+        perfscope_check = {"ok": False,
+                           "error": f"{type(e).__name__}: {e}"}
+    log(f"bench: perfscope check ok={perfscope_check.get('ok')} "
+        f"regressions={len(perfscope_check.get('regressions', []))} "
+        f"baseline_comparable={perfscope_check.get('baseline_comparable')}")
 
     total_trials = trials * len(regimes)
     log(f"bench: sweep elapsed {elapsed:.2f}s for {total_trials} trials; "
@@ -1090,6 +1097,7 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
         "pallas_round_check": pallas_round,
         "batched_sweep_check": batched_check,
         "flight_recorder": recorder_check,
+        "perfscope": perfscope_check,
         "pallas_demoted": demoted,
     }
 
@@ -1167,6 +1175,56 @@ def _labels(mode: str, platform: str) -> tuple[str, str]:
     metric = ("mc_trials_per_sec_n1e6" if n == 1_000_000
               else f"mc_trials_per_sec_n{n}")
     return metric, "trials/s"
+
+
+def _perfscope_check() -> dict:
+    """The AOT cost/memory observatory over all five compiled regimes
+    (benor_tpu/perfscope): per-stage pipeline timings, the XLA cost model
+    (FLOPs / bytes accessed) and memory footprint (argument/output/temp/
+    peak bytes) per regime, reduced to a manifest and compared against
+    the committed PERF_BASELINE.json tolerance bands.  ``perf_ok`` is the
+    headline bool: the manifest is complete (five regimes, non-zero cost
+    model) and in-band vs the baseline when the baseline is comparable
+    (an accelerator capture vs the committed CPU baseline is honestly
+    reported as incomparable, not silently passed through the bands).
+
+    The capture runs at the FIXED smoke scale the committed baseline was
+    taken at — one small extra AOT compile per regime, out-of-band of the
+    science sweep's executables — so the structural numbers band-compare
+    across rounds regardless of BENCH_N."""
+    from benor_tpu.perfscope import (IncomparableManifests, build_manifest,
+                                     capture_all, compare_manifests,
+                                     load_manifest, missing_regimes)
+
+    scale = {"n_nodes": 256, "trials": 8, "max_rounds": 12, "seed": 0}
+    reports = capture_all(**scale)
+    manifest = build_manifest(reports, scale)
+    missing = missing_regimes(manifest)
+    nonzero = all(rep["flops"] > 0 and rep["bytes_accessed"] > 0
+                  and rep["peak_bytes"] > 0
+                  for rep in manifest["regimes"].values())
+    blob = {
+        "manifest": manifest,
+        "missing_regimes": missing,
+        "nonzero_cost_model": nonzero,
+    }
+    regressions = []
+    comparable = None
+    baseline_path = os.path.join(HERE, "PERF_BASELINE.json")
+    if os.path.exists(baseline_path):
+        try:
+            regressions = compare_manifests(manifest,
+                                            load_manifest(baseline_path))
+            comparable = True
+        except (IncomparableManifests, ValueError) as e:
+            comparable = False
+            blob["baseline_note"] = f"{e}"
+    else:
+        blob["baseline_note"] = "no committed PERF_BASELINE.json"
+    blob["baseline_comparable"] = comparable
+    blob["regressions"] = [r.to_dict() for r in regressions]
+    blob["ok"] = not missing and nonzero and not regressions
+    return blob
 
 
 def _lint_check() -> dict:

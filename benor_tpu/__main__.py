@@ -389,6 +389,125 @@ def _lint(args) -> int:
     return lint_main(args)
 
 
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _profile(args) -> int:
+    """AOT cost/memory observatory (benor_tpu/perfscope): stage-timed
+    capture of the five compiled regimes — trace/lower, backend compile,
+    first execute, steady-state execute, plus the XLA cost model and
+    memory footprint per executable, placed on the device roofline.
+    Emits the pinned-schema manifest (--profile-out / --format json),
+    optionally wraps the capture in a jax.profiler Perfetto trace
+    (--trace-dir, with the metrics registry's counter tracks exported
+    next to it), and gates against a committed baseline: exit 2 on an
+    out-of-band structural metric, 0 otherwise."""
+    from .perfscope import (IncomparableManifests, build_manifest,
+                            capture_all, compare_manifests, load_manifest,
+                            missing_regimes, save_manifest)
+    from .perfscope.regimes import REGIME_NAMES, default_profile_scale
+
+    scale = default_profile_scale()
+    for k, v in (("n_nodes", args.n), ("trials", args.trials),
+                 ("max_rounds", args.max_rounds)):
+        if v is not None:
+            scale[k] = v
+    scale["seed"] = args.seed
+    regimes = args.regimes.split(",") if args.regimes else None
+    if regimes:
+        unknown = sorted(set(regimes) - set(REGIME_NAMES))
+        if unknown:
+            print(f"unknown regimes {unknown}; choose from "
+                  f"{list(REGIME_NAMES)}", file=sys.stderr)
+            return 1
+
+    import contextlib
+    trace_cm = contextlib.nullcontext()
+    if args.trace_dir:
+        from .utils.tracing import profile_trace
+        trace_cm = profile_trace(args.trace_dir)
+    with trace_cm as trace_path:
+        reports = capture_all(regimes=regimes,
+                              steady_reps=args.steady_reps, **scale)
+    manifest = build_manifest(reports, scale)
+    if args.trace_dir:
+        # the XLA trace and the registry's counter tracks side by side:
+        # load both files into ui.perfetto.dev for one merged timeline
+        from .utils import metrics
+        counters = os.path.join(args.trace_dir,
+                                "perfscope_counters.trace.json")
+        n_ev = metrics.export_chrome_trace(counters)
+        print(f"jax.profiler trace in {trace_path} "
+              f"(+{n_ev} counter events in {counters})", file=sys.stderr)
+
+    fb = " [cpu fallback]" if FELL_BACK else ""
+    if args.format == "json":
+        print(json.dumps(manifest, indent=1))
+    else:
+        print(f"perfscope: {manifest['platform']} "
+              f"({manifest['device_kind']}), scale "
+              f"N={scale['n_nodes']} T={scale['trials']} "
+              f"R<={scale['max_rounds']} seed={scale['seed']}{fb}")
+        for r in reports:
+            roof = (f"AI={r.arithmetic_intensity} flop/B"
+                    if r.arithmetic_intensity is not None else "AI=n/a")
+            if r.bound is not None:
+                roof += (f", {r.achieved_gbps} GB/s of "
+                         f"{r.hbm_peak_gbps} GB/s peak "
+                         f"(util {r.hbm_util}) -> {r.bound}-bound")
+            print(f"  {r.regime}: lower {r.trace_lower_s * 1e3:.0f}ms "
+                  f"compile {r.compile_s * 1e3:.0f}ms "
+                  f"first {r.first_execute_s * 1e3:.0f}ms "
+                  f"steady {r.steady_execute_s * 1e3:.1f}ms | "
+                  f"rounds={r.rounds_executed} "
+                  f"flops={r.flops:.3g} bytes={r.bytes_accessed:.3g} "
+                  f"peakHBM={r.peak_bytes:,}B | {roof}")
+    if args.profile_out:
+        save_manifest(args.profile_out, manifest)
+        print(f"wrote perf manifest to {args.profile_out}",
+              file=sys.stderr)
+    _export_metrics(args.metrics_out)
+
+    baseline_path = args.baseline or os.path.join(_repo_root(),
+                                                  "PERF_BASELINE.json")
+    missing = missing_regimes(manifest)
+    if args.update_baseline:
+        if missing:
+            # a partial baseline would make every later gate pass
+            # vacuously: compare_manifests only walks baseline regimes
+            print(f"refusing to write a partial baseline (missing "
+                  f"{missing}) — a baseline must cover all of "
+                  f"{list(REGIME_NAMES)}", file=sys.stderr)
+            return 1
+        save_manifest(baseline_path, manifest)
+        print(f"re-baselined {baseline_path}", file=sys.stderr)
+        return 0
+    if not os.path.exists(baseline_path):
+        print(f"no baseline at {baseline_path} — capture-only run "
+              f"(--update-baseline to create one)", file=sys.stderr)
+        return 0
+    if regimes and missing:
+        print(f"partial capture ({sorted(set(regimes))}) — baseline gate "
+              f"skipped (a full manifest covers {list(REGIME_NAMES)})",
+              file=sys.stderr)
+        return 0
+    try:
+        regressions = compare_manifests(manifest,
+                                        load_manifest(baseline_path),
+                                        timing_band=args.timing_band)
+    except (IncomparableManifests, ValueError) as e:
+        print(f"baseline {baseline_path} not comparable: {e}",
+              file=sys.stderr)
+        return 0
+    for reg in regressions:
+        print(f"REGRESSION: {reg.message}", file=sys.stderr)
+    if regressions:
+        return 2
+    print(f"perf gate: in-band vs {baseline_path}", file=sys.stderr)
+    return 0
+
+
 def _preset(args) -> int:
     from .sweep import baseline_configs, run_point
     cfgs = baseline_configs()
@@ -539,6 +658,47 @@ def main(argv=None) -> int:
                     help="write the report to this file instead of stdout")
     _add_obs_args(li, record=False)
 
+    pf = sub.add_parser("profile",
+                        help="AOT cost/memory observatory: stage-timed "
+                             "capture of the five compiled regimes + "
+                             "roofline placement + baseline perf gate "
+                             "(benor_tpu/perfscope); exit 2 on "
+                             "regression")
+    pf.add_argument("--n", type=int, default=None,
+                    help="nodes (default: the profile scale — 256 on "
+                         "CPU, the bench scale on accelerators)")
+    pf.add_argument("--trials", type=int, default=None)
+    pf.add_argument("--max-rounds", type=int, default=None)
+    pf.add_argument("--seed", type=int, default=0)
+    pf.add_argument("--regimes", default=None,
+                    help="comma-separated subset of "
+                         "traced,fused_pallas,sliced,batched_sweep,"
+                         "sharded (default: all five; a subset skips "
+                         "the baseline gate)")
+    pf.add_argument("--steady-reps", type=int, default=2,
+                    help="post-warm-up executions averaged into the "
+                         "steady-state timing (default 2)")
+    pf.add_argument("--format", choices=("text", "json"), default="text",
+                    help="stdout format; json = the pinned-schema "
+                         "manifest (tools/perf_report_schema.json)")
+    pf.add_argument("--profile-out", metavar="PATH",
+                    help="write the manifest to this JSON file")
+    pf.add_argument("--baseline", metavar="PATH", default=None,
+                    help="baseline manifest to gate against (default: "
+                         "the committed PERF_BASELINE.json)")
+    pf.add_argument("--update-baseline", action="store_true",
+                    help="write this capture as the new baseline "
+                         "instead of gating against it")
+    pf.add_argument("--timing-band", type=float, default=None,
+                    help="also gate the machine-sensitive stage timings "
+                         "at this ratio band (off by default; see "
+                         "perfscope/baseline.py)")
+    pf.add_argument("--trace-dir", metavar="DIR", default=None,
+                    help="wrap the capture in a jax.profiler trace "
+                         "(TensorBoard/Perfetto) and export the metrics "
+                         "registry's counter tracks next to it")
+    _add_obs_args(pf, record=False)
+
     r = sub.add_parser("results",
                        help="generate RESULTS/ (curves + presets artifact)")
     r.add_argument("--out", default="RESULTS")
@@ -555,7 +715,7 @@ def main(argv=None) -> int:
     # bare `python -m benor_tpu [-n N -f F ...]` == the start.ts demo
     if not argv or argv[0] not in ("demo", "sweep", "coins", "preset",
                                    "results", "trace", "audit", "lint",
-                                   "-h", "--help"):
+                                   "profile", "-h", "--help"):
         argv = ["demo"] + argv
     args = ap.parse_args(argv)
     _honor_platform_env()
@@ -573,7 +733,8 @@ def main(argv=None) -> int:
         _ensure_live_backend()
     return {"demo": _demo, "sweep": _sweep, "coins": _coins,
             "preset": _preset, "results": _results,
-            "trace": _trace, "audit": _audit, "lint": _lint}[args.cmd](args)
+            "trace": _trace, "audit": _audit, "lint": _lint,
+            "profile": _profile}[args.cmd](args)
 
 
 if __name__ == "__main__":

@@ -38,6 +38,15 @@ dense, ``layout-parity`` proves the tables agree across files and fit
 PARTIAL_COLS at WITNESS_MAX_NODES, ``layout-outspec`` forbids bare
 physical-width literals in out_spec shapes.
 
+**Perf observability** (rules_perf.py) — the ISSUE-5 blind spot.
+perfscope made every compiled executable's AOT pipeline and cost model
+observable, but only through its funnel (perfscope/instrument.py);
+``perf-unregistered-jit`` flags raw ``jax.jit`` / bare
+``.lower().compile()`` call sites that would re-open the pre-perfscope
+hole (a regime the perf gate cannot see regress), with the pure-literal
+``JIT_REGISTRY`` roster as the reviewed exception list — cross-checked
+for staleness by the same rule.
+
 **Five-regime config parity** (rules_config.py) — the threading burden
 every observability PR paid: a SimConfig field consumed in sim.py had
 to be hand-carried through the sweep, fused-round, sharded and

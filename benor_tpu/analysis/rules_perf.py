@@ -1,0 +1,199 @@
+"""Perf-observability rule: every compiled executable must be observable.
+
+ISSUE 5's perfscope subsystem made the AOT pipeline a first-class
+observable — but only for executables built through its funnel
+(``perfscope/instrument.py``: ``instrumented_jit`` registers the jitted
+callable for cost-model introspection, ``aot_compile`` stage-times the
+``lower()``/``compile()`` round trip into ``metrics.REGISTRY``).  A raw
+``jax.jit`` (or a bare ``jit(...).lower(...).compile()`` chain) added
+anywhere else silently re-opens the pre-perfscope blind spot: a compiled
+regime whose FLOPs / bytes / peak-HBM never reach a manifest, and whose
+regressions the gate cannot see.
+
+``perf-unregistered-jit`` makes that a lint failure.  Two escape
+hatches, both visible:
+
+  * ``JIT_REGISTRY`` in perfscope/instrument.py — the pure-literal
+    roster of module-level entry points that keep a raw
+    ``functools.partial(jax.jit, ...)`` decorator (their donation
+    pragmas and tracing seeds hang off that exact spelling).  This rule
+    re-parses the tuple (never imports it) and also cross-checks that
+    every entry still resolves to a real function, so the roster cannot
+    go stale and silently allow-list nothing.
+  * the standard ``# benorlint: allow-perf-unregistered-jit`` pragma —
+    the sanctioned spelling for throwaway jits in test/fixture trees.
+
+perfscope/instrument.py itself is exempt (it IS the funnel: the one
+place ``jax.jit`` and ``.lower().compile()`` are supposed to appear).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set, Tuple
+
+from .core import Finding, Project, Source, assign_line, dotted_name, rule
+from .visitors import _canonical
+
+#: The funnel module, relative to the lint root (benor_tpu/).
+_INSTRUMENT_REL = "perfscope/instrument.py"
+
+#: The roster literal the rule re-parses out of the funnel module.
+_REGISTRY_NAME = "JIT_REGISTRY"
+
+_HINT = ("route it through perfscope.instrument (instrumented_jit for "
+         "entry points, aot_compile for lower/compile chains), add the "
+         "entry point to JIT_REGISTRY with its justification, or pragma "
+         "throwaway test-tree jits")
+
+
+def _module_key(rel: str) -> str:
+    """`ops/pallas_hist.py` -> `ops.pallas_hist` (the JIT_REGISTRY key
+    space: module path relative to the package root, no package name)."""
+    parts = rel[:-3].split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _load_registry(project: Project) -> Tuple[Optional[Source], tuple]:
+    """(instrument Source, parsed roster) — (None, ()) when the project
+    has no funnel module (fixture trees): every raw jit is then
+    unregistered by definition."""
+    src = project.source(_INSTRUMENT_REL)
+    if src is None:
+        return None, ()
+    from .core import literal_assign
+    roster = literal_assign(src, _REGISTRY_NAME)
+    if not isinstance(roster, tuple):
+        return src, ()
+    return src, roster
+
+
+def _canon_last(project: Project, rel: str, node: ast.AST) -> str:
+    """Alias-canonical last component of a dotted ref ('' when the node
+    is not a resolvable Name/Attribute chain)."""
+    name = dotted_name(node)
+    if not name:
+        return ""
+    idx = project.index
+    return _canonical(idx.module_of[rel], idx, name).split(".")[-1]
+
+
+def _jit_decorator(project: Project, rel: str,
+                   dec: ast.AST) -> Optional[ast.AST]:
+    """The raw-``jax.jit`` node of a decorator expression, or None.
+
+    Matches the three shipped spellings — ``@jax.jit``,
+    ``@jax.jit(...)``, and ``@functools.partial(jax.jit, ...)`` — and
+    deliberately NOT ``instrumented_jit`` (that is the fix)."""
+    ref = dec.func if isinstance(dec, ast.Call) else dec
+    if _canon_last(project, rel, ref) == "jit":
+        return dec
+    if isinstance(dec, ast.Call) and \
+            _canon_last(project, rel, dec.func) == "partial" and dec.args \
+            and _canon_last(project, rel, dec.args[0]) == "jit":
+        return dec
+    return None
+
+
+def _lower_compile_chain(node: ast.Call) -> bool:
+    """``<expr>.lower(...).compile(...)`` — the bare AOT spelling.
+    (Requiring the full chain keeps ``str.lower()`` and
+    ``Lowered.compile`` on a named temporary out of scope; the repo's
+    sanctioned chain lives in aot_compile.)"""
+    return (isinstance(node.func, ast.Attribute)
+            and node.func.attr == "compile"
+            and isinstance(node.func.value, ast.Call)
+            and isinstance(node.func.value.func, ast.Attribute)
+            and node.func.value.func.attr == "lower")
+
+
+@rule("perf-unregistered-jit", "perf",
+      "compiled executable invisible to perfscope (raw jax.jit / "
+      "lower().compile() off the instrumented funnel)")
+def check_unregistered_jit(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    inst_src, roster = _load_registry(project)
+
+    # the roster itself must not go stale: an entry whose module is in
+    # the tree but whose function is gone allow-lists nothing and hides
+    # that it allow-lists nothing
+    if inst_src is not None:
+        line = assign_line(inst_src, _REGISTRY_NAME)
+        for entry in roster:
+            mod, _, fn = str(entry).rpartition(".")
+            rel = mod.replace(".", "/") + ".py"
+            src = project.source(rel)
+            if src is None:
+                # a roster row for a module that is not in the tree is
+                # just as stale as one for a vanished function — a
+                # renamed/deleted module must not rot silently
+                findings.append(Finding(
+                    "perf-unregistered-jit", _INSTRUMENT_REL, line, 0,
+                    f"JIT_REGISTRY entry {entry!r} names module {rel} "
+                    f"which is not in the tree — a stale roster row "
+                    f"allow-lists nothing",
+                    hint="update or drop the entry (the roster is the "
+                         "reviewed exception list; it must stay real)"))
+                continue
+            if not any(isinstance(n, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))
+                       and n.name == fn for n in ast.walk(src.tree)):
+                findings.append(Finding(
+                    "perf-unregistered-jit", _INSTRUMENT_REL, line, 0,
+                    f"JIT_REGISTRY entry {entry!r} does not resolve to a "
+                    f"function in {rel} — a stale roster row allow-lists "
+                    f"nothing",
+                    hint="update or drop the entry (the roster is the "
+                         "reviewed exception list; it must stay real)"))
+
+    for rel, src in project.sources.items():
+        if rel == _INSTRUMENT_REL:
+            continue
+        mod_key = _module_key(rel)
+        in_decorator: Set[int] = set()
+
+        # decorator jits: allowed only through the JIT_REGISTRY roster
+        for node in ast.walk(src.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            for dec in node.decorator_list:
+                in_decorator.update(id(sub) for sub in ast.walk(dec))
+                jit_node = _jit_decorator(project, rel, dec)
+                if jit_node is None:
+                    continue
+                entry = f"{mod_key}.{node.name}"
+                if entry in roster:
+                    continue
+                findings.append(Finding(
+                    "perf-unregistered-jit", rel, dec.lineno,
+                    dec.col_offset,
+                    f"raw jax.jit on {node.name!r} is invisible to "
+                    f"perfscope ({entry!r} is not in "
+                    f"perfscope/instrument.py JIT_REGISTRY): its cost "
+                    f"model and compile time reach no manifest, so the "
+                    f"perf gate cannot see it regress",
+                    hint=_HINT))
+
+        # call-site jits + bare lower().compile() chains
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call) or id(node) in in_decorator:
+                continue
+            if _canon_last(project, rel, node.func) == "jit":
+                findings.append(Finding(
+                    "perf-unregistered-jit", rel, node.lineno,
+                    node.col_offset,
+                    "raw jax.jit(...) call site builds an executable "
+                    "perfscope cannot introspect",
+                    hint=_HINT))
+            elif _lower_compile_chain(node):
+                findings.append(Finding(
+                    "perf-unregistered-jit", rel, node.lineno,
+                    node.col_offset,
+                    "bare .lower(...).compile() chain: the AOT round "
+                    "trip is untimed and its cost model unread "
+                    "(pre-perfscope bench.py's exact blind spot)",
+                    hint=_HINT))
+    return findings

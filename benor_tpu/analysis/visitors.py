@@ -37,7 +37,11 @@ from .core import Project, dotted_name
 
 #: Bare callables that open a trace (matched by name alone — the repo
 #: imports shard_map under this name, and jit/vmap read unambiguously).
-_BARE_BOUNDARIES = {"jit", "pallas_call", "shard_map", "vmap", "pmap"}
+#: perfscope's instrumented spellings are jit-equivalent boundaries: a
+#: function handed to instrumented_jit / aot_compile executes under a
+#: trace exactly like a jax.jit-decorated one.
+_BARE_BOUNDARIES = {"jit", "pallas_call", "shard_map", "vmap", "pmap",
+                    "instrumented_jit", "aot_compile"}
 
 #: lax control-flow combinators: matched as ``lax.<name>`` /
 #: ``jax.lax.<name>`` (never by bare name — loop bodies are commonly

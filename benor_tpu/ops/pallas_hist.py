@@ -52,6 +52,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from ..perfscope.instrument import instrumented_jit
 
 #: Lane-tile width per grid step (multiple of the 128-lane VPU width).
 TILE_N = 512
@@ -239,8 +240,7 @@ _COIN_SALT = 255
 _EQUIV_SALT_OFFSET = 64
 
 
-@functools.partial(jax.jit, static_argnames=("trials", "n_nodes",
-                                             "interpret"))
+@instrumented_jit(static_argnames=("trials", "n_nodes", "interpret"))
 def coin_flips_pallas(base_key: jax.Array, r: jax.Array, trials: int,
                       n_nodes: int, interpret: bool = False,
                       node_offset: jax.Array | int = 0,
@@ -328,8 +328,8 @@ def _weak_coin_kernel(eps, scal_ref, shared_ref, out_ref):
     out_ref[...] = jnp.where(dev, private, shared_ref[...])
 
 
-@functools.partial(jax.jit, static_argnames=("trials", "n_nodes", "eps",
-                                             "interpret"))
+@instrumented_jit(static_argnames=("trials", "n_nodes", "eps",
+                                  "interpret"))
 def weak_coin_flips_pallas(base_key: jax.Array, r: jax.Array, trials: int,
                            n_nodes: int, eps: float,
                            shared: jax.Array, interpret: bool = False,
@@ -361,8 +361,7 @@ def weak_coin_flips_pallas(base_key: jax.Array, r: jax.Array, trials: int,
     return out[:, :n_nodes].astype(jnp.int8)
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("m", "n_nodes", "interpret"))
+@instrumented_jit(static_argnames=("m", "n_nodes", "interpret"))
 def equiv_counts_pallas(base_key: jax.Array, r: jax.Array, phase: int,
                         hist: jax.Array, n_equiv: jax.Array, m: int,
                         n_nodes: int, interpret: bool = False,
@@ -409,8 +408,7 @@ def equiv_counts_pallas(base_key: jax.Array, r: jax.Array, phase: int,
     return counts[:, :n_nodes, :]
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("m", "n_nodes", "interpret"))
+@instrumented_jit(static_argnames=("m", "n_nodes", "interpret"))
 def cf_counts_pallas(base_key: jax.Array, r: jax.Array, phase: int,
                      hist: jax.Array, m: int, n_nodes: int,
                      interpret: bool = False,

@@ -59,6 +59,7 @@ from .pallas_hist import (_COIN_SALT, _EQUIV_SALT_OFFSET, TILE_N,
                           _ndtri_as241, _stream_scal, _threefry2x32)
 from ..config import VAL0, VAL1, VALQ
 from ..state import NetState
+from ..perfscope.instrument import instrumented_jit
 
 _DEC, _KILL, _FAULT, _KSHIFT = 2, 3, 4, 5
 
@@ -553,7 +554,7 @@ def _count_vecs(hist, counts_mode):
     return [f[:, c, i:i + 1] for c in range(3) for i in range(2)]
 
 
-@functools.partial(jax.jit, static_argnames=(
+@instrumented_jit(static_argnames=(
     "m", "fault_model", "freeze", "interpret", "counts_mode", "camp_b0",
     "camp_b1", "witness_ids", "n_local"))
 def proposal_hist_pallas(base_key, r, phase, hist, pack, crash_round,
@@ -614,7 +615,7 @@ def proposal_hist_pallas(base_key, r, phase, hist, pack, crash_round,
     return jnp.sum(parts, axis=0)
 
 
-@functools.partial(jax.jit, static_argnames=(
+@instrumented_jit(static_argnames=(
     "m", "n_faulty", "rule", "coin_mode", "eps", "freeze", "fault_model",
     "interpret", "counts_mode", "camp_b0", "camp_b1", "record",
     "witness_ids", "n_local"))

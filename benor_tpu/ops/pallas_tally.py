@@ -30,6 +30,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..config import VAL0, VAL1, VALQ
+from ..perfscope.instrument import instrumented_jit
 
 #: Receiver-tile height; 128 matches the MXU systolic dimension.
 TILE_R = 128
@@ -71,7 +72,7 @@ def _tally_kernel(mask_ref, sent_ref, alive_ref, out_ref):
                          preferred_element_type=jnp.float32)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@instrumented_jit(static_argnames=("interpret",))
 def dense_counts_pallas(mask: jax.Array, sent: jax.Array, alive: jax.Array,
                         interpret: bool = False) -> jax.Array:
     """Drop-in replacement for ops.tally.dense_counts.

@@ -43,6 +43,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..config import SimConfig
 from ..models.benor import all_settled, benor_round
 from ..ops.collectives import ShardCtx
+from ..perfscope.instrument import instrumented_jit
 from ..sim import start_state
 from ..state import FaultSpec, NetState
 from . import mesh as meshlib
@@ -99,7 +100,8 @@ def _compiled(cfg: SimConfig, mesh: Mesh, fresh: bool = True):
         out_specs=out_specs,
         check_vma=False,  # while_loop results can't be proven replicated
     )
-    return jax.jit(fn)
+    return instrumented_jit(
+        fn, label="sharded.run" if fresh else "sharded.resume")
 
 
 def shard_inputs(state: NetState, faults: FaultSpec, mesh: Mesh):
@@ -113,6 +115,18 @@ def shard_inputs(state: NetState, faults: FaultSpec, mesh: Mesh):
     return state, faults
 
 
+def jitted_runner(cfg: SimConfig, mesh: Mesh, fresh: bool = True):
+    """The sharded regime's jitted executable, as an object.
+
+    ``run_consensus_sharded`` dispatches through this; perfscope's sharded
+    capture (perfscope/regimes.py) lowers/compiles the SAME object AOT to
+    read its cost/memory model, so what is profiled is what runs.  The
+    callable takes ``(state, faults, base_key, from_round)`` with state/
+    faults already placed by ``shard_inputs``.
+    """
+    return _compiled(cfg, mesh, fresh)
+
+
 def run_consensus_sharded(cfg: SimConfig, state: NetState, faults: FaultSpec,
                           base_key: jax.Array, mesh: Mesh):
     """Run /start -> termination over a ('trials','nodes') device mesh.
@@ -124,7 +138,7 @@ def run_consensus_sharded(cfg: SimConfig, state: NetState, faults: FaultSpec,
     """
     meshlib.check_divisible(cfg.trials, cfg.n_nodes, mesh)
     state, faults = shard_inputs(state, faults, mesh)
-    return _compiled(cfg, mesh)(state, faults, base_key, jnp.int32(1))
+    return jitted_runner(cfg, mesh)(state, faults, base_key, jnp.int32(1))
 
 
 def _local_slice_packed(cfg: SimConfig, state: NetState, faults: FaultSpec,
@@ -236,7 +250,7 @@ def _compiled_slice(cfg: SimConfig, mesh: Mesh):
         out_specs=(P(), sspec) + rec,
         check_vma=False,
     )
-    return jax.jit(fn)
+    return instrumented_jit(fn, label="sharded.slice")
 
 
 def run_consensus_slice_sharded(cfg: SimConfig, state: NetState,

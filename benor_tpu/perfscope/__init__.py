@@ -1,0 +1,71 @@
+"""perfscope — the AOT cost/memory observatory + perf regression gate.
+
+The repo could *see* protocol behavior (flight recorder, witness/audit)
+but measured performance by hand: one buried ``cost_analysis()`` probe
+in bench.py, ad-hoc wall-clocks, and five BENCH_r*.json snapshots
+nothing compared.  perfscope makes performance a first-class observable
+for every compiled regime (traced XLA loop, fused pallas packed loop,
+poll_rounds slices, batched dynamic-F sweep, sharded mesh):
+
+  * per-stage AOT pipeline timing — trace/lower, backend compile, first
+    execute, steady-state execute — fed into ``utils.metrics.REGISTRY``;
+  * the executable's own XLA cost model (FLOPs, bytes accessed,
+    transcendentals) and memory footprint (argument/output/temp/peak
+    bytes) from ``cost_analysis()`` / ``memory_analysis()``;
+  * arithmetic intensity + roofline placement against the device-kind
+    peak tables (roofline.py — the table bench.py used to own);
+  * a pinned-schema JSON manifest (tools/perf_report_schema.json,
+    validated by tools/check_metrics_schema.py) and a regression gate
+    (tools/check_perf_regression.py vs the committed PERF_BASELINE.json,
+    exit 2 on regression — perfscope/baseline.py holds the bands).
+
+Capture is OUT-OF-BAND: the profiled executable is AOT-built next to
+the normal jit cache, so profiling changes neither results nor compile
+counts of the unprofiled paths (pinned in tests/test_perfscope.py, the
+flight-recorder discipline).  Surfaces: ``python -m benor_tpu profile``
+(--profile-out/--baseline/--update-baseline, optional jax.profiler
+Perfetto capture), bench.py's ``perf_ok`` headline bool + ``perfscope``
+sidecar blob, and benorlint's ``perf-unregistered-jit`` rule keeping
+every jit/AOT call site routed through ``instrument.py``.
+
+NO-NEW-DEPS CONTRACT: perfscope is jax + numpy + stdlib only — the
+``profile = []`` extra in pyproject.toml documents that adding a real
+dependency (a profiler UI, a stats package) must be a reviewed decision,
+not import creep; the comparison half (baseline.py, the regression
+tool) is stdlib-only so CI can gate without initializing a backend.
+"""
+
+from .baseline import (IncomparableManifests, Regression,
+                       STRUCTURAL_BANDS, check_bench_trajectory,
+                       compare_manifests)
+from .capture import PerfReport, REPORT_VERSION, build_report, capture_stages
+from .instrument import (INSTRUMENTED, JIT_REGISTRY, AotArtifact,
+                         aot_compile, cost_of, instrumented_jit)
+from .manifest import (MANIFEST_KIND, build_manifest, load_manifest,
+                       missing_regimes, save_manifest)
+from .roofline import flops_peak_for, hbm_peak_for, roofline
+
+__all__ = [
+    "AotArtifact", "INSTRUMENTED", "IncomparableManifests",
+    "JIT_REGISTRY", "MANIFEST_KIND", "PerfReport", "REPORT_VERSION",
+    "Regression", "STRUCTURAL_BANDS", "aot_compile", "build_manifest",
+    "build_report", "capture_all", "capture_regime", "capture_stages",
+    "check_bench_trajectory", "compare_manifests", "cost_of",
+    "flops_peak_for", "hbm_peak_for", "instrumented_jit",
+    "load_manifest", "missing_regimes", "roofline", "save_manifest",
+]
+
+
+def capture_regime(name, **kw):
+    """One regime's (PerfReport, outputs) — see regimes.capture_regime.
+    (Lazy import: regimes pulls in sim/sweep/parallel, which themselves
+    import perfscope.instrument — the package __init__ must stay cheap
+    and cycle-free.)"""
+    from .regimes import capture_regime as impl
+    return impl(name, **kw)
+
+
+def capture_all(**kw):
+    """PerfReports for all five regimes — see regimes.capture_all."""
+    from .regimes import capture_all as impl
+    return impl(**kw)

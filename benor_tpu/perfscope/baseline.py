@@ -1,0 +1,184 @@
+"""Perf regression detection: manifest-vs-baseline tolerance bands.
+
+Deliberately jax-free (stdlib only): ``tools/check_perf_regression.py``
+must be able to gate a CI run — or an operator's laptop — without
+initializing any backend.  A "regression" is a STRUCTURAL drift: the
+cost model's FLOPs / bytes / memory footprint moving outside a
+per-metric band, a regime disappearing, or the deterministic round
+count changing.  Wall-clock stages are machine-sensitive and are NOT
+gated by default (pass ``timing_band`` to opt in); they are still
+carried in every manifest for trend reading.
+
+Bands gate BOTH directions: a 10x drop in bytes accessed is either a
+real optimization (re-baseline with ``--update-baseline`` /
+``python -m benor_tpu profile --update-baseline``) or a silently
+degenerated capture (a regime that stopped iterating), and the gate
+cannot tell which — a human re-baselining can.
+
+``check_bench_trajectory`` reads the committed BENCH_r01..r05 headline
+series and flags same-platform throughput collapses, so the round-over-
+round artifacts participate in the same gate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Sequence
+
+#: metric -> max allowed new/old ratio (and 1/band on the way down).
+#: Structural cost-model and footprint metrics only; see module
+#: docstring for why timings are opt-in.
+STRUCTURAL_BANDS: Dict[str, float] = {
+    "flops": 1.25,
+    "bytes_accessed": 1.25,
+    "transcendentals": 1.5,
+    "argument_bytes": 1.25,
+    "output_bytes": 1.25,
+    "temp_bytes": 1.5,
+    "peak_bytes": 1.5,
+}
+
+#: Stage-timing metrics (gated only when ``timing_band`` is passed).
+TIMING_KEYS = ("trace_lower_s", "compile_s", "first_execute_s",
+               "steady_execute_s")
+
+
+class IncomparableManifests(ValueError):
+    """Raised when manifest and baseline describe different experiments
+    (platform / scale / schema mismatch) — comparing them would produce
+    confident nonsense, so the gate refuses instead."""
+
+
+@dataclasses.dataclass
+class Regression:
+    """One out-of-band metric."""
+
+    regime: str
+    metric: str
+    new: Optional[float]
+    old: Optional[float]
+    ratio: Optional[float]
+    band: Optional[float]
+    message: str
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _require_comparable(new: dict, base: dict) -> None:
+    for key in ("kind", "schema_version", "platform"):
+        if new.get(key) != base.get(key):
+            raise IncomparableManifests(
+                f"{key}: manifest has {new.get(key)!r}, baseline has "
+                f"{base.get(key)!r}")
+    if new.get("scale") != base.get("scale"):
+        raise IncomparableManifests(
+            f"scale: manifest {new.get('scale')} vs baseline "
+            f"{base.get('scale')} — recapture at the baseline scale or "
+            f"re-baseline")
+
+
+def _band_check(regime: str, metric: str, new_v, old_v, band: float,
+                out: List[Regression]) -> None:
+    if old_v in (None, 0) or new_v is None:
+        # a metric the baseline's backend could not produce (or a zero
+        # denominator) cannot band-compare; only flag a new zero where
+        # the baseline had substance
+        if old_v and not new_v:
+            out.append(Regression(
+                regime, metric, new_v, old_v, 0.0, band,
+                f"{regime}.{metric}: went to zero (baseline {old_v}) — "
+                f"the capture likely degenerated"))
+        return
+    ratio = float(new_v) / float(old_v)
+    if ratio > band:
+        out.append(Regression(
+            regime, metric, float(new_v), float(old_v), round(ratio, 4),
+            band,
+            f"{regime}.{metric}: {new_v} vs baseline {old_v} "
+            f"({ratio:.2f}x > band {band}x) — regression"))
+    elif ratio < 1.0 / band:
+        out.append(Regression(
+            regime, metric, float(new_v), float(old_v), round(ratio, 4),
+            band,
+            f"{regime}.{metric}: {new_v} vs baseline {old_v} "
+            f"({ratio:.2f}x < band 1/{band}x) — improvement or "
+            f"degenerated capture; re-baseline if intended"))
+
+
+def compare_manifests(new: dict, base: dict,
+                      timing_band: Optional[float] = None
+                      ) -> List[Regression]:
+    """All out-of-band metrics of ``new`` vs ``base`` (empty = gate
+    passes).  Raises IncomparableManifests when the two documents do not
+    describe the same experiment."""
+    _require_comparable(new, base)
+    out: List[Regression] = []
+    for regime, old_rep in base.get("regimes", {}).items():
+        new_rep = new.get("regimes", {}).get(regime)
+        if new_rep is None:
+            out.append(Regression(
+                regime, "regime", None, None, None, None,
+                f"{regime}: present in baseline but missing from the "
+                f"manifest — a compiled regime disappeared"))
+            continue
+        if new_rep.get("rounds_executed") != old_rep.get("rounds_executed"):
+            out.append(Regression(
+                regime, "rounds_executed",
+                new_rep.get("rounds_executed"),
+                old_rep.get("rounds_executed"), None, None,
+                f"{regime}.rounds_executed: "
+                f"{new_rep.get('rounds_executed')} vs baseline "
+                f"{old_rep.get('rounds_executed')} — same seed + scale "
+                f"must execute the same rounds (determinism drift)"))
+        for metric, band in STRUCTURAL_BANDS.items():
+            _band_check(regime, metric, new_rep.get(metric),
+                        old_rep.get(metric), band, out)
+        if timing_band:
+            for metric in TIMING_KEYS:
+                _band_check(regime, metric, new_rep.get(metric),
+                            old_rep.get(metric), timing_band, out)
+    return out
+
+
+def check_bench_trajectory(paths: Sequence[str],
+                           collapse_ratio: float = 3.0) -> List[str]:
+    """Same-platform throughput collapses along a BENCH_r*.json series.
+
+    Compares each record's ``node_rounds_per_sec`` (the workload-
+    invariant throughput number; ``value`` = trials/s is NOT comparable
+    across regime-set changes — bench.py documents why) against the best
+    earlier same-platform record; a drop past ``collapse_ratio`` is a
+    finding.  Records that failed to parse, carried an error, or predate
+    the metric are skipped with a note."""
+    findings: List[str] = []
+    best: Dict[str, tuple] = {}              # platform -> (value, path)
+    for path in paths:
+        try:
+            with open(path) as fh:
+                rec = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            findings.append(f"note: {path}: unreadable ({e})")
+            continue
+        if not isinstance(rec, dict) or rec.get("error"):
+            findings.append(f"note: {path}: error record, skipped")
+            continue
+        plat = rec.get("platform")
+        nrps = rec.get("node_rounds_per_sec")
+        if not plat or nrps is None:
+            # ABSENT metric = pre-metric capture; a present 0.0 is the
+            # worst possible collapse and must flow into the comparison
+            findings.append(
+                f"note: {path}: no node_rounds_per_sec (pre-metric "
+                f"capture), skipped")
+            continue
+        prev = best.get(plat)
+        if prev and nrps * collapse_ratio < prev[0]:
+            findings.append(
+                f"REGRESSION: {path}: node_rounds_per_sec {nrps:.3g} is "
+                f">{collapse_ratio}x below the {plat} best {prev[0]:.3g} "
+                f"({prev[1]})")
+        if prev is None or nrps > prev[0]:
+            best[plat] = (nrps, path)
+    return findings

@@ -1,0 +1,176 @@
+"""Stage-timed AOT capture -> PerfReport.
+
+One capture = the full pipeline a compiled regime lives through, each
+stage measured separately (and fed into ``metrics.REGISTRY`` so captures
+land in the same JSON-lines / Prometheus / Chrome-trace exports as every
+other accounting source):
+
+  trace+lower     python trace -> StableHLO           (host, per config)
+  compile         XLA backend compile                 (the 8-40 s remote
+                                                       cost the batched
+                                                       sweep amortizes)
+  first execute   includes device-transfer warm-up
+  steady execute  mean of ``steady_reps`` post-warm repetitions — the
+                  number roofline placement uses
+
+plus the compiled executable's own post-optimization cost model
+(FLOPs / bytes accessed / transcendentals) and memory footprint
+(argument / output / temp / peak bytes), reduced with the device peak
+table (roofline.py) into arithmetic intensity + roofline position.
+
+The capture executes the AOT-compiled object directly; it never touches
+the normal jit call cache, so profiling a regime leaves the unprofiled
+path's results AND compile counts bit-identical (pinned by
+tests/test_perfscope.py, same discipline as the flight recorder and the
+witness buffers).  Its own cost is one extra backend compile per
+captured regime — out-of-band, like a ``jax.profiler`` capture.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+from ..utils.metrics import REGISTRY
+from .instrument import AotArtifact, aot_compile
+from .roofline import roofline
+
+#: PerfReport / manifest schema version; bump on any key change
+#: (tools/perf_report_schema.json is the pinned schema).
+REPORT_VERSION = 1
+
+
+@dataclasses.dataclass
+class PerfReport:
+    """One regime's AOT pipeline + cost/memory/roofline accounting."""
+
+    regime: str
+    platform: str
+    device_kind: str
+    # the captured workload
+    n_nodes: int
+    n_faulty: int
+    trials: int
+    max_rounds: int
+    seed: int
+    rounds_executed: int
+    # stage timings (seconds)
+    trace_lower_s: float
+    compile_s: float
+    first_execute_s: float
+    steady_execute_s: float
+    steady_reps: int
+    backend_compiles: int
+    # XLA cost model (per program; the while-loop body counts once)
+    flops: float
+    bytes_accessed: float
+    transcendentals: float
+    # memory footprint (bytes)
+    argument_bytes: int
+    output_bytes: int
+    temp_bytes: int
+    alias_bytes: int
+    generated_code_bytes: int
+    peak_bytes: int
+    # roofline placement (roofline.py; None off the peak tables)
+    arithmetic_intensity: Optional[float]
+    achieved_gbps: Optional[float]
+    hbm_peak_gbps: Optional[float]
+    hbm_util: Optional[float]
+    ridge_flop_per_byte: Optional[float]
+    bound: Optional[str]
+    #: regime-specific facts (scheduler, coin, mesh shape, ...)
+    extra: dict = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class CaptureResult:
+    """An AOT artifact plus its measured executions and outputs."""
+
+    art: AotArtifact
+    first_execute_s: float
+    steady_execute_s: float
+    steady_reps: int
+    out: Any                      # the first execution's outputs
+
+
+def _default_barrier(out) -> None:
+    """Completion barrier: fetch the first output (the rounds scalar /
+    vector in every regime here) — under the axon tunnel
+    ``block_until_ready`` does not actually block, a fetch does."""
+    np.asarray(out[0] if isinstance(out, (tuple, list)) else out)
+
+
+def capture_stages(label: str, fun, lower_args: Tuple,
+                   exec_args: Optional[Tuple] = None, *,
+                   steady_reps: int = 2, barrier=_default_barrier,
+                   **jit_kwargs) -> CaptureResult:
+    """AOT-compile ``fun`` at ``lower_args`` and measure every stage.
+
+    ``exec_args`` are the arguments the COMPILED object takes (defaults
+    to ``lower_args``; jitted functions with static leading arguments
+    take only the dynamic tail).  Execution timers feed
+    ``perfscope.<label>.first_execute`` / ``.steady_execute``.
+    """
+    art = aot_compile(fun, lower_args, label=label, **jit_kwargs)
+    if exec_args is None:
+        exec_args = lower_args
+    t0 = time.perf_counter()
+    out = art.compiled(*exec_args)
+    barrier(out)
+    first_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    last = out
+    for _ in range(steady_reps):
+        last = art.compiled(*exec_args)
+    barrier(last)
+    steady_s = (time.perf_counter() - t0) / max(steady_reps, 1)
+    REGISTRY.timer(f"perfscope.{label}.first_execute").record(first_s)
+    REGISTRY.timer(f"perfscope.{label}.steady_execute").record(steady_s)
+    return CaptureResult(art=art, first_execute_s=first_s,
+                         steady_execute_s=steady_s,
+                         steady_reps=steady_reps, out=out)
+
+
+def build_report(regime: str, cfg, cap: CaptureResult,
+                 rounds_executed: int, extra: Optional[dict] = None
+                 ) -> PerfReport:
+    """Reduce a CaptureResult + its SimConfig into the serializable
+    PerfReport (cost model, memory footprint, roofline placement)."""
+    import jax
+
+    dev = jax.devices()[0]
+    cost = cap.art.cost()
+    mem = cap.art.memory()
+    flops = float(cost.get("flops", 0.0))
+    bytes_acc = float(cost.get("bytes accessed", 0.0))
+    roof = roofline(flops, bytes_acc, cap.steady_execute_s,
+                    dev.device_kind)
+    return PerfReport(
+        regime=regime, platform=dev.platform, device_kind=dev.device_kind,
+        n_nodes=cfg.n_nodes, n_faulty=cfg.n_faulty, trials=cfg.trials,
+        max_rounds=cfg.max_rounds, seed=cfg.seed,
+        rounds_executed=int(rounds_executed),
+        trace_lower_s=round(cap.art.trace_lower_s, 6),
+        compile_s=round(cap.art.compile_s, 6),
+        first_execute_s=round(cap.first_execute_s, 6),
+        steady_execute_s=round(cap.steady_execute_s, 6),
+        steady_reps=cap.steady_reps,
+        backend_compiles=cap.art.backend_compiles,
+        flops=flops, bytes_accessed=bytes_acc,
+        transcendentals=float(cost.get("transcendentals", 0.0)),
+        argument_bytes=mem["argument_bytes"],
+        output_bytes=mem["output_bytes"],
+        temp_bytes=mem["temp_bytes"],
+        alias_bytes=mem["alias_bytes"],
+        generated_code_bytes=mem["generated_code_bytes"],
+        peak_bytes=mem["peak_bytes"],
+        **roof,
+        extra=dict(extra or {}),
+    )

@@ -1,0 +1,65 @@
+"""Perf manifest: the pinned-schema JSON document a profile run emits.
+
+One manifest = one capture session: platform/device identity, the
+profile scale, and one PerfReport per regime (regimes.REGIME_NAMES).
+The schema is checked in at ``tools/perf_report_schema.json`` and
+validated by ``tools/check_metrics_schema.py`` (auto-detected by the
+``kind`` key) — the same contract discipline as the bench detail record
+and the witness bundle, so a renamed metric breaks tier-1 before it
+breaks the regression gate or a dashboard.
+
+``tools/check_perf_regression.py`` compares a manifest against the
+committed ``PERF_BASELINE.json`` (same document format) with per-metric
+tolerance bands (perfscope/baseline.py), exit 2 on regression.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import List, Sequence
+
+from .capture import REPORT_VERSION, PerfReport
+from .regimes import REGIME_NAMES
+
+#: The manifest's auto-detection tag (tools/check_metrics_schema.py).
+MANIFEST_KIND = "perf_manifest"
+
+
+def build_manifest(reports: Sequence[PerfReport], scale: dict) -> dict:
+    """Assemble the manifest document from a capture session's reports."""
+    import jax
+
+    dev = jax.devices()[0]
+    return {
+        "kind": MANIFEST_KIND,
+        "schema_version": REPORT_VERSION,
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "jax_version": jax.__version__,
+        "created_unix": round(time.time(), 3),
+        "scale": {k: int(scale[k])
+                  for k in ("n_nodes", "trials", "max_rounds", "seed")},
+        "regimes": {r.regime: r.to_dict() for r in reports},
+    }
+
+
+def missing_regimes(manifest: dict) -> List[str]:
+    """Regime keys a complete manifest must carry but this one lacks."""
+    return [r for r in REGIME_NAMES
+            if r not in manifest.get("regimes", {})]
+
+
+def save_manifest(path: str, manifest: dict) -> None:
+    with open(path, "w") as fh:
+        json.dump(manifest, fh, indent=1)
+        fh.write("\n")
+
+
+def load_manifest(path: str) -> dict:
+    with open(path) as fh:
+        doc = json.load(fh)
+    if doc.get("kind") != MANIFEST_KIND:
+        raise ValueError(
+            f"{path}: not a perf manifest (kind={doc.get('kind')!r})")
+    return doc

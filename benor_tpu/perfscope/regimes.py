@@ -1,0 +1,232 @@
+"""The five compiled regimes as capturable workloads.
+
+Every compiled path the repo ships — the traced XLA while-loop, the
+fused pallas packed loop, the poll_rounds slice primitive, the batched
+dynamic-F sweep bucket, and the sharded (shard_map) runner — built at a
+profile scale, AOT-captured stage by stage (capture.py) and reduced to
+one PerfReport each.  ``capture_all`` is what ``python -m benor_tpu
+profile`` and bench.py's ``perfscope`` blob run.
+
+Regime configs (balanced inputs, zero crashes — the multi-round science
+shape, so the while-loops genuinely iterate):
+
+  traced         uniform scheduler, f = 0.4 N (the flagship curve point),
+                 plain XLA loop
+  fused_pallas   count-controlling adversary + common coin with
+                 ``use_pallas_round`` — closed-form counts, so the kernel
+                 path engages at ANY scale (CPU interpret mode included)
+                 and shares every random bit with the XLA loop
+  sliced         the traced config through ``run_consensus_slice`` (one
+                 slice spanning the whole run — the poll_rounds
+                 executable, traced round bounds)
+  batched_sweep  a 2-point dynamic-F bucket over the adversarial config
+                 (vmapped ``run_consensus_traced`` + on-device summaries
+                 — the sweep engine's executable shape)
+  sharded        the traced config under a ('trials','nodes') mesh
+                 (default (1, 1): deterministic on any host; pass
+                 ``mesh_shape`` to span real devices)
+
+The profile scale is deliberately SMALL on CPU (N=256) — the point is
+the pipeline and the cost model, both of which scale-compare fine — and
+the bench/TPU scale on accelerators.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+#: Manifest regime keys, capture order.  The regression gate and the
+#: manifest schema both require exactly this set.
+REGIME_NAMES = ("traced", "fused_pallas", "sliced", "batched_sweep",
+                "sharded")
+
+
+def default_profile_scale(on_cpu: Optional[bool] = None) -> dict:
+    """(n_nodes, trials, max_rounds) for a profile capture — smoke scale
+    on CPU, bench scale on accelerators (utils/backend.default_scale)."""
+    import jax
+
+    from ..utils.backend import default_scale
+    if on_cpu is None:
+        on_cpu = jax.default_backend() == "cpu"
+    if on_cpu:
+        return {"n_nodes": 256, "trials": 8, "max_rounds": 12}
+    n, t = default_scale(False)
+    return {"n_nodes": n, "trials": t, "max_rounds": 16}
+
+
+def _even_quorum(n: int, f: int) -> int:
+    """Adjust F so the quorum N - F is even (the tie-forcing adversary's
+    requirement, cf. sweep.coin_comparison)."""
+    return f + (n - f) % 2
+
+
+def _uniform_cfg(n: int, trials: int, max_rounds: int, seed: int):
+    from ..config import SimConfig
+    return SimConfig(n_nodes=n, n_faulty=int(0.4 * n), trials=trials,
+                     delivery="quorum", scheduler="uniform",
+                     path="histogram", max_rounds=max_rounds, seed=seed)
+
+
+def _adversarial_cfg(n: int, trials: int, max_rounds: int, seed: int,
+                     use_pallas_round: bool = False):
+    from ..config import SimConfig
+    return SimConfig(n_nodes=n, n_faulty=_even_quorum(n, int(0.2 * n)),
+                     trials=trials, delivery="quorum",
+                     scheduler="adversarial", coin_mode="common",
+                     path="histogram", max_rounds=min(12, max_rounds),
+                     use_pallas_round=use_pallas_round, seed=seed)
+
+
+def _inputs(cfg):
+    from ..state import FaultSpec, init_state
+    from ..sweep import balanced_inputs
+    import jax
+
+    faults = FaultSpec.none(cfg.trials, cfg.n_nodes)
+    state = init_state(cfg, balanced_inputs(cfg.trials, cfg.n_nodes),
+                       faults)
+    return state, faults, jax.random.key(cfg.seed)
+
+
+def capture_regime(name: str, *, n_nodes: Optional[int] = None,
+                   trials: Optional[int] = None,
+                   max_rounds: Optional[int] = None, seed: int = 0,
+                   mesh_shape: Tuple[int, int] = (1, 1),
+                   steady_reps: int = 2):
+    """Capture ONE regime -> (PerfReport, raw outputs of one execution).
+
+    The outputs are returned so callers (tests, notably) can pin the
+    profiled executable bit-identical to the plain dispatch path.
+    """
+    import jax.numpy as jnp
+
+    from .capture import build_report, capture_stages
+
+    scale = default_profile_scale()
+    n = scale["n_nodes"] if n_nodes is None else n_nodes
+    t = scale["trials"] if trials is None else trials
+    mr = scale["max_rounds"] if max_rounds is None else max_rounds
+
+    if name == "traced":
+        from ..sim import run_consensus
+        cfg = _uniform_cfg(n, t, mr, seed)
+        state, faults, key = _inputs(cfg)
+        cap = capture_stages(f"regime.{name}", run_consensus,
+                             (cfg, state, faults, key),
+                             (state, faults, key),
+                             steady_reps=steady_reps)
+        rounds = int(cap.out[0])
+        extra = {"scheduler": cfg.scheduler, "coin_mode": cfg.coin_mode}
+
+    elif name == "fused_pallas":
+        from ..ops.tally import pallas_round_active
+        from ..sim import run_consensus
+        cfg = _adversarial_cfg(n, t, mr, seed, use_pallas_round=True)
+        if not pallas_round_active(cfg):
+            raise ValueError(
+                "fused_pallas regime config failed the kernel gate "
+                "(pallas_round_active) — the capture would silently "
+                "profile the XLA loop instead")
+        state, faults, key = _inputs(cfg)
+        cap = capture_stages(f"regime.{name}", run_consensus,
+                             (cfg, state, faults, key),
+                             (state, faults, key),
+                             steady_reps=steady_reps)
+        rounds = int(cap.out[0])
+        extra = {"scheduler": cfg.scheduler, "coin_mode": cfg.coin_mode,
+                 "use_pallas_round": True}
+
+    elif name == "sliced":
+        from ..sim import run_consensus_slice, start_state
+        cfg = _uniform_cfg(n, t, mr, seed)
+        state, faults, key = _inputs(cfg)
+        st = start_state(cfg, state)
+        bounds = (jnp.int32(1), jnp.int32(cfg.max_rounds + 2))
+        cap = capture_stages(f"regime.{name}", run_consensus_slice,
+                             (cfg, st, faults, key) + bounds,
+                             (st, faults, key) + bounds,
+                             steady_reps=steady_reps)
+        rounds = int(cap.out[0]) - 1
+        extra = {"scheduler": cfg.scheduler,
+                 "slice_bounds": [1, cfg.max_rounds + 2]}
+
+    elif name == "batched_sweep":
+        import jax
+
+        from ..sim import run_consensus_traced
+        from ..state import DynParams, FaultSpec, init_state
+        from ..sweep import (_stack_tree, _summarize_inline,
+                             balanced_inputs, sweep_bucket_key)
+        base = _adversarial_cfg(n, t, mr, seed)
+        f_values = [_even_quorum(n, int(0.15 * n)),
+                    _even_quorum(n, int(0.25 * n))]
+        cfgs = [base.replace(n_faulty=f) for f in f_values]
+        if any(sweep_bucket_key(c)[0] != "dyn" for c in cfgs):
+            raise ValueError(
+                "batched_sweep regime points fell into a static bucket — "
+                "the capture would not cover the dynamic-F executable")
+        bal = balanced_inputs(t, n)
+        fls = [FaultSpec.none(t, n) for _ in f_values]
+        states = _stack_tree([init_state(c, bal, fl)
+                              for c, fl in zip(cfgs, fls)])
+        faults_b = _stack_tree(fls)
+        dyn = DynParams.stack(cfgs)
+        rep = cfgs[0]
+        key = jax.random.key(seed)
+
+        def bucket_runner(states, faults, dyn, bk):
+            def one(s, fl, d):
+                out = run_consensus_traced(rep, s, fl, bk, d)
+                return _summarize_inline(rep, out[0], out[1], fl) + (
+                    out[1],)
+            return jax.vmap(one, in_axes=(0, 0, 0))(states, faults, dyn)
+
+        cap = capture_stages(f"regime.{name}", bucket_runner,
+                             (states, faults_b, dyn, key),
+                             steady_reps=steady_reps)
+        cfg = rep
+        rounds = int(np.max(np.asarray(cap.out[0])))
+        extra = {"scheduler": base.scheduler, "f_values": list(f_values),
+                 "batch": len(f_values)}
+
+    elif name == "sharded":
+        import jax.numpy as jnp
+
+        from ..parallel import make_mesh
+        from ..parallel.sharded import jitted_runner, shard_inputs
+        cfg = _uniform_cfg(n, t, mr, seed)
+        mesh = make_mesh(*mesh_shape)
+        state, faults, key = _inputs(cfg)
+        st_sh, fl_sh = shard_inputs(state, faults, mesh)
+        args = (st_sh, fl_sh, key, jnp.int32(1))
+        cap = capture_stages(f"regime.{name}", jitted_runner(cfg, mesh),
+                             args, steady_reps=steady_reps)
+        rounds = int(cap.out[0])
+        extra = {"scheduler": cfg.scheduler,
+                 "mesh_shape": list(mesh_shape)}
+
+    else:
+        raise ValueError(f"unknown regime {name!r}; choose from "
+                         f"{REGIME_NAMES}")
+
+    return build_report(name, cfg, cap, rounds, extra=extra), cap.out
+
+
+def capture_all(n_nodes: Optional[int] = None,
+                trials: Optional[int] = None,
+                max_rounds: Optional[int] = None, seed: int = 0,
+                regimes: Optional[Sequence[str]] = None,
+                mesh_shape: Tuple[int, int] = (1, 1),
+                steady_reps: int = 2):
+    """Capture every regime (or the named subset) -> list of PerfReports,
+    capture order = REGIME_NAMES order."""
+    reports = []
+    for name in (REGIME_NAMES if regimes is None else regimes):
+        report, _ = capture_regime(
+            name, n_nodes=n_nodes, trials=trials, max_rounds=max_rounds,
+            seed=seed, mesh_shape=mesh_shape, steady_reps=steady_reps)
+        reports.append(report)
+    return reports

@@ -1,0 +1,87 @@
+"""Device peak tables + roofline placement for PerfReports.
+
+The HBM peak-bandwidth table lived in ``bench.py`` since round 2, where
+only the end-to-end sweep could use it; perfscope owns it now so every
+per-regime report (and bench.py, which imports it back) places its
+achieved bytes/s against the same published numbers.  The FLOPs table
+lets a report say which side of the roofline ridge a regime sits on:
+arithmetic intensity below ``ridge = peak_flops / peak_bw`` means the
+regime is memory-bound — the expectation for this workload, whose round
+body is a pass over [T, N] int8/int32 state (see README "Performance").
+
+Both tables key on substrings of ``jax.Device.device_kind``
+(lowercased), most-specific first; unknown kinds (including the CPU
+smoke backend) yield ``None`` peaks and a ``bound`` of ``None`` — the
+report then carries arithmetic intensity only, which is still
+comparable across captures.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+#: Published HBM peak bandwidth per chip, bytes/s, keyed by substrings of
+#: jax Device.device_kind (lowercased), most-specific first.
+HBM_PEAKS = [
+    ("v6", 1640e9), ("v5p", 2765e9), ("v5 lite", 819e9), ("v5e", 819e9),
+    ("v5", 2765e9), ("v4", 1228e9), ("v3", 900e9), ("v2", 700e9),
+]
+
+#: Published peak dense compute per chip (bf16 FLOP/s), same keying.
+FLOPS_PEAKS = [
+    ("v6", 918e12), ("v5p", 459e12), ("v5 lite", 197e12), ("v5e", 197e12),
+    ("v5", 459e12), ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
+]
+
+
+def _lookup(table, device_kind: str) -> Optional[float]:
+    kind = device_kind.lower()
+    for sub, peak in table:
+        if sub in kind:
+            return peak
+    return None
+
+
+def hbm_peak_for(device_kind: str) -> Optional[float]:
+    """Peak HBM bandwidth (bytes/s) for a device kind, or None."""
+    return _lookup(HBM_PEAKS, device_kind)
+
+
+def flops_peak_for(device_kind: str) -> Optional[float]:
+    """Peak dense compute (FLOP/s) for a device kind, or None."""
+    return _lookup(FLOPS_PEAKS, device_kind)
+
+
+def roofline(flops: float, bytes_accessed: float, exec_s: float,
+             device_kind: str) -> dict:
+    """Place one executed program on the device roofline.
+
+    Returns the derived keys every PerfReport carries:
+
+      arithmetic_intensity  flops / bytes accessed (FLOP/byte); None
+                            when the cost model reported zero bytes
+      achieved_gbps         bytes accessed / steady-state seconds / 1e9
+      hbm_peak_gbps         the table peak, or None off the table
+      hbm_util              achieved / peak
+      ridge_flop_per_byte   peak_flops / peak_bw — the roofline knee
+      bound                 'memory' | 'compute' by which side of the
+                            ridge the intensity falls on; None when the
+                            device is off the peak tables
+    """
+    ai = (flops / bytes_accessed) if bytes_accessed else None
+    gbps = (bytes_accessed / exec_s / 1e9) if exec_s > 0 else None
+    peak_bw = hbm_peak_for(device_kind)
+    peak_fl = flops_peak_for(device_kind)
+    ridge = (peak_fl / peak_bw) if (peak_fl and peak_bw) else None
+    bound = None
+    if ridge is not None and ai is not None:
+        bound = "memory" if ai < ridge else "compute"
+    return {
+        "arithmetic_intensity": round(ai, 6) if ai is not None else None,
+        "achieved_gbps": round(gbps, 3) if gbps is not None else None,
+        "hbm_peak_gbps": round(peak_bw / 1e9, 1) if peak_bw else None,
+        "hbm_util": (round(gbps * 1e9 / peak_bw, 6)
+                     if (gbps is not None and peak_bw) else None),
+        "ridge_flop_per_byte": round(ridge, 3) if ridge else None,
+        "bound": bound,
+    }

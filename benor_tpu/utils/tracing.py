@@ -97,12 +97,21 @@ def emit_round_event(state, ctx=None) -> None:
 
 @contextlib.contextmanager
 def profile_trace(log_dir: str):
-    """XLA profiler trace around a block: TensorBoard-compatible output."""
+    """XLA profiler trace around a block: TensorBoard-compatible output.
+
+    Yields the trace directory path, so callers can report where the
+    capture landed (``python -m benor_tpu profile --trace-dir`` prints
+    it) or post-process the files; each completed capture also ticks the
+    ``tracing.profile_capture`` counter in the unified metrics registry,
+    making profiler runs visible in the JSON-lines / Prometheus /
+    Chrome-trace exports next to the compile and probe accounting."""
+    from .metrics import REGISTRY
     jax.profiler.start_trace(log_dir)
     try:
-        yield
+        yield log_dir
     finally:
         jax.profiler.stop_trace()
+        REGISTRY.counter("tracing.profile_capture").inc()
 
 
 @contextlib.contextmanager

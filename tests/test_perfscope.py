@@ -1,0 +1,432 @@
+"""perfscope (ISSUE 5): AOT cost/memory observatory + perf regression gate.
+
+Acceptance contract:
+  * a capture's manifest is schema-valid (tools/perf_report_schema.json
+    via check_metrics_schema.check_perf_manifest) with non-zero FLOPs /
+    bytes accessed / peak-HBM on the CPU backend;
+  * tools/check_perf_regression.py exits 0 against the committed
+    PERF_BASELINE.json, 2 against a manifest with an injected 2x
+    peak-HBM regression, and 3 on incomparable documents;
+  * profiling OFF is bit-identical in results AND compile counts (the
+    tests/test_flight_recorder.py / test_witness_audit.py discipline):
+    the out-of-band AOT capture neither adds dispatch compiles nor
+    perturbs results — including a checkpoint-resumed
+    ``run_consensus_slice`` leg (utils/checkpoint interaction).
+"""
+
+import copy
+import importlib.util
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from benor_tpu.config import SimConfig
+from benor_tpu.perfscope import (IncomparableManifests, build_manifest,
+                                 capture_stages, check_bench_trajectory,
+                                 compare_manifests, missing_regimes)
+from benor_tpu.perfscope.regimes import REGIME_NAMES, capture_regime
+from benor_tpu.sim import run_consensus, run_consensus_slice
+from benor_tpu.state import FaultSpec, init_state
+from benor_tpu.sweep import balanced_inputs
+from benor_tpu.utils.compile_counter import count_backend_compiles
+from benor_tpu.utils.metrics import REGISTRY
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO, "PERF_BASELINE.json")
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+schema_tool = _load_tool("check_metrics_schema")
+gate_tool = _load_tool("check_perf_regression")
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    with open(BASELINE) as fh:
+        return json.load(fh)
+
+
+# --------------------------------------------------------------------------
+# manifest schema (mirrors tests/test_metrics_schema.py)
+# --------------------------------------------------------------------------
+
+
+def test_committed_baseline_passes_schema(baseline):
+    assert schema_tool.check_perf_manifest(baseline) == []
+    assert missing_regimes(baseline) == []
+    assert set(baseline["regimes"]) == set(REGIME_NAMES)
+
+
+def test_committed_baseline_has_nonzero_cost_model(baseline):
+    """The acceptance pin: every regime's CPU capture carries a real cost
+    model — zero FLOPs/bytes/peak would mean a degenerated capture."""
+    for name, rep in baseline["regimes"].items():
+        assert rep["flops"] > 0, name
+        assert rep["bytes_accessed"] > 0, name
+        assert rep["peak_bytes"] > 0, name
+        assert rep["rounds_executed"] >= 2, name   # the loop iterated
+        assert rep["backend_compiles"] == 1, name  # one AOT round trip
+
+
+def test_schema_catches_missing_required(baseline):
+    broken = {k: v for k, v in baseline.items() if k != "scale"}
+    assert any("scale" in e
+               for e in schema_tool.check_perf_manifest(broken))
+
+
+def test_schema_catches_regime_report_drift(baseline):
+    broken = copy.deepcopy(baseline)
+    del broken["regimes"]["traced"]["flops"]
+    assert any("flops" in e
+               for e in schema_tool.check_perf_manifest(broken))
+
+
+def test_schema_catches_cross_field_violations(baseline):
+    # map key vs report's own regime name
+    broken = copy.deepcopy(baseline)
+    broken["regimes"]["traced"]["regime"] = "sliced"
+    assert any("regime key" in e
+               for e in schema_tool.check_perf_manifest(broken))
+    # the peak = arg + out + temp - alias identity the widest gate band
+    # relies on
+    broken = copy.deepcopy(baseline)
+    broken["regimes"]["traced"]["peak_bytes"] += 1
+    assert any("peak_bytes" in e
+               for e in schema_tool.check_perf_manifest(broken))
+
+
+def test_schema_errors_isolated_per_regime(baseline):
+    """One regime's schema error must not mask another regime's
+    cross-field drift (the iteration is per-regime scoped)."""
+    broken = copy.deepcopy(baseline)
+    broken["regimes"]["traced"]["flops"] = "many"          # schema error
+    broken["regimes"]["sharded"]["peak_bytes"] += 1        # identity drift
+    errs = schema_tool.check_perf_manifest(broken)
+    assert any("traced" in e and "flops" in e for e in errs)
+    assert any("sharded" in e and "peak_bytes" in e for e in errs)
+
+
+def test_schema_tool_main_autodetects_manifest(capsys):
+    assert schema_tool.main([BASELINE]) == 0
+    assert "perf manifest OK" in capsys.readouterr().out
+
+
+# --------------------------------------------------------------------------
+# regression gate (perfscope/baseline.py + tools/check_perf_regression.py)
+# --------------------------------------------------------------------------
+
+
+def _regress_peak(manifest, factor=2.0):
+    """The acceptance fixture: a ``factor``x peak-HBM regression in every
+    regime, with the arg+out+temp-alias identity kept honest."""
+    out = copy.deepcopy(manifest)
+    for rep in out["regimes"].values():
+        grown = int(rep["temp_bytes"] + (factor - 1) * rep["peak_bytes"])
+        rep["temp_bytes"] = grown
+        rep["peak_bytes"] = (rep["argument_bytes"] + rep["output_bytes"]
+                             + grown - rep["alias_bytes"])
+    return out
+
+
+def test_gate_in_band_against_itself(baseline):
+    assert compare_manifests(baseline, baseline) == []
+
+
+def test_gate_catches_2x_peak_hbm(baseline):
+    regs = compare_manifests(_regress_peak(baseline), baseline)
+    assert regs
+    assert {r.metric for r in regs} >= {"peak_bytes"}
+    assert all(r.ratio is None or r.ratio > 1 for r in regs)
+
+
+def test_gate_flags_improvement_direction_too(baseline):
+    """A 10x drop is either a real optimization or a degenerated capture;
+    the gate cannot tell which, so it flags for a human re-baseline."""
+    shrunk = copy.deepcopy(baseline)
+    shrunk["regimes"]["traced"]["flops"] /= 10.0
+    regs = compare_manifests(shrunk, baseline)
+    assert any(r.metric == "flops" and "re-baseline" in r.message
+               for r in regs)
+
+
+def test_gate_flags_missing_regime_and_rounds_drift(baseline):
+    partial = copy.deepcopy(baseline)
+    del partial["regimes"]["sharded"]
+    partial["regimes"]["traced"]["rounds_executed"] += 1
+    msgs = [r.message for r in compare_manifests(partial, baseline)]
+    assert any("sharded" in m and "missing" in m for m in msgs)
+    assert any("determinism drift" in m for m in msgs)
+
+
+def test_gate_refuses_incomparable(baseline):
+    alien = copy.deepcopy(baseline)
+    alien["platform"] = "tpu"
+    with pytest.raises(IncomparableManifests):
+        compare_manifests(alien, baseline)
+
+
+def test_gate_tool_exit_codes(tmp_path, baseline, capsys):
+    """The CI contract end-to-end through tools/check_perf_regression.py:
+    0 in-band, 2 on the injected 2x peak-HBM regression, 3 incomparable."""
+    clean = tmp_path / "clean.json"
+    clean.write_text(json.dumps(baseline))
+    assert gate_tool.main([str(clean), BASELINE]) == 0
+
+    bad = tmp_path / "regressed.json"
+    bad.write_text(json.dumps(_regress_peak(baseline)))
+    assert gate_tool.main([str(bad), BASELINE]) == 2
+    assert "peak_bytes" in capsys.readouterr().out
+
+    alien = copy.deepcopy(baseline)
+    alien["scale"]["n_nodes"] *= 2
+    weird = tmp_path / "alien.json"
+    weird.write_text(json.dumps(alien))
+    assert gate_tool.main([str(weird), BASELINE]) == 3
+
+    assert gate_tool.main([str(clean), str(tmp_path / "absent.json"),
+                           "--strict"]) == 3
+
+
+def test_bench_trajectory_collapse(tmp_path):
+    recs = [("r01", {"platform": "cpu", "node_rounds_per_sec": 900.0}),
+            ("r02", {"platform": "cpu", "node_rounds_per_sec": 1200.0}),
+            ("r03", {"platform": "tpu", "node_rounds_per_sec": 5.0}),
+            ("r04", {"platform": "cpu", "node_rounds_per_sec": 100.0}),
+            ("r05", {"error": "probe timeout"}),
+            ("r06", {"platform": "cpu", "node_rounds_per_sec": 0.0})]
+    paths = []
+    for name, rec in recs:
+        p = tmp_path / f"BENCH_{name}.json"
+        p.write_text(json.dumps(rec))
+        paths.append(str(p))
+    findings = check_bench_trajectory(paths)
+    hits = [f for f in findings if f.startswith("REGRESSION")]
+    # r04 collapses vs the cpu best (r02); the tpu record is its own
+    # platform series; the error record is skipped with a note; the
+    # 0.0 record is the WORST collapse, not a pre-metric skip
+    assert len(hits) == 2
+    assert "BENCH_r04" in hits[0] and "BENCH_r06" in hits[1]
+    assert any("error record" in f for f in findings)
+
+
+# --------------------------------------------------------------------------
+# capture smoke (CPU): the observatory itself is tested, not just available
+# --------------------------------------------------------------------------
+
+#: Small but multi-round capture scale for tier-1 (the committed baseline
+#: is captured at the 256/8/12 smoke scale by `-m benor_tpu profile`).
+SMOKE = dict(n_nodes=32, trials=4, max_rounds=8)
+
+
+def test_capture_traced_regime_smoke():
+    report, out = capture_regime("traced", seed=0, **SMOKE)
+    assert report.regime == "traced" and report.platform == "cpu"
+    assert report.flops > 0 and report.bytes_accessed > 0
+    assert report.peak_bytes > 0 and report.temp_bytes > 0
+    assert report.backend_compiles == 1
+    assert report.trace_lower_s > 0 and report.compile_s > 0
+    assert report.first_execute_s > 0 and report.steady_execute_s > 0
+    assert report.rounds_executed == int(out[0])
+    # stage timings landed in the unified metrics registry
+    for stage in ("lower", "compile", "first_execute", "steady_execute"):
+        t = REGISTRY.timer(f"perfscope.regime.traced.{stage}")
+        assert t.count >= 1 and t.total_s > 0
+    # a single-regime manifest is schema-valid; completeness is a
+    # separate, explicit question
+    manifest = build_manifest([report], dict(seed=0, **SMOKE))
+    assert schema_tool.check_perf_manifest(manifest) == []
+    assert set(missing_regimes(manifest)) == set(REGIME_NAMES) - {"traced"}
+
+
+def test_capture_unknown_regime_rejected():
+    with pytest.raises(ValueError, match="unknown regime"):
+        capture_regime("warp_drive")
+
+
+def test_profiled_capture_bit_identical_and_cache_untouched():
+    """The flight-recorder discipline for perfscope: dispatch compiles
+    exactly once with profiling off; the out-of-band AOT capture returns
+    bit-identical outputs and leaves the dispatch cache untouched (a
+    re-dispatch recompiles nothing)."""
+    # shape distinct from every other suite pin so no jit cache is warm
+    cfg = SimConfig(n_nodes=28, n_faulty=5, trials=6, delivery="quorum",
+                    scheduler="uniform", path="histogram", max_rounds=14,
+                    seed=21)
+    faults = FaultSpec.none(cfg.trials, cfg.n_nodes)
+    state = init_state(cfg, balanced_inputs(cfg.trials, cfg.n_nodes),
+                       faults)
+    key = jax.random.key(cfg.seed)
+
+    with count_backend_compiles() as cc:
+        r0, fin0 = run_consensus(cfg, state, faults, key)
+        int(r0)
+    assert cc.count == 1, cc.count
+
+    cap = capture_stages("test.traced", run_consensus,
+                         (cfg, state, faults, key), (state, faults, key))
+    assert cap.art.backend_compiles == 1
+    r1, fin1 = cap.out
+    assert int(r0) == int(r1)
+    for leaf in ("x", "decided", "k", "killed"):
+        np.testing.assert_array_equal(np.asarray(getattr(fin0, leaf)),
+                                      np.asarray(getattr(fin1, leaf)))
+
+    with count_backend_compiles() as cc2:
+        r2, fin2 = run_consensus(cfg, state, faults, key)
+        int(r2)
+    assert cc2.count == 0, cc2.count
+    np.testing.assert_array_equal(np.asarray(fin0.x), np.asarray(fin2.x))
+
+
+def test_checkpoint_resume_unchanged_by_profiling(tmp_path):
+    """utils/checkpoint interaction (ISSUE 5 satellite): profiling a
+    resumed ``run_consensus_slice`` run changes neither its results nor
+    its dispatch compile counts, and the resumed+profiled leg stays
+    bit-identical to the uninterrupted run."""
+    from benor_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+    n, f = 30, 10
+    cfg = SimConfig(n_nodes=n, n_faulty=f, trials=6, delivery="quorum",
+                    scheduler="uniform", path="histogram", max_rounds=24,
+                    seed=6)
+    # f silent-faulty nodes leave the quorum N - F exactly met by the
+    # healthy population, whose inputs are balanced: several rounds of
+    # genuine coin-flipping before quiescence (same recipe as
+    # tests/test_checkpoint.py, smaller)
+    faults = FaultSpec.from_faulty_list(cfg, [True] * f + [False] * (n - f))
+    state = init_state(cfg, [1] * (f + 10) + [0] * 10, faults)
+    key = jax.random.key(cfg.seed)
+
+    r_full, fin_full = run_consensus(cfg, state, faults, key)
+    assert int(r_full) >= 3, "config must take several rounds"
+
+    r_cap, mid = run_consensus(cfg.replace(max_rounds=2), state, faults,
+                               key)
+    path = str(tmp_path / "ckpt.npz")
+    save_checkpoint(path, cfg, mid, faults, next_round=int(r_cap) + 1)
+    cfg2, st2, fl2, next_round, key2 = load_checkpoint(path)
+    bounds = (jnp.int32(next_round), jnp.int32(cfg.max_rounds + 2))
+
+    # unprofiled resume: the slice executable compiles once, fresh shape
+    with count_backend_compiles() as cc:
+        r_a, fin_a = run_consensus_slice(cfg2, st2, fl2, key2, *bounds)
+        int(r_a)
+    assert cc.count == 1, cc.count
+    assert int(r_a) - 1 == int(r_full)
+    np.testing.assert_array_equal(np.asarray(fin_a.x),
+                                  np.asarray(fin_full.x))
+
+    # profiled resume: out-of-band AOT capture of the SAME slice
+    # executable at the resumed operands...
+    cap = capture_stages("test.resume", run_consensus_slice,
+                         (cfg2, st2, fl2, key2) + bounds,
+                         (st2, fl2, key2) + bounds)
+    assert cap.art.backend_compiles == 1
+    np.testing.assert_array_equal(np.asarray(cap.out[1].x),
+                                  np.asarray(fin_full.x))
+
+    # ...then the dispatch resume again, under a jax.profiler trace:
+    # zero new compiles, bit-identical results, and the capture is
+    # visible in the metrics registry (satellite: utils/tracing.py)
+    from benor_tpu.utils.tracing import profile_trace
+
+    ticks0 = REGISTRY.counter("tracing.profile_capture").value
+    tb_dir = str(tmp_path / "tb")
+    with profile_trace(tb_dir) as trace_path, \
+            count_backend_compiles() as cc2:
+        r_b, fin_b = run_consensus_slice(cfg2, st2, fl2, key2, *bounds)
+        int(r_b)
+    assert cc2.count == 0, cc2.count
+    assert trace_path == tb_dir
+    assert REGISTRY.counter("tracing.profile_capture").value == ticks0 + 1
+    assert int(r_b) == int(r_a)
+    for leaf in ("x", "decided", "k", "killed"):
+        np.testing.assert_array_equal(np.asarray(getattr(fin_a, leaf)),
+                                      np.asarray(getattr(fin_b, leaf)))
+
+
+# --------------------------------------------------------------------------
+# surfaces: CLI + bench headline
+# --------------------------------------------------------------------------
+
+
+def test_cli_profile_partial_capture_json(tmp_path, capsys):
+    from benor_tpu.__main__ import main
+
+    out_path = str(tmp_path / "m.json")
+    assert main(["profile", "--regimes", "traced", "--n", "32",
+                 "--trials", "4", "--max-rounds", "8", "--format",
+                 "json", "--profile-out", out_path]) == 0
+    manifest = json.loads(capsys.readouterr().out)
+    assert manifest["kind"] == "perf_manifest"
+    assert schema_tool.check_perf_manifest(manifest) == []
+    assert list(manifest["regimes"]) == ["traced"]
+    with open(out_path) as fh:
+        assert json.load(fh) == manifest
+
+
+def test_cli_profile_rejects_unknown_regime(capsys):
+    from benor_tpu.__main__ import main
+
+    assert main(["profile", "--regimes", "warp_drive"]) == 1
+    assert "unknown regimes" in capsys.readouterr().err
+
+
+def test_cli_profile_refuses_partial_baseline(tmp_path, capsys):
+    """A --regimes subset must never become the baseline: the gate only
+    walks baseline regimes, so a partial baseline passes vacuously."""
+    from benor_tpu.__main__ import main
+
+    bp = str(tmp_path / "b.json")
+    assert main(["profile", "--regimes", "traced", "--n", "32",
+                 "--trials", "4", "--max-rounds", "8",
+                 "--baseline", bp, "--update-baseline"]) == 1
+    assert "refusing to write a partial baseline" in \
+        capsys.readouterr().err
+    assert not os.path.exists(bp)
+
+
+def test_bench_headline_gains_exactly_perf_ok():
+    """bench._split_headline routes the perfscope blob to the sidecar and
+    keeps exactly ONE new bool (perf_ok) on the stdout headline."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    blob = {"n_nodes": 4, "perfscope": {"ok": True, "manifest": {},
+                                        "regressions": []}}
+    head, detail = bench._split_headline(blob)
+    assert head["perf_ok"] is True
+    assert "perfscope" not in head and "perfscope" in detail
+    assert "perfscope" in bench._DETAIL_KEYS
+
+
+@pytest.mark.slow
+def test_full_manifest_in_band_with_committed_baseline(baseline):
+    """All five regimes captured at the committed baseline's scale gate
+    in-band — the same capture `python -m benor_tpu profile` and
+    bench.py's `_perfscope_check` run."""
+    from benor_tpu.perfscope import capture_all
+
+    scale = dict(baseline["scale"])
+    seed = scale.pop("seed")
+    reports = capture_all(seed=seed, **scale)
+    manifest = build_manifest(reports, dict(seed=seed, **scale))
+    assert schema_tool.check_perf_manifest(manifest) == []
+    assert missing_regimes(manifest) == []
+    assert compare_manifests(manifest, baseline) == []

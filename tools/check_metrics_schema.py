@@ -25,9 +25,12 @@ Run as a script over a committed capture (exit 0 = pass):
 or import from tests (tests/test_metrics_schema.py keeps this in tier-1,
 so a key that would re-trigger the truncation fails the suite before it
 ever reaches a driver run).  The script auto-detects the document kind:
-bench detail record, witness bundle (audit.save_bundle), or benorlint
+bench detail record, witness bundle (audit.save_bundle), benorlint
 JSON report (``python -m benor_tpu lint --format json`` — validated by
-``check_lint_report`` against the inline ``LINT_REPORT_SCHEMA``).
+``check_lint_report`` against the inline ``LINT_REPORT_SCHEMA``), or
+perfscope manifest (``python -m benor_tpu profile`` /
+``PERF_BASELINE.json``, tagged ``kind: perf_manifest`` — validated by
+``check_perf_manifest`` against ``tools/perf_report_schema.json``).
 """
 
 from __future__ import annotations
@@ -154,6 +157,52 @@ def check_lint_report(report: dict) -> List[str]:
     return errors
 
 
+PERF_SCHEMA_PATH = os.path.join(HERE, "perf_report_schema.json")
+
+
+def check_perf_manifest(manifest: dict,
+                        schema_path: str = PERF_SCHEMA_PATH) -> List[str]:
+    """Validate a perfscope manifest (`python -m benor_tpu profile`,
+    PERF_BASELINE.json, bench.py's perfscope sidecar blob) against
+    tools/perf_report_schema.json; returns the error list (empty = ok).
+
+    ``regimes`` is keyed by regime name — a dynamic key set the subset
+    validator cannot express — so each value is validated here against
+    the schema file's ``regime_report`` entry, plus the cross-field
+    facts the regression gate relies on: every report's ``regime`` key
+    must match its map key, its platform must match the manifest's, and
+    the memory footprint identity peak = arg + out + temp - alias must
+    hold (a drifted peak_bytes would silently skew the gate's widest
+    band)."""
+    errors: List[str] = []
+    with open(schema_path) as fh:
+        schema = json.load(fh)
+    _validate(manifest, schema, "$", errors)
+    if errors:
+        return errors
+    report_schema = schema["regime_report"]
+    for name, rep in manifest["regimes"].items():
+        path = f"$.regimes.{name}"
+        before = len(errors)
+        _validate(rep, report_schema, path, errors)
+        if len(errors) > before:
+            # cross-field checks only on THIS regime's schema errors —
+            # another regime's failure must not mask this one's drift
+            continue
+        if rep["regime"] != name:
+            errors.append(f"{path}: regime key {name!r} but report says "
+                          f"{rep['regime']!r}")
+        if rep["platform"] != manifest["platform"]:
+            errors.append(f"{path}: platform {rep['platform']!r} != "
+                          f"manifest {manifest['platform']!r}")
+        peak = (rep["argument_bytes"] + rep["output_bytes"]
+                + rep["temp_bytes"] - rep["alias_bytes"])
+        if rep["peak_bytes"] != peak:
+            errors.append(f"{path}: peak_bytes {rep['peak_bytes']} != "
+                          f"arg+out+temp-alias {peak}")
+    return errors
+
+
 WITNESS_SCHEMA_PATH = os.path.join(HERE, "witness_bundle_schema.json")
 
 
@@ -226,6 +275,14 @@ def main(argv=None) -> int:
         for e in errors:
             print(f"FAIL {e}", file=sys.stderr)
         print(f"{os.path.basename(path)}: witness bundle "
+              f"{'OK' if not errors else 'INVALID'}")
+        return 1 if errors else 0
+    if detail.get("kind") == "perf_manifest":
+        # a perfscope manifest (profile CLI / PERF_BASELINE.json)
+        errors = check_perf_manifest(detail)
+        for e in errors:
+            print(f"FAIL {e}", file=sys.stderr)
+        print(f"{os.path.basename(path)}: perf manifest "
               f"{'OK' if not errors else 'INVALID'}")
         return 1 if errors else 0
     if "rules_run" in detail and "findings" in detail:
