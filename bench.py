@@ -77,7 +77,7 @@ _DETAIL_KEYS = ("curve", "pallas_check", "pallas_hist_check",
                 "pallas_equiv_check", "pallas_weak_coin_check",
                 "pallas_round_check", "pallas_demoted",
                 "batched_sweep_check", "flight_recorder", "perfscope",
-                "lint")
+                "meshscope", "lint")
 
 
 def _split_headline(out: dict) -> tuple[dict, dict]:
@@ -120,6 +120,12 @@ def _split_headline(out: dict) -> tuple[dict, dict]:
         # in-band vs the committed baseline (when comparable); the full
         # per-regime PerfReports live in the sidecar's perfscope blob
         head["perf_ok"] = bool(ps.get("ok"))
+    ms = out.get("meshscope")
+    if isinstance(ms, dict):
+        # ONE compact bool: scaling manifest schema-valid + no straggler
+        # trip + in-band vs SCALING_BASELINE.json when comparable; the
+        # manifest itself lives in the sidecar's meshscope blob
+        head["scaling_ok"] = bool(ms.get("ok"))
     head["detail_file"] = "BENCH_DETAIL.json"
     return head, detail
 
@@ -1045,6 +1051,14 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
     log(f"bench: perfscope check ok={perfscope_check.get('ok')} "
         f"regressions={len(perfscope_check.get('regressions', []))} "
         f"baseline_comparable={perfscope_check.get('baseline_comparable')}")
+    try:
+        meshscope_check = _meshscope_check()
+    except Exception as e:  # noqa: BLE001 — accounting must not kill the run
+        meshscope_check = {"ok": False,
+                           "error": f"{type(e).__name__}: {e}"}
+    log(f"bench: meshscope check ok={meshscope_check.get('ok')} "
+        f"straggler_max={meshscope_check.get('straggler_max')} "
+        f"baseline_comparable={meshscope_check.get('baseline_comparable')}")
 
     total_trials = trials * len(regimes)
     log(f"bench: sweep elapsed {elapsed:.2f}s for {total_trials} trials; "
@@ -1098,6 +1112,7 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
         "batched_sweep_check": batched_check,
         "flight_recorder": recorder_check,
         "perfscope": perfscope_check,
+        "meshscope": meshscope_check,
         "pallas_demoted": demoted,
     }
 
@@ -1224,6 +1239,74 @@ def _perfscope_check() -> dict:
     blob["baseline_comparable"] = comparable
     blob["regressions"] = [r.to_dict() for r in regressions]
     blob["ok"] = not missing and nonzero and not regressions
+    return blob
+
+
+def _meshscope_check() -> dict:
+    """The runtime/scaling observatory (benor_tpu/meshscope): run a
+    small scaling ladder over whatever devices this capture actually
+    has (1 rung on a single chip, 1+2 when a mesh is available), emit
+    the pinned-schema scaling manifest into the sidecar blob, and
+    reduce it to the ``scaling_ok`` headline bool: manifest
+    schema-valid (tools/scaling_manifest_schema.json, loaded by file
+    path — the checker must not drift from CI's) + no straggler trip
+    (max/median per-shard step time under scalegate.STRAGGLER_TRIP) +
+    in-band vs the committed SCALING_BASELINE.json when the rung sets
+    are comparable (a single-chip smoke vs the 3-rung CPU baseline is
+    honestly reported incomparable, not silently passed)."""
+    import importlib.util
+
+    import jax
+
+    from benor_tpu.meshscope import (STRAGGLER_TRIP, IncomparableScaling,
+                                     build_scaling_manifest,
+                                     compare_scaling,
+                                     load_scaling_manifest,
+                                     run_scaling_ladder)
+
+    sizes = [1] + ([2] if len(jax.devices()) >= 2 else [])
+    rows, scale = run_scaling_ladder(sizes)
+    manifest = build_scaling_manifest(rows, "weak", "nodes", scale)
+    spec = importlib.util.spec_from_file_location(
+        "_check_metrics_schema",
+        os.path.join(HERE, "tools", "check_metrics_schema.py"))
+    cms = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cms)
+    schema_errors = cms.check_scaling_manifest(manifest)
+    straggler_max = max(r["straggler_ratio"] for r in rows)
+    blob = {
+        "manifest": manifest,
+        "schema_errors": schema_errors,
+        "straggler_max": straggler_max,
+        "straggler_trip": STRAGGLER_TRIP,
+    }
+    regressions = []
+    comparable = None
+    baseline_path = os.path.join(HERE, "SCALING_BASELINE.json")
+    if os.path.exists(baseline_path):
+        try:
+            base = load_scaling_manifest(baseline_path)
+            base_rungs = {(r["devices"], r["n_nodes"])
+                          for r in base.get("rows", [])}
+            new_rungs = {(r["devices"], r["n_nodes"]) for r in rows}
+            if base_rungs <= new_rungs:
+                regressions = [f.to_dict()
+                               for f in compare_scaling(manifest, base)]
+                comparable = True
+            else:
+                comparable = False
+                blob["baseline_note"] = (
+                    f"smoke ladder rungs {sorted(new_rungs)} do not "
+                    f"cover the baseline's {sorted(base_rungs)}")
+        except (IncomparableScaling, ValueError) as e:
+            comparable = False
+            blob["baseline_note"] = f"{e}"
+    else:
+        blob["baseline_note"] = "no committed SCALING_BASELINE.json"
+    blob["baseline_comparable"] = comparable
+    blob["regressions"] = regressions
+    blob["ok"] = (not schema_errors and straggler_max < STRAGGLER_TRIP
+                  and not regressions)
     return blob
 
 

@@ -15,6 +15,20 @@ Subcommands:
                                                      the Ben-Or invariants
                                                      (benor_tpu/audit.py),
                                                      dump the bundle
+  scale  --mesh 1,2,4 [--mode weak|strong]           weak/strong scaling
+         [--profile-out scaling.json]                ladders across mesh
+                                                     shapes -> pinned-
+                                                     schema scaling
+                                                     manifest + baseline
+                                                     gate (benor_tpu/
+                                                     meshscope); exit 2
+                                                     on regression
+  watch  PATH [--poll 0.2] [--timeout 60]            tail a running
+                                                     sweep's heartbeat
+                                                     file (live rounds/s,
+                                                     decided fraction,
+                                                     ETA); no backend
+                                                     touched
   preset NAME                                        a BASELINE.json config
   lint   [--format json|text] [--root DIR]           benorlint static
                                                      analysis over the
@@ -37,6 +51,23 @@ import sys
 import time
 
 import numpy as np
+
+
+def _mesh_sizes(spec: str):
+    """argparse type= for --mesh: '1,2,4' -> [1, 2, 4], rejecting
+    malformed rungs with a usage error instead of a raw ValueError
+    traceback (the value is consumed twice: the pre-dispatch
+    device-count widening in main() and the ladder itself in _scale)."""
+    import argparse
+    try:
+        sizes = [int(x) for x in spec.split(",")]
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"--mesh expects comma-separated device counts, got {spec!r}")
+    if not sizes or any(s < 1 for s in sizes):
+        raise argparse.ArgumentTypeError(
+            f"--mesh rungs must be >= 1, got {spec!r}")
+    return sizes
 
 
 def _honor_platform_env() -> None:
@@ -174,7 +205,16 @@ def _sweep(args) -> int:
                     max_rounds=args.max_rounds, delivery="quorum",
                     scheduler=args.scheduler, coin_mode=args.coin,
                     fault_model=args.fault_model, seed=args.seed,
-                    record=args.record, **flags)
+                    record=args.record,
+                    heartbeat_rounds=args.heartbeat_rounds, **flags)
+    if args.heartbeat_rounds and not args.batched:
+        # the per-point path runs each point as one uninterrupted
+        # compiled loop — there is no boundary to beat at; a silent
+        # no-op would fake live progress (the house rule)
+        print("warning: --heartbeat-rounds only publishes on the "
+              "batched engine (per bucket); add --batched, or use "
+              "`trace`/poll_rounds for per-round liveness",
+              file=sys.stderr)
     mode = "balanced/no-crash" if args.balanced else "iid/crash"
     fb = " [cpu fallback]" if FELL_BACK else ""
     # banner reports the compute path actually taken, not the request:
@@ -213,7 +253,8 @@ def _sweep(args) -> int:
 
         if args.batched:
             cb = run_curve_batched(cfg, f_values, initial_values=bal,
-                                   faults_for=faults_for, verbose=True)
+                                   faults_for=faults_for, verbose=True,
+                                   heartbeat_path=args.heartbeat_out)
             points = cb.points
         else:
             points = []
@@ -228,7 +269,8 @@ def _sweep(args) -> int:
                   f"{pt.trials_per_sec:.1f} trials/s", flush=True)
     elif args.batched:
         from .sweep import rounds_vs_f_batched
-        points = rounds_vs_f_batched(cfg, f_values)
+        points = rounds_vs_f_batched(cfg, f_values,
+                                     heartbeat_path=args.heartbeat_out)
     else:
         points = rounds_vs_f(cfg, f_values)
     from .utils.metrics import REGISTRY
@@ -508,6 +550,120 @@ def _profile(args) -> int:
     return 0
 
 
+def _scale(args) -> int:
+    """Scaling-efficiency capture (benor_tpu/meshscope/scaling.py): run
+    weak-/strong-scaling ladders of the sharded regime across mesh
+    shapes, emit the pinned-schema ``kind: scaling_manifest`` document
+    (tools/scaling_manifest_schema.json) with per-shape throughput,
+    efficiency vs the 1-device rung and the straggler ratio, and gate it
+    against the committed SCALING_BASELINE.json
+    (meshscope/scalegate.py): exit 2 on a scaling regression or
+    straggler trip, 0 otherwise."""
+    from .meshscope import (IncomparableScaling, build_scaling_manifest,
+                            compare_scaling, load_scaling_manifest,
+                            run_scaling_ladder, save_scaling_manifest)
+
+    sizes = args.mesh
+    import jax
+    have = len(jax.devices())
+    if max(sizes) > have:
+        print(f"mesh ladder needs {max(sizes)} devices, have {have} — "
+              f"on CPU set XLA_FLAGS=--xla_force_host_platform_"
+              f"device_count={max(sizes)} (before jax initializes)",
+              file=sys.stderr)
+        return 1
+    rows, scale = run_scaling_ladder(
+        sizes, mode=args.mode, axis=args.axis, n_nodes=args.n,
+        trials=args.trials, max_rounds=args.max_rounds, seed=args.seed,
+        reps=args.reps, verbose=args.format == "text")
+    manifest = build_scaling_manifest(rows, args.mode, args.axis, scale)
+    fb = " [cpu fallback]" if FELL_BACK else ""
+    if args.format == "json":
+        print(json.dumps(manifest, indent=1))
+    else:
+        print(f"meshscope scale: {manifest['platform']} "
+              f"({manifest['device_kind']}), {args.mode} ladder on the "
+              f"{args.axis} axis, rungs {sizes}{fb}")
+        for r in rows:
+            print(f"  d={r['devices']}: N={r['n_nodes']} "
+                  f"T={r['trials']} rounds={r['rounds']} "
+                  f"{r['node_rounds_per_sec']:.4g} node-rounds/s "
+                  f"efficiency={r['efficiency']} "
+                  f"straggler={r['straggler_ratio']:.2f}")
+    if args.profile_out:
+        save_scaling_manifest(args.profile_out, manifest)
+        print(f"wrote scaling manifest to {args.profile_out}",
+              file=sys.stderr)
+    _export_metrics(args.metrics_out)
+
+    baseline_path = args.baseline or os.path.join(_repo_root(),
+                                                  "SCALING_BASELINE.json")
+    if args.update_baseline:
+        save_scaling_manifest(baseline_path, manifest)
+        print(f"re-baselined {baseline_path}", file=sys.stderr)
+        return 0
+    if not os.path.exists(baseline_path):
+        print(f"no baseline at {baseline_path} — capture-only run "
+              f"(--update-baseline to create one)", file=sys.stderr)
+        return 0
+    try:
+        findings = compare_scaling(manifest,
+                                   load_scaling_manifest(baseline_path))
+    except (IncomparableScaling, ValueError) as e:
+        print(f"baseline {baseline_path} not comparable: {e}",
+              file=sys.stderr)
+        return 0
+    for f in findings:
+        print(f"REGRESSION: {f.message}", file=sys.stderr)
+    if findings:
+        return 2
+    print(f"scaling gate: in-band vs {baseline_path}", file=sys.stderr)
+    return 0
+
+
+def _watch(args) -> int:
+    """Tail a running sweep's heartbeat file (meshscope's live progress
+    plane): print each new heartbeat record — rounds/sec, decided
+    fraction, ETA — as it is appended, stopping on the run's
+    ``done: true`` record, on --no-follow after one pass, or after
+    --timeout seconds of silence.  Pure host-side tail: never touches a
+    JAX backend.  Exit 0 once at least one record was seen, 1 on a
+    silent timeout (nothing to watch)."""
+    from .meshscope.heartbeat import tail_heartbeats
+
+    seen = 0
+    for rec in tail_heartbeats(args.path, poll_s=args.poll,
+                               timeout_s=args.timeout,
+                               follow=not args.no_follow):
+        seen += 1
+        bits = [f"[{rec.get('label', '?')}]"]
+        if rec.get("round") is not None:
+            bits.append(f"round={rec['round']}/{rec.get('max_rounds')}")
+        if rec.get("points_done") is not None:
+            bits.append(f"points={rec['points_done']}"
+                        f"/{rec.get('points_total')}")
+        if rec.get("rounds_per_sec") is not None:
+            bits.append(f"{rec['rounds_per_sec']:.3g} rounds/s")
+        if rec.get("decided_frac") is not None:
+            bits.append(f"decided={rec['decided_frac']:.3f}")
+        if rec.get("eta_s") is not None:
+            bits.append(f"eta={rec['eta_s']:.1f}s")
+        if rec.get("progress") is not None:
+            bits.append(f"{100 * rec['progress']:.0f}%")
+        if rec.get("done"):
+            bits.append("DONE")
+        print(" ".join(bits), flush=True)
+        if args.max_updates and seen >= args.max_updates:
+            break
+    if not seen:
+        print(f"watch: no heartbeat records in {args.path} within "
+              f"{args.timeout}s (is the run armed with "
+              f"heartbeat_rounds and a heartbeat path?)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
 def _preset(args) -> int:
     from .sweep import baseline_configs, run_point
     cfgs = baseline_configs()
@@ -561,6 +717,15 @@ def main(argv=None) -> int:
                         "instead of one per f value (bit-identical "
                         "summaries; see sweep.run_curve_batched)")
     s.add_argument("--out", help="write points to this JSON file")
+    s.add_argument("--heartbeat-out", metavar="PATH",
+                   help="with --batched and a heartbeat cadence "
+                        "(SimConfig.heartbeat_rounds via "
+                        "--heartbeat-rounds), append live-progress "
+                        "records here for `python -m benor_tpu watch`")
+    s.add_argument("--heartbeat-rounds", type=int, default=0,
+                   help="arm the live progress plane at this round "
+                        "cadence (0 = off); the batched engine beats "
+                        "per bucket")
 
     c = sub.add_parser("coins", help="private vs common coin, adversarial")
     c.add_argument("--n", type=int, default=100)
@@ -699,6 +864,63 @@ def main(argv=None) -> int:
                          "registry's counter tracks next to it")
     _add_obs_args(pf, record=False)
 
+    sc = sub.add_parser("scale",
+                        help="weak/strong scaling ladders across mesh "
+                             "shapes -> pinned-schema scaling manifest "
+                             "+ baseline gate (benor_tpu/meshscope); "
+                             "exit 2 on scaling regression")
+    sc.add_argument("--mesh", default="1,2,4", type=_mesh_sizes,
+                    help="comma-separated device counts, one ladder "
+                         "rung each; MUST include 1 (efficiency is "
+                         "measured vs the single-device rung)")
+    sc.add_argument("--mode", choices=("weak", "strong"), default="weak",
+                    help="weak: the sharded axis's problem size grows "
+                         "with the rung; strong: fixed problem spread "
+                         "thinner")
+    sc.add_argument("--axis", choices=("nodes", "trials"),
+                    default="nodes",
+                    help="which mesh axis the ladder grows (nodes = "
+                         "the ICI psum leg, trials = data parallel)")
+    sc.add_argument("--n", type=int, default=None,
+                    help="base nodes per rung (default: the CPU-smoke "
+                         "scale in meshscope/scaling.py)")
+    sc.add_argument("--trials", type=int, default=None)
+    sc.add_argument("--max-rounds", type=int, default=None)
+    sc.add_argument("--seed", type=int, default=0)
+    sc.add_argument("--reps", type=int, default=2,
+                    help="steady-state executions averaged per rung")
+    sc.add_argument("--format", choices=("text", "json"), default="text")
+    sc.add_argument("--profile-out", metavar="PATH",
+                    help="write the scaling manifest to this JSON file "
+                         "(kind: scaling_manifest, schema-pinned by "
+                         "tools/scaling_manifest_schema.json)")
+    sc.add_argument("--baseline", metavar="PATH", default=None,
+                    help="baseline manifest to gate against (default: "
+                         "the committed SCALING_BASELINE.json)")
+    sc.add_argument("--update-baseline", action="store_true",
+                    help="write this capture as the new baseline "
+                         "instead of gating against it")
+    _add_obs_args(sc, record=False)
+
+    w = sub.add_parser("watch",
+                       help="tail a running sweep's heartbeat file "
+                            "(live rounds/sec, decided fraction, ETA); "
+                            "no JAX backend touched")
+    w.add_argument("path", help="heartbeat JSON-lines file (sweep "
+                                "--heartbeat-out / TpuNetwork."
+                                "heartbeat_path)")
+    w.add_argument("--poll", type=float, default=0.2,
+                   help="poll interval in seconds (default 0.2)")
+    w.add_argument("--timeout", type=float, default=60.0,
+                   help="give up after this many seconds without a new "
+                        "record (default 60)")
+    w.add_argument("--max-updates", type=int, default=0,
+                   help="stop after printing this many records "
+                        "(0 = until done/timeout)")
+    w.add_argument("--no-follow", action="store_true",
+                   help="print what is in the file now and exit "
+                        "instead of tailing")
+
     r = sub.add_parser("results",
                        help="generate RESULTS/ (curves + presets artifact)")
     r.add_argument("--out", default="RESULTS")
@@ -715,9 +937,22 @@ def main(argv=None) -> int:
     # bare `python -m benor_tpu [-n N -f F ...]` == the start.ts demo
     if not argv or argv[0] not in ("demo", "sweep", "coins", "preset",
                                    "results", "trace", "audit", "lint",
-                                   "profile", "-h", "--help"):
+                                   "profile", "scale", "watch",
+                                   "-h", "--help"):
         argv = ["demo"] + argv
     args = ap.parse_args(argv)
+    if args.cmd == "scale":
+        # a CPU mesh ladder needs max(--mesh) virtual devices; the
+        # host-platform device count is honored until the CPU backend
+        # first INITIALIZES (importing jax is fine — nothing before this
+        # point touches a device), so widen it here when the operator
+        # has not already pinned it
+        want = max(args.mesh)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{max(want, 1)}").strip()
     _honor_platform_env()
     if getattr(args, "metrics_out", None) and args.cmd != "lint":
         # feed the unified registry's compile counters from the first
@@ -726,15 +961,17 @@ def main(argv=None) -> int:
         # analyzer's no-jax contract must hold with --metrics-out too.
         from .utils.compile_counter import install
         install()
-    # the event-loop oracle backends and the (pure-AST) linter never
-    # touch a JAX backend — don't spend a probe (or a fallback) on them
-    if not (args.cmd == "lint" or
+    # the event-loop oracle backends, the (pure-AST) linter and the
+    # (pure-tail) watcher never touch a JAX backend — don't spend a
+    # probe (or a fallback) on them
+    if not (args.cmd in ("lint", "watch") or
             (args.cmd == "demo" and args.backend in ("express", "native"))):
         _ensure_live_backend()
     return {"demo": _demo, "sweep": _sweep, "coins": _coins,
             "preset": _preset, "results": _results,
             "trace": _trace, "audit": _audit, "lint": _lint,
-            "profile": _profile}[args.cmd](args)
+            "profile": _profile, "scale": _scale,
+            "watch": _watch}[args.cmd](args)
 
 
 if __name__ == "__main__":

@@ -53,6 +53,13 @@ PARITY_ALLOWLIST = {
     ("max_rounds", "parallel/multihost.py"):
         "the round loop (and its cap) lives in sharded._local_slice; "
         "multihost only builds global inputs and dispatches to it",
+    ("heartbeat_rounds", "ops/pallas_round.py"):
+        "the heartbeat (meshscope/heartbeat.py) publishes HOST-side at "
+        "slice boundaries, from buffers the slice already returns; the "
+        "fused kernels can never see the cadence — run_packed_slice's "
+        "callers (sim.run_consensus_slice, sharded._local_slice) own "
+        "the boundary, and the sharded/multihost wrappers plus the "
+        "sweep engine all reference the field themselves",
 }
 
 

@@ -9,6 +9,13 @@ node, backed by EITHER network backend's device/oracle state:
     GET /stop      200 "killed"                       node.ts:191-194
     GET /getState  200 NodeState JSON                 node.ts:197-199
 
+plus one framework-native route with no reference counterpart:
+
+    GET /getRoundHistory?since_round=N   200 {"rows": [...], "cursor": r}
+        — the flight recorder's cursor-based incremental feed
+        (SimConfig(record=True); grows live under poll_rounds; see
+        _get_round_history and README Observability / meshscope)
+
 Semantics notes:
   * The reference runs consensus *concurrently* with polling; here the
     first /start on any node runs the network to termination (the compiled
@@ -67,21 +74,68 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(data)
 
     def do_GET(self):
+        from urllib.parse import parse_qs, urlsplit
         net, nid = self.network, self.node_id
-        if self.path == "/status":
+        route = urlsplit(self.path)
+        if route.path == "/status":
             body, code = net.status(nid)
             self._send(code, body, as_json=False)
-        elif self.path == "/start":
+        elif route.path == "/start":
             with self.start_lock:          # idempotent network-level start
                 net.start()
             self._send(200, {"message": "Algorithm started"}, as_json=True)
-        elif self.path == "/stop":
+        elif route.path == "/stop":
             net.stop_node(nid)
             self._send(200, "killed", as_json=False)
-        elif self.path == "/getState":
+        elif route.path == "/getState":
             self._send(200, net.get_state(nid), as_json=True)
+        elif route.path == "/getRoundHistory":
+            self._get_round_history(parse_qs(route.query))
         else:
             self._send(404, {"error": f"no route {self.path}"}, as_json=True)
+
+    def _get_round_history(self, query) -> None:
+        """GET /getRoundHistory[?since_round=N] — the flight recorder's
+        cursor-based incremental feed (meshscope's live progress plane;
+        not a reference route, so it sits OUTSIDE the four parity routes
+        above).  ``since_round`` is the last round the poller has seen:
+        only strictly newer rows return, each carrying its true round
+        index, plus ``cursor`` = the highest round in this response (or
+        the request's cursor when nothing new) to pass back next poll.
+        Under SimConfig(poll_rounds=c) the history grows between slices,
+        so a polling client streams the run round by round without
+        re-downloading the whole buffer.  405 on backends without a
+        flight recorder (the event-loop oracles), 400 when the recorder
+        is off (SimConfig(record=False)) or the cursor is malformed.
+        """
+        net = self.network
+        if not hasattr(net, "get_round_history"):
+            self._send(405, {
+                "error": "round history not supported on this backend",
+                "detail": "the flight recorder fills inside the tpu "
+                          "backend's compiled loop; the event-loop "
+                          "oracles have no device buffer to serve "
+                          "(see README Observability)",
+            }, as_json=True, extra_headers=(("Allow", "GET"),))
+            return
+        since = None
+        raw = query.get("since_round")
+        if raw:
+            try:
+                since = int(raw[0])
+            except (TypeError, ValueError):
+                self._send(400, {"error": "since_round must be an "
+                                          "integer round index"},
+                           as_json=True)
+                return
+        try:
+            rows = net.get_round_history(since_round=since)
+        except ValueError as e:        # recorder off (record=False)
+            self._send(400, {"error": str(e)}, as_json=True)
+            return
+        cursor = rows[-1]["round"] if rows else (since if since is not None
+                                                 else -1)
+        self._send(200, {"rows": rows, "cursor": cursor}, as_json=True)
 
     def _drain_best_effort(self, cap: int = 1 << 20) -> None:
         """Read whatever body bytes are ALREADY in flight before responding:

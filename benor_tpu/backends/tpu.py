@@ -29,16 +29,32 @@ from ..sim import run_consensus
 from ..state import FaultSpec, NetState, init_state, observable_state
 
 
+def _decided_frac(state: NetState) -> Optional[float]:
+    """Decided fraction over decided + LIVE undecided lanes — the same
+    classes the flight recorder counts (state.recorder_snapshot_row), so
+    the heartbeat's decided_frac does not change meaning with
+    cfg.record: killed lanes never sit in the denominator."""
+    decided = np.asarray(state.decided)
+    undec = int((~decided & ~np.asarray(state.killed)).sum())
+    d = int(decided.sum())
+    return d / (d + undec) if (d + undec) else None
+
+
 class TpuNetwork:
     """One simulated network (all trials of it) behind the parity API."""
 
     def __init__(self, cfg: SimConfig, initial_values, faulty_list,
-                 crash_rounds=None):
+                 crash_rounds=None, heartbeat_path: Optional[str] = None):
         # Validation order and messages mirror launchNodes.ts:10-13.
         if len(initial_values) != len(faulty_list) or \
                 cfg.n_nodes != len(initial_values):
             raise ValueError("Arrays don't match")
         self.cfg = cfg
+        #: Optional JSON-lines file the live-progress heartbeat
+        #: (cfg.heartbeat_rounds; meshscope/heartbeat.py) appends to —
+        #: what `python -m benor_tpu watch` tails.  Registry gauges are
+        #: fed regardless; assignable after construction too.
+        self.heartbeat_path = heartbeat_path
         self.faults = FaultSpec.from_faulty_list(cfg, faulty_list,
                                                  crash_rounds)
         self.state: NetState = init_state(cfg, initial_values, self.faults)
@@ -108,9 +124,12 @@ class TpuNetwork:
                                                      self.faults, mesh)
 
                 def slice_fn(st, r, until, rec, wit):
+                    # heartbeat=False: this loop runs its OWN publisher
+                    # below (it also owns the file plane) — the slice
+                    # wrapper must not double-publish the same beat.
                     return run_consensus_slice_sharded(
                         self.cfg, st, faults_sh, base_key, mesh, r, until,
-                        recorder=rec, witness=wit)
+                        recorder=rec, witness=wit, heartbeat=False)
             else:
                 def slice_fn(st, r, until, rec, wit):
                     return run_consensus_slice(
@@ -118,6 +137,15 @@ class TpuNetwork:
                         jnp.int32(r), jnp.int32(until), rec, wit)
             state = start_state(self.cfg, self.state)
             self.state = state               # k=1 visible (node.ts:172)
+            heartbeat = None
+            if self.cfg.heartbeat_rounds:
+                # live progress plane (meshscope): host-side beats from
+                # the slice boundary — the compiled slice is untouched
+                from ..meshscope.heartbeat import HeartbeatPublisher
+                from ..sim import heartbeat_due
+                heartbeat = HeartbeatPublisher(
+                    self.cfg, path=self.heartbeat_path,
+                    label=f"net N={self.cfg.n_nodes}")
             r, rec, wit = 1, None, None
             while True:
                 out = slice_fn(state, r, r + self.cfg.poll_rounds, rec,
@@ -135,12 +163,31 @@ class TpuNetwork:
                 if on_slice is not None:
                     on_slice()
                 rn = int(r_next)             # host sync: slice completed
+                if heartbeat is not None and heartbeat_due(self.cfg,
+                                                           r - 1, rn - 1):
+                    heartbeat.publish(
+                        rn - 1, recorder=rec,
+                        decided_frac=(None if record else
+                                      _decided_frac(state)))
                 if (rn == r or rn > self.cfg.max_rounds
                         or bool(np.asarray(all_settled(state)))):
                     break
                 r = rn
+            if heartbeat is not None:
+                heartbeat.close(rn - 1, recorder=rec)
             self.rounds_executed = rn - 1
         else:
+            heartbeat = None
+            if self.cfg.heartbeat_rounds:
+                # One-shot run (poll_rounds=0): there are no slice
+                # boundaries to beat from, but a silent no-op would leave
+                # `watch` blocked on an empty file forever — publish the
+                # single honest record the run has: its final state
+                # (rate state starts here, before the compiled run).
+                from ..meshscope.heartbeat import HeartbeatPublisher
+                heartbeat = HeartbeatPublisher(
+                    self.cfg, path=self.heartbeat_path,
+                    label=f"net N={self.cfg.n_nodes}")
             if self.cfg.mesh_shape is not None:
                 from ..parallel import make_mesh, run_consensus_sharded
                 mesh = make_mesh(*self.cfg.mesh_shape)
@@ -157,6 +204,9 @@ class TpuNetwork:
                 idx += 1
             if witness:
                 self._witness = out[idx]
+            if heartbeat is not None:
+                heartbeat.close(self.rounds_executed,
+                                recorder=self._recorder)
         self._started = True
 
     # -- /stop (consensus.ts:10-15 -> node.ts:191-194) -------------------
@@ -177,13 +227,22 @@ class TpuNetwork:
                                 node_id, trial)
 
     # -- flight recorder (cfg.record) -------------------------------------
-    def get_round_history(self) -> List[dict]:
+    def get_round_history(self,
+                          since_round: Optional[int] = None) -> List[dict]:
         """Per-round telemetry rows next to /getState (one dict per row,
         state.REC_COLUMNS keys plus "round") — the observable surface of
         the flight recorder.  Requires SimConfig(record=True); before
         start() the history is just the row-0 snapshot-to-come (empty
         list).  Under poll_rounds the history grows live between slices,
         so a concurrent poller watches decide velocity round by round.
+
+        ``since_round`` is the incremental CURSOR (served over HTTP as
+        GET /getRoundHistory?since_round=N): only rows with a STRICTLY
+        greater round index return, so a poller passing the last round
+        it has seen receives exactly the new rows — an empty list when
+        the cursor sits at or past the end, and (because rows key on
+        their TRUE round index) the post-gap rows when the cursor falls
+        inside a fresh-buffer resume's unwritten gap.
         """
         if not self.cfg.record:
             raise ValueError(
@@ -195,7 +254,8 @@ class TpuNetwork:
         from ..utils.metrics import round_history_rows
         if self._recorder is None:
             return []
-        return round_history_rows(np.asarray(self._recorder))
+        return round_history_rows(np.asarray(self._recorder),
+                                  since_round=since_round)
 
     # -- witness trace (cfg.witness) ---------------------------------------
     def get_witness(self) -> List[dict]:

@@ -221,6 +221,21 @@ class SimConfig:
     # is static, so the recorder never enters the trace).
     record: bool = False
 
+    # --- live progress plane (benor_tpu/meshscope/heartbeat.py) ----------
+    # heartbeat_rounds = h > 0: long sliced runs (TpuNetwork.start under
+    # poll_rounds, the sharded/multihost slice wrappers) publish a
+    # HOST-SIDE heartbeat — rounds/sec, decided fraction (from the
+    # flight recorder when cfg.record), ETA — every time the round
+    # cursor crosses a multiple of h, into the unified metrics registry
+    # (heartbeat.* gauges) and, when the driver supplies a path, an
+    # append-only JSON-lines file `python -m benor_tpu watch` tails.
+    # The batched sweep engine beats per bucket instead (its unit of
+    # progress).  Purely host-side: the knob never enters a trace, so
+    # heartbeat on AND off are bit-identical in results and compile
+    # counts (tests/test_meshscope.py pins it — the same discipline as
+    # ``record``).  0 (default) = off.
+    heartbeat_rounds: int = 0
+
     # --- witness traces (per-node forensics; see benor_tpu/audit.py) -----
     # witness_trials=(t0, t1, ...) + witness_nodes=k arm the WITNESS
     # recorder: a preallocated [max_rounds + 1, W, k, state.WIT_WIDTH]
@@ -302,6 +317,14 @@ class SimConfig:
                 "scheduler='uniform'")
         if self.poll_rounds < 0:
             raise ValueError("poll_rounds must be >= 0")
+        if self.heartbeat_rounds < 0:
+            raise ValueError("heartbeat_rounds must be >= 0")
+        if self.heartbeat_rounds and self.backend != "tpu":
+            raise ValueError(
+                "heartbeat_rounds publishes between the tpu backend's "
+                "compiled slices; the event-loop oracles run to "
+                "termination in one drain — a silent no-op would fake "
+                "live progress, so use backend='tpu'")
         if self.poll_rounds and self.backend != "tpu":
             raise ValueError(
                 "poll_rounds slices the tpu backend's compiled loop; the "

@@ -1,0 +1,56 @@
+"""meshscope — live runtime & multichip scaling observatory (ISSUE 6).
+
+Perfscope (benor_tpu/perfscope) observes the system BEFORE it runs: AOT
+stage timings, the XLA cost model, the roofline.  Meshscope observes it
+WHILE it runs, and across mesh shapes:
+
+  telemetry  per-shard runtime telemetry — steady-state step wall-time,
+             live device-memory watermarks (memory_stats / live-array
+             sums), psum/collective byte attribution derived from the
+             declarative layout tables (state.REC_LAYOUT / WIT_LAYOUT,
+             pallas_round.PARTIAL_COLS), and straggler/imbalance
+             detection (max/median shard step-time ratio) with a
+             Perfetto per-shard track export.
+  scaling    weak-/strong-scaling ladders across mesh shapes -> a
+             pinned-schema ``kind: scaling_manifest`` document
+             (tools/scaling_manifest_schema.json), gated against the
+             committed SCALING_BASELINE.json by
+             tools/check_scaling_regression.py (exit 0/2/3).
+  heartbeat  the live progress plane — long sliced runs and batched
+             sweeps publish rounds/sec, decided fraction and an ETA
+             between slices (registry gauges + an append-only JSON-lines
+             file the ``python -m benor_tpu watch`` CLI tails).
+  scalegate  the stdlib-only band comparator behind the scaling gate
+             (file-path-loaded by tools/check_scaling_regression.py, the
+             same no-jax contract as perfscope/baseline.py).
+
+House rule (PRs 2, 3, 5): meshscope OFF is bit-identical in results AND
+compile counts — every hook here is host-side, out-of-band of the
+compiled executables, and armed only by explicit knobs
+(SimConfig.heartbeat_rounds, the scale/watch CLI).  Pinned by
+tests/test_meshscope.py across the sharded, multihost, sliced and
+batched regimes.
+"""
+
+from .heartbeat import (HeartbeatPublisher, publish_slice_heartbeat,
+                        publish_sweep_heartbeat, read_heartbeats,
+                        tail_heartbeats)
+from .scalegate import (STRAGGLER_TRIP, IncomparableScaling,
+                        compare_scaling)
+from .scaling import (SCALING_MANIFEST_KIND, build_scaling_manifest,
+                      load_scaling_manifest, run_scaling_ladder,
+                      save_scaling_manifest)
+from .telemetry import (collective_bytes, detect_stragglers,
+                        export_shard_trace, probe_shard_step_times,
+                        sample_device_memory, step_time_imbalance)
+
+__all__ = [
+    "HeartbeatPublisher", "publish_slice_heartbeat",
+    "publish_sweep_heartbeat", "read_heartbeats", "tail_heartbeats",
+    "STRAGGLER_TRIP", "IncomparableScaling", "compare_scaling",
+    "SCALING_MANIFEST_KIND", "build_scaling_manifest",
+    "load_scaling_manifest", "run_scaling_ladder",
+    "save_scaling_manifest", "collective_bytes", "detect_stragglers",
+    "export_shard_trace", "probe_shard_step_times",
+    "sample_device_memory", "step_time_imbalance",
+]

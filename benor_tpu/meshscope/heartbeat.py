@@ -1,0 +1,268 @@
+"""Live progress plane: heartbeats from long sliced runs & batched sweeps.
+
+An hour-long pod-scale sweep is a black box between its first compile
+and its final summary — nothing reports rounds/sec, decided fraction or
+an ETA while the compiled loops run.  The heartbeat closes that gap
+HOST-SIDE, between slices/buckets, from buffers the run already
+publishes (the flight-recorder rows, the slice round cursor): nothing
+here enters a trace, so heartbeat off — and on — is bit-identical in
+results and compile counts (tests/test_meshscope.py pins it).
+
+Three publication surfaces per heartbeat:
+
+  * gauges in utils/metrics.REGISTRY (``heartbeat.round``,
+    ``heartbeat.rounds_per_sec``, ``heartbeat.decided_frac``,
+    ``heartbeat.eta_s``, ``heartbeat.progress``) plus a
+    ``heartbeat.published`` counter — every exporter sees them;
+  * an append-only JSON-lines file (one record per beat, written
+    line-atomically via metrics.append_jsonl) that the
+    ``python -m benor_tpu watch`` CLI tails from another process;
+  * TpuNetwork.get_round_history(since_round=...) /
+    GET /getRoundHistory?since_round=N — the cursor-based incremental
+    round-history feed the HTTP control plane serves between slices.
+
+Cadence is SimConfig.heartbeat_rounds (0 = off): a beat fires whenever
+the run's round cursor crosses a multiple of it (sim.heartbeat_due).
+The batched sweep engine beats per bucket instead (buckets, not rounds,
+are its unit of progress).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import metrics
+
+#: Record tag on every heartbeat JSON line (what ``watch`` filters on).
+HEARTBEAT_KIND = "heartbeat"
+
+
+def _decided_frac_from_recorder(recorder) -> Optional[float]:
+    """Decided fraction of non-killed lanes, from the LAST written
+    flight-recorder row (None when no row was written yet)."""
+    from ..state import (REC_DECIDED, REC_UNDEC0, REC_UNDEC1, REC_UNDECQ)
+    rows = metrics.executed_rows(recorder)
+    if rows.shape[0] == 0:
+        return None
+    last = rows[-1]
+    undec = (last[REC_UNDEC0] + last[REC_UNDEC1] + last[REC_UNDECQ])
+    denom = int(last[REC_DECIDED] + undec)
+    return float(last[REC_DECIDED] / denom) if denom else None
+
+
+class HeartbeatPublisher:
+    """Stateful per-run heartbeat emitter (rate + ETA need history).
+
+    ``path`` (optional) is the append-only JSON-lines file; gauges feed
+    the registry regardless.  Thread-safe: the poll loop and any
+    concurrent exporter serialize on the registry/export locks
+    (utils/metrics.py)."""
+
+    def __init__(self, cfg, path: Optional[str] = None,
+                 label: str = "run",
+                 registry: Optional[metrics.MetricsRegistry] = None):
+        self.cfg = cfg
+        self.path = path
+        self.label = label
+        self.registry = metrics.REGISTRY if registry is None else registry
+        self._t0 = time.perf_counter()
+        self._last_t = self._t0
+        self._last_round = 0
+        self._published = 0
+
+    def publish(self, round_: Optional[int] = None, recorder=None,
+                decided_frac: Optional[float] = None,
+                progress: Optional[float] = None,
+                rate: Optional[float] = None, done: bool = False,
+                **extra) -> dict:
+        """Emit one beat; returns the record written/registered.
+
+        ``round_`` is the run's round cursor (rounds/sec and the ETA
+        derive from its motion); ``recorder`` (a flight-recorder buffer)
+        supplies the decided fraction when ``decided_frac`` is not given;
+        ``progress`` in [0, 1] serves drivers whose unit is not rounds
+        (the batched sweep passes buckets-done / buckets-total).
+        """
+        now = time.perf_counter()
+        rps = rate
+        eta = None
+        if round_ is not None and rps is None:
+            dt = now - self._last_t
+            dr = round_ - self._last_round
+            if dr > 0 and dt > 0:
+                rps = dr / dt
+            elif round_ and (now - self._t0) > 0:
+                rps = round_ / (now - self._t0)
+        if decided_frac is None and recorder is not None:
+            decided_frac = _decided_frac_from_recorder(
+                np.asarray(recorder))
+        if round_ is not None and rps:
+            remaining = max(0, self.cfg.max_rounds - round_)
+            if decided_frac is not None and decided_frac >= 1.0:
+                remaining = 0
+            eta = remaining / rps
+        if progress is None and round_ is not None and self.cfg.max_rounds:
+            progress = min(1.0, round_ / self.cfg.max_rounds)
+        if done:
+            eta, progress = 0.0, 1.0
+        record = {
+            "kind": HEARTBEAT_KIND, "label": self.label,
+            "round": (int(round_) if round_ is not None else None),
+            "max_rounds": int(self.cfg.max_rounds),
+            "rounds_per_sec": (round(float(rps), 4)
+                               if rps is not None else None),
+            "decided_frac": (round(float(decided_frac), 6)
+                             if decided_frac is not None else None),
+            "eta_s": round(float(eta), 3) if eta is not None else None,
+            "progress": (round(float(progress), 6)
+                         if progress is not None else None),
+            "elapsed_s": round(now - self._t0, 3),
+            "done": bool(done),
+        }
+        record.update(extra)
+        g = self.registry.gauge
+        if round_ is not None:
+            g("heartbeat.round").set(round_)
+            self._last_round = int(round_)
+        if rps is not None:
+            g("heartbeat.rounds_per_sec").set(rps)
+        if decided_frac is not None:
+            g("heartbeat.decided_frac").set(decided_frac)
+        if eta is not None:
+            g("heartbeat.eta_s").set(eta)
+        if progress is not None:
+            g("heartbeat.progress").set(progress)
+        self.registry.counter("heartbeat.published").inc()
+        self._last_t = now
+        self._published += 1
+        if self.path:
+            metrics.append_jsonl(self.path, record)
+        return record
+
+    def close(self, round_: Optional[int] = None, recorder=None,
+              decided_frac: Optional[float] = None) -> dict:
+        """Final beat with ``done: true`` (what ``watch`` stops on)."""
+        return self.publish(round_=round_, recorder=recorder,
+                            decided_frac=decided_frac, done=True)
+
+
+# --------------------------------------------------------------------------
+# Slice-level publishing for the sharded / multihost regimes: the slice
+# wrappers (parallel/sharded.py, parallel/multihost.py) call this after
+# every compiled slice when cfg.heartbeat_rounds is armed — registry
+# gauges only (the file plane belongs to the driver that owns the path,
+# e.g. TpuNetwork.start's poll loop).  Keyed per label so concurrent
+# runs don't share rate state.
+# --------------------------------------------------------------------------
+
+_SLICE_LOCK = threading.Lock()
+#: label -> (publisher, round cursor BEFORE the next expected slice) —
+#: the cursor advances on EVERY boundary (not just cadence-crossing
+#: ones), so a fresh run is recognized by its from_round not continuing
+#: where the previous slice stopped.
+_SLICE_PUBS: Dict[str, Tuple[HeartbeatPublisher, int]] = {}
+
+
+def publish_slice_heartbeat(cfg, next_round, recorder=None,
+                            label: str = "slice",
+                            from_round=None) -> Optional[dict]:
+    """Registry-only heartbeat from one slice boundary; returns the
+    record when the cadence fired, else None.  ``next_round`` may be a
+    device scalar (the slice output) — it is fetched, which is the host
+    sync the caller is about to do anyway at a slice boundary.
+
+    ``from_round`` (the slice's entry cursor) distinguishes a NEW run
+    from a continuation: a publisher cached under ``label`` is only
+    reused when the slice picks up exactly where the previous one
+    stopped — otherwise its rate state would span the idle/compile gap
+    between two runs and the first beat of the new run would report a
+    near-zero rounds/sec."""
+    from ..sim import heartbeat_due
+    r = int(next_round) - 1          # rounds fully executed so far
+    prev = None if from_round is None else int(from_round) - 1
+    with _SLICE_LOCK:
+        pub, seen = _SLICE_PUBS.get(label, (None, 0))
+        if (pub is None or pub.cfg != cfg or r < pub._last_round
+                or (prev is not None and prev != seen)):
+            pub = HeartbeatPublisher(cfg, label=label)
+        _SLICE_PUBS[label] = (pub, r)
+    if not heartbeat_due(cfg, pub._last_round, r):
+        return None
+    return pub.publish(round_=r, recorder=recorder)
+
+
+def publish_sweep_heartbeat(cfg, done: int, total: int,
+                            publisher: Optional[HeartbeatPublisher] = None,
+                            path: Optional[str] = None) -> dict:
+    """Per-bucket heartbeat for the batched sweep engine
+    (sweep.run_curve_batched): progress = points finished / points
+    total.  Returns the record; pass a publisher to keep one rate state
+    across buckets (the engine does)."""
+    pub = publisher if publisher is not None else HeartbeatPublisher(
+        cfg, path=path, label="sweep")
+    return pub.publish(progress=done / max(total, 1),
+                       done=(done >= total),
+                       points_done=int(done), points_total=int(total))
+
+
+# --------------------------------------------------------------------------
+# Reading side: what `python -m benor_tpu watch` runs.
+# --------------------------------------------------------------------------
+
+
+def read_heartbeats(path: str) -> List[dict]:
+    """Parse a heartbeat JSON-lines file -> records, in file order.
+    A torn (still-being-written) final line is skipped, not an error —
+    the writer appends line-atomically, but a reader can still catch the
+    file between the open and the flush of the very first line."""
+    out: List[dict] = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue             # torn tail line; next poll re-reads
+            if isinstance(rec, dict) and rec.get("kind") == HEARTBEAT_KIND:
+                out.append(rec)
+    return out
+
+
+def tail_heartbeats(path: str, poll_s: float = 0.2,
+                    timeout_s: float = 60.0, follow: bool = True,
+                    stop_when_done: bool = True) -> Iterator[dict]:
+    """Yield heartbeat records as they are appended (the watch engine).
+
+    Polls ``path`` every ``poll_s`` seconds, yielding only NEW records;
+    stops on a ``done: true`` record (when ``stop_when_done``), when
+    ``follow`` is False and the file has been read through once, or
+    after ``timeout_s`` seconds without any new record.  A not-yet-
+    created file counts as "no new records" (the sweep may still be
+    compiling), so the timeout is the only way out of a path that never
+    materializes."""
+    seen = 0
+    deadline = time.monotonic() + timeout_s
+    while True:
+        try:
+            records = read_heartbeats(path)
+        except OSError:
+            records = []
+        new = records[seen:]
+        seen = len(records)
+        for rec in new:
+            deadline = time.monotonic() + timeout_s
+            yield rec
+            if stop_when_done and rec.get("done"):
+                return
+        if not follow:
+            return
+        if time.monotonic() >= deadline:
+            return
+        time.sleep(poll_s)

@@ -1,0 +1,152 @@
+"""Scaling-regression detection: manifest-vs-baseline efficiency bands.
+
+Deliberately jax-free (stdlib only), same contract as
+perfscope/baseline.py: ``tools/check_scaling_regression.py`` loads this
+module by FILE PATH so a CI image (or an operator's laptop) can gate a
+scaling manifest without initializing any backend.  An import creep here
+breaks that gate immediately.
+
+What gates (all structural / dimensionless — wall clocks are carried in
+every manifest for trend reading but never banded):
+
+  * a baseline row (one (devices, n_nodes) ladder rung) disappearing;
+  * ``efficiency`` — throughput vs d x the 1-device row — dropping below
+    ``efficiency_band`` x the baseline's (missing/zero where the
+    baseline had substance is the WORST collapse, the same rule
+    perfscope applies to ``node_rounds_per_sec=0.0``);
+  * ``straggler_ratio`` — max/median per-shard step time — at or above
+    the ABSOLUTE trip ``STRAGGLER_TRIP`` (a straggling shard is a
+    health event regardless of what the baseline machine looked like);
+  * ``node_rounds_per_sec`` going to zero where the baseline had
+    substance (a degenerated capture);
+  * ``rounds`` changing at the same seed + scale (determinism drift,
+    mirroring the perf gate's rounds_executed pin).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Tuple
+
+#: Max allowed max/median per-shard step-time ratio before the imbalance
+#: detector (meshscope/telemetry.py) and the gate both trip.  A 2x
+#: straggler — one shard taking twice the median step time — is the
+#: canonical fixture and sits comfortably past this.
+STRAGGLER_TRIP = 1.5
+
+#: Default floor on new_efficiency / baseline_efficiency: scaling
+#: efficiency is a ratio of ratios on the SAME ladder shape, so it is far
+#: more machine-stable than a wall clock — 0.8 tolerates CPU-smoke noise
+#: while catching a real parallelism collapse.
+EFFICIENCY_BAND = 0.8
+
+
+class IncomparableScaling(ValueError):
+    """Raised when manifest and baseline describe different ladders
+    (platform / mode / axis / scale mismatch) — comparing them would
+    produce confident nonsense, so the gate refuses instead."""
+
+
+@dataclasses.dataclass
+class ScalingFinding:
+    """One out-of-band scaling metric."""
+
+    devices: int
+    metric: str
+    message: str
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _require_comparable(new: dict, base: dict) -> None:
+    for key in ("kind", "schema_version", "platform", "mode", "axis"):
+        if new.get(key) != base.get(key):
+            raise IncomparableScaling(
+                f"{key}: manifest has {new.get(key)!r}, baseline has "
+                f"{base.get(key)!r}")
+    if new.get("scale") != base.get("scale"):
+        raise IncomparableScaling(
+            f"scale: manifest {new.get('scale')} vs baseline "
+            f"{base.get('scale')} — recapture at the baseline scale or "
+            f"re-baseline")
+
+
+def _rows_by_rung(manifest: dict) -> Dict[Tuple[int, int], dict]:
+    return {(int(r["devices"]), int(r["n_nodes"])): r
+            for r in manifest.get("rows", [])}
+
+
+def compare_scaling(new: dict, base: dict,
+                    efficiency_band: float = EFFICIENCY_BAND,
+                    straggler_trip: float = STRAGGLER_TRIP
+                    ) -> List[ScalingFinding]:
+    """All out-of-band rows of ``new`` vs ``base`` (empty = gate passes).
+    Raises IncomparableScaling when the two documents do not describe the
+    same ladder."""
+    _require_comparable(new, base)
+    out: List[ScalingFinding] = []
+    new_rows = _rows_by_rung(new)
+    base_rows = _rows_by_rung(base)
+    for rung, old in sorted(base_rows.items()):
+        d, n = rung
+        row = new_rows.get(rung)
+        if row is None:
+            out.append(ScalingFinding(
+                d, "row",
+                f"rung devices={d} n_nodes={n}: present in baseline but "
+                f"missing from the manifest — a ladder rung disappeared"))
+            continue
+        if row.get("rounds") != old.get("rounds"):
+            out.append(ScalingFinding(
+                d, "rounds",
+                f"rung devices={d}: rounds {row.get('rounds')} vs "
+                f"baseline {old.get('rounds')} — same seed + scale must "
+                f"execute the same rounds (determinism drift)"))
+        old_eff = old.get("efficiency")
+        new_eff = row.get("efficiency")
+        if old_eff:
+            if not new_eff:
+                out.append(ScalingFinding(
+                    d, "efficiency",
+                    f"rung devices={d}: scaling efficiency is "
+                    f"{new_eff!r} where the baseline had {old_eff} — "
+                    f"missing or zero efficiency is the worst possible "
+                    f"collapse"))
+            elif new_eff < old_eff * efficiency_band:
+                out.append(ScalingFinding(
+                    d, "efficiency",
+                    f"rung devices={d}: efficiency {new_eff} vs "
+                    f"baseline {old_eff} "
+                    f"({new_eff / old_eff:.2f}x < band "
+                    f"{efficiency_band}x) — scaling regressed"))
+        if old.get("node_rounds_per_sec") and \
+                not row.get("node_rounds_per_sec"):
+            out.append(ScalingFinding(
+                d, "node_rounds_per_sec",
+                f"rung devices={d}: node_rounds_per_sec went to zero "
+                f"(baseline {old['node_rounds_per_sec']:.3g}) — the "
+                f"capture likely degenerated"))
+        ratio = row.get("straggler_ratio")
+        if ratio is not None and ratio >= straggler_trip:
+            out.append(ScalingFinding(
+                d, "straggler_ratio",
+                f"rung devices={d}: straggler_ratio {ratio} >= trip "
+                f"{straggler_trip} — one shard's step time is "
+                f"{ratio:.2f}x the median; the mesh is imbalanced"))
+    # The straggler trip is ABSOLUTE (a health event, not a band), so it
+    # must also fire on manifest rungs the baseline never captured —
+    # e.g. `scale --mesh 1,2,4` against a d=1,2 baseline.
+    for rung, row in sorted(new_rows.items()):
+        if rung in base_rows:
+            continue
+        d = rung[0]
+        ratio = row.get("straggler_ratio")
+        if ratio is not None and ratio >= straggler_trip:
+            out.append(ScalingFinding(
+                d, "straggler_ratio",
+                f"rung devices={d} (not in baseline): straggler_ratio "
+                f"{ratio} >= trip {straggler_trip} — one shard's step "
+                f"time is {ratio:.2f}x the median; the mesh is "
+                f"imbalanced"))
+    return out

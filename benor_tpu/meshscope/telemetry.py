@@ -1,0 +1,277 @@
+"""Per-shard runtime telemetry: memory watermarks, collective cost
+attribution, straggler detection, Perfetto shard tracks.
+
+Everything here runs HOST-SIDE and out-of-band of the compiled
+executables — sampling memory or probing a shard never enters a trace,
+so the off path stays bit-identical in results and compile counts
+(the meshscope house rule; tests/test_meshscope.py).
+
+  sample_device_memory     live device-memory watermarks into gauge
+                           families: ``device.memory_stats()`` where the
+                           backend serves it (TPU), a live-array
+                           per-device byte sum everywhere else (CPU).
+  collective_bytes         per-round psum/collective byte attribution
+                           DERIVED from the declarative layout tables —
+                           state.REC_LAYOUT / WIT_LAYOUT and the pallas
+                           kernels' PARTIAL_COLS — not hand-counted, so
+                           a relayout (the tables are the single source
+                           of truth since PR 4) re-prices the
+                           collectives automatically.
+  probe_shard_step_times   per-device steady-state step-time probe: one
+                           warm fixed-size compute kernel timed on every
+                           device of the mesh.  Relative shard health is
+                           the quantity straggler detection needs; the
+                           absolute step time of the real run lands in
+                           the scaling rows (meshscope/scaling.py).
+  detect_stragglers        max/median imbalance ratio over per-shard
+                           step times -> gauge + a trip counter when the
+                           ratio crosses scalegate.STRAGGLER_TRIP.
+  export_shard_trace       the per-shard samples as one Perfetto track
+                           per shard (load next to a jax.profiler
+                           capture or metrics.export_chrome_trace).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils import metrics
+from .scalegate import STRAGGLER_TRIP
+
+# --------------------------------------------------------------------------
+# Device-memory watermarks
+# --------------------------------------------------------------------------
+
+
+def sample_device_memory(registry: Optional[metrics.MetricsRegistry] = None
+                         ) -> List[dict]:
+    """Sample per-device memory into gauges; returns one dict per device.
+
+    Two sources, best first: ``device.memory_stats()`` (bytes_in_use /
+    peak_bytes_in_use — the real HBM watermark on TPU backends) and a
+    sum of ``jax.live_arrays()`` bytes per device (what the CPU backend
+    can attribute).  Gauge families: ``meshscope.mem.live_bytes.d<i>``
+    always; ``meshscope.mem.bytes_in_use.d<i>`` /
+    ``meshscope.mem.peak_bytes.d<i>`` when the backend serves stats.
+    """
+    import jax
+    registry = metrics.REGISTRY if registry is None else registry
+    live: Dict[int, int] = {}
+    for arr in jax.live_arrays():
+        for shard in getattr(arr, "addressable_shards", []):
+            nbytes = getattr(shard.data, "nbytes", 0)
+            live[shard.device.id] = live.get(shard.device.id, 0) + nbytes
+    out = []
+    for dev in jax.local_devices():
+        row = {"device": dev.id, "platform": dev.platform,
+               "live_bytes": int(live.get(dev.id, 0))}
+        registry.gauge(f"meshscope.mem.live_bytes.d{dev.id}").set(
+            row["live_bytes"])
+        stats_fn = getattr(dev, "memory_stats", None)
+        stats = None
+        if stats_fn is not None:
+            try:
+                stats = stats_fn()
+            except (RuntimeError, NotImplementedError):
+                stats = None         # backend has no allocator stats
+        if stats:
+            for key, name in (("bytes_in_use", "bytes_in_use"),
+                              ("peak_bytes_in_use", "peak_bytes")):
+                if key in stats:
+                    row[name] = int(stats[key])
+                    registry.gauge(
+                        f"meshscope.mem.{name}.d{dev.id}").set(stats[key])
+        out.append(row)
+    return out
+
+
+# --------------------------------------------------------------------------
+# Collective byte attribution from the declarative layout tables
+# --------------------------------------------------------------------------
+
+
+def collective_bytes(cfg, registry: Optional[metrics.MetricsRegistry] = None
+                     ) -> Dict[str, int]:
+    """Per-ROUND collective payload bytes, by family, for one config.
+
+    This is a cost MODEL of what crosses the mesh per round and node
+    shard, priced from the same declarative tables the kernels derive
+    their layouts from (PR 4's whole point — a relayout is a table edit,
+    and this attribution follows it):
+
+      tally_psum        histogram path: one int32 [T, 3] class histogram
+                        psum per phase (2 phases/round)
+      tally_allgather   dense path instead: int8 [T, N] sent values +
+                        bool [T, N] alive per phase
+      pallas_partials   fused-round regime: the per-tile [T, PARTIAL_COLS]
+                        int32 reduction rows psum'd between kernels
+                        (carries tallies + recorder + witness partials,
+                        replacing the families above)
+      termination_psum  the scalar all-settled predicate, every round
+      recorder_psum     cfg.record: one [REC_WIDTH] int32 row globalized
+                        before its write
+      witness_psum      cfg.witness: one [W, k, WIT_WIDTH] int32 row
+
+    Families are set as ``meshscope.collective.<family>_bytes`` gauges;
+    the returned dict adds ``total`` (bytes/round).
+    """
+    from ..ops.pallas_round import PARTIAL_COLS
+    from ..ops.tally import pallas_round_active
+    from ..state import REC_WIDTH, WIT_WIDTH
+    registry = metrics.REGISTRY if registry is None else registry
+    T, N = cfg.trials, cfg.n_nodes
+    phases = 2                                   # proposal + vote
+    fam: Dict[str, int] = {}
+    if pallas_round_active(cfg):
+        # the packed loop's only inter-shard traffic: the per-tile
+        # partial-column rows (tallies, recorder cols 5-11, witness
+        # blocks) reduced across the node axis, once per kernel pass
+        fam["pallas_partials"] = phases * T * PARTIAL_COLS * 4
+    elif cfg.resolved_path == "dense":
+        fam["tally_allgather"] = phases * (T * N * 1 + T * N * 1)
+    else:
+        fam["tally_psum"] = phases * T * 3 * 4
+    fam["termination_psum"] = 4
+    if cfg.record and not pallas_round_active(cfg):
+        fam["recorder_psum"] = REC_WIDTH * 4
+    if cfg.witness and not pallas_round_active(cfg):
+        fam["witness_psum"] = (len(cfg.witness_trials)
+                               * cfg.witness_nodes * WIT_WIDTH * 4)
+    for name, nbytes in fam.items():
+        registry.gauge(f"meshscope.collective.{name}_bytes").set(nbytes)
+    fam["total"] = sum(fam.values())
+    registry.gauge("meshscope.collective.total_bytes").set(fam["total"])
+    return fam
+
+
+# --------------------------------------------------------------------------
+# Straggler / imbalance detection
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class StragglerReport:
+    """Per-shard step times + the imbalance verdict."""
+
+    step_times_s: List[float]
+    ratio: float                 # max / median
+    stragglers: List[int]        # shard indices at/above the trip
+    tripped: bool
+
+    def to_dict(self) -> dict:
+        return {"step_times_s": [round(t, 6) for t in self.step_times_s],
+                "ratio": round(self.ratio, 4),
+                "stragglers": self.stragglers, "tripped": self.tripped}
+
+
+def step_time_imbalance(step_times: Sequence[float]) -> float:
+    """max/median shard step-time ratio (1.0 = perfectly balanced)."""
+    t = np.asarray(list(step_times), dtype=np.float64)
+    if t.size == 0:
+        return 1.0
+    med = float(np.median(t))
+    return float(np.max(t) / med) if med > 0 else 1.0
+
+
+def detect_stragglers(step_times: Sequence[float],
+                      trip: float = STRAGGLER_TRIP,
+                      registry: Optional[metrics.MetricsRegistry] = None
+                      ) -> StragglerReport:
+    """Imbalance verdict over per-shard step times.
+
+    Sets ``meshscope.straggler_ratio`` (gauge) every call and bumps the
+    ``meshscope.straggler_detected`` counter when the max/median ratio
+    crosses ``trip`` — the same threshold the scaling gate applies to a
+    manifest's ``straggler_ratio`` (scalegate.STRAGGLER_TRIP), so a
+    live detection and a gated capture agree on what "imbalanced" means.
+    """
+    registry = metrics.REGISTRY if registry is None else registry
+    times = [float(t) for t in step_times]
+    ratio = step_time_imbalance(times)
+    med = float(np.median(np.asarray(times))) if times else 0.0
+    stragglers = [i for i, t in enumerate(times)
+                  if med > 0 and t / med >= trip]
+    tripped = ratio >= trip
+    registry.gauge("meshscope.straggler_ratio").set(ratio)
+    if tripped:
+        registry.counter("meshscope.straggler_detected").inc()
+    return StragglerReport(step_times_s=times, ratio=ratio,
+                           stragglers=stragglers, tripped=tripped)
+
+
+# --------------------------------------------------------------------------
+# Per-device step-time probe
+# --------------------------------------------------------------------------
+
+
+def probe_shard_step_times(mesh=None, devices=None, reps: int = 3,
+                           size: int = 256,
+                           registry: Optional[
+                               metrics.MetricsRegistry] = None
+                           ) -> List[float]:
+    """Steady-state step-time probe, one value per mesh device.
+
+    Runs a fixed [size, size] f32 matmul ``reps`` times on EVERY device
+    of the mesh (warm-up execution first, so the per-device executable
+    is compiled out of the timed window) and returns each device's MIN
+    wall time, in mesh order — min, not mean, because the probe wants
+    the device's capability floor: a genuinely throttled chip is slow
+    on every rep, while host-scheduler noise (virtual CPU devices share
+    cores) only inflates some reps.  The probe is deliberately
+    workload-independent: straggler detection wants RELATIVE shard
+    health, which a fixed kernel measures without re-running the
+    protocol.  Gauges: ``meshscope.shard.step_s.d<i>``.
+    """
+    import jax
+    import jax.numpy as jnp
+    registry = metrics.REGISTRY if registry is None else registry
+    if devices is None:
+        devices = (list(np.asarray(mesh.devices).flat)
+                   if mesh is not None else jax.local_devices())
+    a_host = np.ones((size, size), np.float32)
+    times: List[float] = []
+    for dev in devices:
+        a = jax.device_put(a_host, dev)
+        jnp.dot(a, a).block_until_ready()        # warm-up: compile + run
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jnp.dot(a, a).block_until_ready()
+            best = min(best, time.perf_counter() - t0)
+        times.append(best)
+        registry.gauge(f"meshscope.shard.step_s.d{dev.id}").set(best)
+    return times
+
+
+# --------------------------------------------------------------------------
+# Perfetto per-shard tracks
+# --------------------------------------------------------------------------
+
+
+def export_shard_trace(path: str, samples: Sequence[Sequence[float]],
+                       label: str = "shard") -> int:
+    """Write per-shard step-time samples as a Chrome-trace/Perfetto file:
+    one track (tid) per shard, one complete event per timed step, laid
+    end to end — a straggling shard is visibly longer on its track.
+    ``samples[i]`` is shard i's per-step durations in seconds.  Returns
+    the event count; load next to a jax.profiler capture or a
+    metrics.export_chrome_trace file in https://ui.perfetto.dev.
+    """
+    events = []
+    for i, steps in enumerate(samples):
+        ts = 0.0
+        for j, dur in enumerate(steps):
+            events.append({
+                "name": f"step {j}", "ph": "X", "pid": 0,
+                "tid": f"{label} {i}",
+                "ts": ts * 1e6, "dur": float(dur) * 1e6,
+            })
+            ts += float(dur)
+    with open(path, "w") as fh:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, fh)
+    return len(events)

@@ -188,7 +188,14 @@ def run_consensus_slice_multihost(cfg: SimConfig, state: NetState,
     like every other slice primitive: pass the previous slice's buffer,
     None starts a fresh one; the filled buffer is the third output.  The
     witness buffer (cfg.witness) threads the same way, appended after
-    the recorder when both are armed."""
+    the recorder when both are armed.
+
+    With cfg.heartbeat_rounds, PROCESS 0 publishes the host-side
+    live-progress heartbeat at cadence-crossing slice boundaries (the
+    replicated round cursor is identical on every host, so one
+    publisher suffices; meshscope/heartbeat.py) — registry gauges only,
+    out-of-band of the compiled slice, same bit-identity contract as
+    the sharded wrapper."""
     meshlib.check_divisible(cfg.trials, cfg.n_nodes, mesh)
     _check_global(state, faults, (cfg.trials, cfg.n_nodes))
     args = (state, faults, base_key, jnp.int32(from_round),
@@ -203,7 +210,14 @@ def run_consensus_slice_multihost(cfg: SimConfig, state: NetState,
             from ..state import new_witness
             witness = new_witness(cfg, state)
         args = args + (witness,)
-    return sharded._compiled_slice(cfg, mesh)(*args)
+    out = sharded._compiled_slice(cfg, mesh)(*args)
+    if cfg.heartbeat_rounds and jax.process_index() == 0:
+        from ..meshscope.heartbeat import publish_slice_heartbeat
+        publish_slice_heartbeat(cfg, out[0],
+                                recorder=out[2] if cfg.record else None,
+                                label="multihost.slice",
+                                from_round=from_round)
+    return out
 
 
 def resume_consensus_multihost(cfg: SimConfig, state: NetState,

@@ -256,7 +256,8 @@ def _compiled_slice(cfg: SimConfig, mesh: Mesh):
 def run_consensus_slice_sharded(cfg: SimConfig, state: NetState,
                                 faults: FaultSpec, base_key: jax.Array,
                                 mesh: Mesh, from_round, until_round,
-                                recorder=None, witness=None):
+                                recorder=None, witness=None,
+                                heartbeat: bool = True):
     """Mid-run observability (cfg.poll_rounds) under a device mesh.
 
     Same semantics as sim.run_consensus_slice (including the recorder /
@@ -265,6 +266,17 @@ def run_consensus_slice_sharded(cfg: SimConfig, state: NetState,
     is keyed on global (trial, node, round) ids, a sliced sharded run is
     bit-identical to the one-shot sharded run AND to the single-device
     run for any mesh shape (tests/test_parallel.py pins both).
+
+    With cfg.heartbeat_rounds the wrapper also publishes a HOST-side
+    live-progress heartbeat (meshscope/heartbeat.py) at each slice
+    boundary whose round cursor crossed the cadence — registry gauges
+    only (rounds/sec, decided fraction from the recorder when armed);
+    the compiled slice executable is untouched, so heartbeat on/off
+    stays bit-identical in results and compile counts.  A driver that
+    runs its OWN HeartbeatPublisher around the slice loop (e.g.
+    TpuNetwork.start, which also owns the file plane) passes
+    ``heartbeat=False`` so one beat is not published twice into the
+    shared ``heartbeat.*`` gauges.
     """
     meshlib.check_divisible(cfg.trials, cfg.n_nodes, mesh)
     state, faults = shard_inputs(state, faults, mesh)
@@ -280,7 +292,14 @@ def run_consensus_slice_sharded(cfg: SimConfig, state: NetState,
             from ..state import new_witness
             witness = new_witness(cfg, state)
         args = args + (witness,)
-    return _compiled_slice(cfg, mesh)(*args)
+    out = _compiled_slice(cfg, mesh)(*args)
+    if heartbeat and cfg.heartbeat_rounds:
+        from ..meshscope.heartbeat import publish_slice_heartbeat
+        publish_slice_heartbeat(cfg, out[0],
+                                recorder=out[2] if cfg.record else None,
+                                label="sharded.slice",
+                                from_round=from_round)
+    return out
 
 
 def resume_consensus_sharded(cfg: SimConfig, state: NetState,

@@ -182,3 +182,54 @@ def check_bench_trajectory(paths: Sequence[str],
         if prev is None or nrps > prev[0]:
             best[plat] = (nrps, path)
     return findings
+
+
+def check_multichip_trajectory(paths: Sequence[str],
+                               collapse_ratio: float = 3.0) -> List[str]:
+    """Scaling-efficiency collapses along the MULTICHIP_r*.json series.
+
+    The multichip round captures carry the "near-linear scaling"
+    evidence the pod-scale arc rests on; once a record publishes a
+    ``scaling_efficiency`` (meshscope-era captures do — the best
+    same-device-count efficiency of their scaling manifest), later
+    records must not collapse below it.  Mirrors the bench-series
+    ``node_rounds_per_sec=0.0`` rule, one notch stricter: a MISSING or
+    zero scaling_efficiency on an otherwise-ok record is treated as the
+    WORST collapse (efficiency 0.0) and flows into the comparison
+    instead of being skipped — a capture that stopped reporting the
+    metric must not read as healthy.  Records that failed (``ok``
+    false), were skipped, or are unreadable are noted and skipped, like
+    error records in the bench walk.  Comparisons key on ``n_devices``
+    (efficiency at 2 chips and at 8 are different experiments)."""
+    findings: List[str] = []
+    best: Dict[object, tuple] = {}      # n_devices -> (efficiency, path)
+    for path in paths:
+        try:
+            with open(path) as fh:
+                rec = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            findings.append(f"note: {path}: unreadable ({e})")
+            continue
+        if not isinstance(rec, dict) or rec.get("skipped") \
+                or not rec.get("ok"):
+            findings.append(f"note: {path}: skipped/failed capture, "
+                            f"not compared")
+            continue
+        eff = rec.get("scaling_efficiency")
+        if not eff:
+            # missing or zero = the worst possible collapse; it still
+            # participates (flagged iff an earlier record set a bar)
+            findings.append(
+                f"note: {path}: no scaling_efficiency — treated as 0.0 "
+                f"(the worst collapse), not skipped")
+            eff = 0.0
+        key = rec.get("n_devices")
+        prev = best.get(key)
+        if prev and eff * collapse_ratio < prev[0]:
+            findings.append(
+                f"REGRESSION: {path}: scaling_efficiency {eff:.3g} is "
+                f">{collapse_ratio}x below the n_devices={key} best "
+                f"{prev[0]:.3g} ({prev[1]})")
+        if eff and (prev is None or eff > prev[0]):
+            best[key] = (eff, path)
+    return findings

@@ -53,6 +53,21 @@ def warn_debug_demotes_pallas(cfg: SimConfig) -> None:
         stacklevel=3)
 
 
+def heartbeat_due(cfg: SimConfig, prev_round, next_round) -> bool:
+    """True iff the live-progress heartbeat (cfg.heartbeat_rounds;
+    benor_tpu/meshscope/heartbeat.py) should fire for a round cursor
+    that moved prev_round -> next_round: the cursor crossed a multiple
+    of the cadence.  HOST-side only — every consumer (TpuNetwork.start's
+    poll loop, the sharded/multihost slice wrappers) calls this between
+    compiled slices, never inside one, so the knob cannot perturb a
+    trace.  The single source of truth for the cadence, so every regime
+    beats at the same rounds."""
+    h = cfg.heartbeat_rounds
+    if h <= 0:
+        return False
+    return (int(next_round) // h) > (int(prev_round) // h)
+
+
 def start_state(cfg: SimConfig, state: NetState) -> NetState:
     """The /start transition: live lanes set k=1 (node.ts:167-188)."""
     k = jnp.where(~state.killed, jnp.int32(1), state.k)

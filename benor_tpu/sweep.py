@@ -307,7 +307,9 @@ def _stack_tree(items):
 
 def run_curve_batched(base_cfg: SimConfig, f_values: Sequence[int],
                       initial_values=None, faults_for=None,
-                      verbose: bool = False) -> BatchedCurve:
+                      verbose: bool = False,
+                      heartbeat_path: Optional[str] = None
+                      ) -> BatchedCurve:
     """Run a rounds-vs-f curve with one XLA compile per static-shape bucket.
 
     Semantics match the per-point loop exactly — same inputs, same
@@ -336,6 +338,14 @@ def run_curve_batched(base_cfg: SimConfig, f_values: Sequence[int],
     Timing fields on the returned points: ``seconds`` is the point's
     amortized share of its bucket's post-compile execution wall-clock
     (bucket run time / bucket size).
+
+    With ``base_cfg.heartbeat_rounds`` > 0 the engine publishes a live
+    progress heartbeat after every bucket (its unit of progress:
+    points-done / points-total, never mid-executable) into the metrics
+    registry and, when ``heartbeat_path`` is given, an append-only
+    JSON-lines file `python -m benor_tpu watch` tails — host-side only,
+    so the bucket executables (and their compile counts) are untouched
+    (benor_tpu/meshscope/heartbeat.py).
     """
     import warnings
 
@@ -388,6 +398,13 @@ def run_curve_batched(base_cfg: SimConfig, f_values: Sequence[int],
     secs = [0.0] * len(cfgs)       # per-point amortized bucket run time
     compile_s = run_s = 0.0
     bucket_sizes = []
+    heartbeat = None
+    if base_cfg.heartbeat_rounds:
+        from .meshscope.heartbeat import (HeartbeatPublisher,
+                                          publish_sweep_heartbeat)
+        heartbeat = HeartbeatPublisher(base_cfg, path=heartbeat_path,
+                                       label="sweep")
+    points_done = 0
     with count_backend_compiles() as counter:
         for key in order:
             b = buckets[key]
@@ -446,6 +463,10 @@ def run_curve_batched(base_cfg: SimConfig, f_values: Sequence[int],
                 raw[i] = ([o[j] for o in out] if key[0] == "dyn"
                           else [o for o in out])
                 secs[i] = bucket_run / len(b["idx"])
+            points_done += len(b["idx"])
+            if heartbeat is not None:
+                publish_sweep_heartbeat(base_cfg, points_done, len(cfgs),
+                                        publisher=heartbeat)
     del buckets  # the donated input buffers are dead; drop the refs
 
     points = _assemble_points(cfgs, raw, secs)
@@ -482,11 +503,14 @@ def _assemble_points(cfgs, raw, secs) -> List[SweepPoint]:
 
 
 def rounds_vs_f_batched(base_cfg: SimConfig, f_values: Sequence[int],
-                        verbose: bool = True) -> List[SweepPoint]:
+                        verbose: bool = True,
+                        heartbeat_path: Optional[str] = None
+                        ) -> List[SweepPoint]:
     """The north-star curve via the batched engine — same defaults and
     bit-identical summaries as ``rounds_vs_f``, O(buckets) compiles
     instead of O(points)."""
-    cb = run_curve_batched(base_cfg, f_values, verbose=verbose)
+    cb = run_curve_batched(base_cfg, f_values, verbose=verbose,
+                           heartbeat_path=heartbeat_path)
     if verbose:
         for pt in cb.points:
             print(f"  f={pt.n_faulty}: mean_k={pt.mean_k:.2f} "

@@ -25,6 +25,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import json
+import os
 import re
 import threading
 import time
@@ -181,15 +182,25 @@ def executed_rows(recorder) -> np.ndarray:
     return rec[written_round_indices(recorder)]
 
 
-def round_history_rows(recorder) -> List[dict]:
+def round_history_rows(recorder,
+                       since_round: Optional[int] = None) -> List[dict]:
     """Recorder buffer -> one dict per WRITTEN row, REC_COLUMNS-keyed plus
     the row's true round index ("round": 0 = post-/start snapshot;
     unwritten gap rows, e.g. before a fresh-buffer resume's re-entry
-    round, are skipped)."""
+    round, are skipped).
+
+    ``since_round`` is the incremental CURSOR: only rows with a round
+    index STRICTLY greater are returned, so a poller that passes the
+    last round it has seen receives exactly the new rows (and an empty
+    list when the cursor is at — or past — the end).  Rows key on their
+    TRUE round index, so the cursor is stable across a fresh-buffer
+    resume's gap: a cursor inside the gap yields the post-gap rows."""
     from ..state import REC_COLUMNS
     rec = np.asarray(recorder).astype(np.int64)
     rows = []
     for r in written_round_indices(recorder):
+        if since_round is not None and int(r) <= int(since_round):
+            continue
         d = {"round": int(r)}
         d.update({col: int(v) for col, v in zip(REC_COLUMNS, rec[r])})
         rows.append(d)
@@ -234,19 +245,56 @@ def round_history_summary(recorder) -> dict:
 
 # --------------------------------------------------------------------------
 # Exporters
+#
+# Thread-safety contract (meshscope's heartbeat publisher runs on the
+# driver thread while HTTP handlers and pollers read): metric MUTATION
+# is already serialized on _REGISTRY_LOCK; the exporters below
+# additionally (a) write whole-file snapshots to a temp file and
+# os.replace() it into place, so a concurrent reader (``watch``, a
+# Prometheus textfile collector) never observes a torn document, and
+# (b) serialize line APPENDS (append_jsonl) on _EXPORT_LOCK with one
+# write() call per line, so interleaved writers cannot corrupt a
+# JSON-lines stream.  tests/test_metrics.py hammers both concurrently.
 # --------------------------------------------------------------------------
+
+_EXPORT_LOCK = threading.Lock()
+
+
+def _atomic_write(path: str, text: str) -> None:
+    """Write ``text`` to ``path`` via temp-file + rename, so concurrent
+    readers see either the old complete file or the new one — never a
+    partial write."""
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    with open(tmp, "w") as fh:
+        fh.write(text)
+    os.replace(tmp, path)
+
+
+def append_jsonl(path: str, record: dict) -> None:
+    """Append ONE record as a JSON line (timestamped), line-atomically:
+    the line is serialized first and written in a single call under the
+    export lock, so concurrent in-process appenders (the heartbeat
+    publisher vs. the main loop's exporter) cannot interleave bytes and
+    a tailing reader (``python -m benor_tpu watch``) always parses."""
+    line = json.dumps({"ts": time.time(), **record}) + "\n"
+    with _EXPORT_LOCK:
+        with open(path, "a") as fh:
+            fh.write(line)
 
 
 def export_jsonl(path: str, registry: MetricsRegistry = None,
                  extra: Optional[List[dict]] = None) -> int:
     """Write the registry snapshot (plus optional extra records, e.g.
-    round_history_rows) as JSON-lines; returns the record count."""
+    round_history_rows) as JSON-lines; returns the record count.
+    Atomic (temp file + rename): a concurrent reader never sees a
+    half-written snapshot."""
     registry = REGISTRY if registry is None else registry
     records = registry.snapshot() + list(extra or [])
     ts = time.time()
-    with open(path, "w") as fh:
-        for rec in records:
-            fh.write(json.dumps({"ts": ts, **rec}) + "\n")
+    text = "".join(json.dumps({"ts": ts, **rec}) + "\n"
+                   for rec in records)
+    with _EXPORT_LOCK:
+        _atomic_write(path, text)
     return len(records)
 
 
@@ -280,8 +328,8 @@ def export_prometheus(path: str, registry: MetricsRegistry = None,
             lines.append(f"# TYPE {name}_seconds_max gauge")
             lines.append(f"{name}_seconds_max {m['max_s']}")
             n += 3
-    with open(path, "w") as fh:
-        fh.write("\n".join(lines) + "\n")
+    with _EXPORT_LOCK:
+        _atomic_write(path, "\n".join(lines) + "\n")
     return n
 
 
@@ -361,6 +409,7 @@ def export_chrome_trace(path: str, registry: MetricsRegistry = None,
                 "args": {k: v for k, v in row.items()
                          if k not in ("round", "trial", "node")},
             })
-    with open(path, "w") as fh:
-        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, fh)
+    with _EXPORT_LOCK:
+        _atomic_write(path, json.dumps({"traceEvents": events,
+                                        "displayTimeUnit": "ms"}))
     return len(events)

@@ -394,3 +394,127 @@ def test_post_unknown_route_404_with_body():
             urllib.request.urlopen(req, timeout=10)
         assert ei.value.code == 404
     net.close()
+
+
+# --- incremental round-history cursor (meshscope live progress plane) ---
+# GET /getRoundHistory?since_round=N and TpuNetwork.get_round_history(
+# since_round=...) serve the flight recorder as a cursor feed: strictly
+# newer rows only, keyed by TRUE round index.
+
+_CURSOR_NET = dict(n=10, f=5, vals=[1, 1, 0, 0, 1, 1, 0, 0, 1, 1],
+                   faulty=[True] * 5 + [False] * 5)
+
+
+def _cursor_net(**overrides):
+    kw = dict(backend="tpu", seed=0, delivery="quorum", max_rounds=12,
+              record=True)
+    kw.update(overrides)
+    return launch_network(_CURSOR_NET["n"], _CURSOR_NET["f"],
+                          _CURSOR_NET["vals"], _CURSOR_NET["faulty"], **kw)
+
+
+def test_round_history_cursor_incremental_under_poll_rounds():
+    """Polling with the cursor between slices yields exactly the new
+    rows each time; their concatenation equals the full history, and a
+    cursor at (or past) the end yields nothing."""
+    net = _cursor_net(poll_rounds=2)
+    chunks, cursor = [], None
+
+    def poll():
+        nonlocal cursor
+        rows = net.get_round_history(since_round=cursor)
+        if rows:
+            cursor = rows[-1]["round"]
+            chunks.append(rows)
+
+    net.start(on_slice=poll)
+    poll()                                   # drain the final slice
+    flat = [r for chunk in chunks for r in chunk]
+    assert flat == net.get_round_history()   # no gaps, no duplicates
+    rounds = [r["round"] for r in flat]
+    assert rounds == sorted(rounds) and len(set(rounds)) == len(rounds)
+    assert len(chunks) >= 3                  # genuinely incremental
+    # cursor at the end, and far past it: both empty
+    assert net.get_round_history(since_round=cursor) == []
+    assert net.get_round_history(since_round=10 ** 6) == []
+
+
+def test_round_history_cursor_mid_resume_gap():
+    """A fresh-buffer resume leaves an unwritten gap before the re-entry
+    round; a cursor INSIDE the gap must return exactly the post-gap rows
+    (rows key on their true round index, so the cursor stays stable
+    across the gap)."""
+    import jax
+
+    from benor_tpu.config import SimConfig
+    from benor_tpu.sim import resume_consensus, run_consensus
+    from benor_tpu.state import FaultSpec, init_state
+    from benor_tpu.utils.metrics import round_history_rows
+
+    cfg = SimConfig(n_nodes=_CURSOR_NET["n"], n_faulty=_CURSOR_NET["f"],
+                    trials=1, delivery="quorum", max_rounds=12,
+                    record=True, seed=0)
+    faults = FaultSpec.from_faulty_list(cfg, _CURSOR_NET["faulty"])
+    state = init_state(cfg, _CURSOR_NET["vals"], faults)
+    key = jax.random.key(cfg.seed)
+    _, mid, _ = run_consensus(cfg.replace(max_rounds=5), state, faults,
+                              key)
+    # resume at round 6 with a FRESH recorder: rows 1..5 stay unwritten
+    out = resume_consensus(cfg, mid, faults, key, from_round=6)
+    rec = out[2]
+    full = round_history_rows(rec)
+    written = [r["round"] for r in full]
+    assert 0 in written and 6 in written and 3 not in written
+    # cursor inside the gap: exactly the post-gap rows
+    post_gap = round_history_rows(rec, since_round=3)
+    assert [r["round"] for r in post_gap] == [r for r in written if r > 3]
+    # cursor at the snapshot row: everything after row 0
+    assert [r["round"] for r in round_history_rows(rec, since_round=0)] \
+        == [r for r in written if r > 0]
+
+
+def test_round_history_http_route_cursor_and_errors():
+    """The wire surface: GET /getRoundHistory serves rows + cursor,
+    since_round pages incrementally, a past-end cursor yields an empty
+    page, malformed cursors 400, record-off networks 400, and the
+    event-loop oracle (no device recorder) 405."""
+    net = _cursor_net(poll_rounds=0)
+    with NodeHttpCluster(net, BASE + 80):
+        _get(BASE + 80, "/start")
+        code, body = _get(BASE + 80, "/getRoundHistory")
+        assert code == 200
+        doc = json.loads(body)
+        rows, cursor = doc["rows"], doc["cursor"]
+        assert rows and cursor == rows[-1]["round"]
+        assert rows == net.get_round_history()
+        # incremental page: only rows after the mid cursor
+        mid = rows[len(rows) // 2]["round"]
+        code, body = _get(BASE + 80,
+                          f"/getRoundHistory?since_round={mid}")
+        assert code == 200
+        page = json.loads(body)
+        assert [r["round"] for r in page["rows"]] == \
+            [r["round"] for r in rows if r["round"] > mid]
+        # cursor past the end: empty page, cursor echoed back
+        code, body = _get(BASE + 80,
+                          f"/getRoundHistory?since_round={cursor + 99}")
+        assert code == 200
+        empty = json.loads(body)
+        assert empty["rows"] == [] and empty["cursor"] == cursor + 99
+        # malformed cursor
+        code, _ = _get(BASE + 80, "/getRoundHistory?since_round=nope")
+        assert code == 400
+    net.close()
+
+    off = _cursor_net(record=False)
+    with NodeHttpCluster(off, BASE + 81):
+        code, body = _get(BASE + 81, "/getRoundHistory")
+        assert code == 400 and "record=True" in body
+    off.close()
+
+    oracle = launch_network(2, 0, [1, 1], [False, False],
+                            backend="express", seed=0)
+    with NodeHttpCluster(oracle, BASE + 82):
+        code, _ = _get(BASE + 82, "/getRoundHistory")
+        assert code == 405
+    oracle.close()

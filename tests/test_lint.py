@@ -432,6 +432,36 @@ def test_config_parity_new_consumed_field_fires_everywhere(tmp_path):
     assert len(hits) == 4      # one per regime file, none allowlisted
 
 
+def test_config_parity_heartbeat_field_clean_and_mutation_fails(tmp_path):
+    """ISSUE 6 satellite: heartbeat_rounds is consumed by the driver
+    (sim.heartbeat_due) and must stay visible in every regime — the
+    shipped tree passes (sweep/sharded/multihost reference it, the
+    fused kernels carry a reasoned PARITY_ALLOWLIST entry), and
+    removing the reference from ONE regime fails lint."""
+    root = _parity_tree(tmp_path)
+    active, _ = _findings(root, rules=["config-parity"])
+    assert active == []        # clean as shipped (allowlist included)
+
+    # mutation: the sharded slice wrapper stops honoring the cadence
+    _edit(root, "parallel/sharded.py",
+          "if heartbeat and cfg.heartbeat_rounds:",
+          "if False:", count=1)
+    active, _ = _findings(root, rules=["config-parity"])
+    assert len(active) == 1
+    f = active[0]
+    assert f.rule == "config-parity" and f.path == "sim.py"
+    assert "heartbeat_rounds" in f.message
+    assert "parallel/sharded.py" in f.message
+
+    # same mutation against the sweep engine, independently
+    root2 = _parity_tree(tmp_path.joinpath("second"))
+    _edit(root2, "sweep.py", "if base_cfg.heartbeat_rounds:",
+          "if False:", count=1)
+    active, _ = _findings(root2, rules=["config-parity"])
+    assert any("heartbeat_rounds" in f.message and "sweep.py"
+               in f.message for f in active)
+
+
 # --------------------------------------------------------------------------
 # perf observability: raw jits off the perfscope funnel (ISSUE 5)
 # --------------------------------------------------------------------------

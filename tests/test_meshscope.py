@@ -1,0 +1,591 @@
+"""meshscope (ISSUE 6): live runtime & multichip scaling observatory.
+
+Acceptance contract:
+  * meshscope off is bit-identical in results AND compile counts for
+    the sharded, multihost, sliced and batched regimes (the heartbeat
+    knob is host-side only; pinned via utils/compile_counter);
+  * `python -m benor_tpu scale` emits a schema-valid scaling manifest
+    with per-shape throughput, efficiency and straggler ratio; the
+    committed SCALING_BASELINE.json passes the gate (exit 0) and an
+    injected 2x step-time straggler fixture both trips the imbalance
+    detector and drives the gate to exit 2;
+  * `watch` tails a live heartbeat file end-to-end.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from benor_tpu.config import SimConfig
+from benor_tpu.state import FaultSpec, init_state
+from benor_tpu.sweep import balanced_inputs
+from benor_tpu.utils.compile_counter import count_backend_compiles
+from benor_tpu.utils.metrics import REGISTRY
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+BASELINE = os.path.join(REPO, "SCALING_BASELINE.json")
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        f"_tool_{name}", os.path.join(TOOLS, f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _livelock_cfg(**kw):
+    """Private-coin count-controlling adversary: forced ties livelock
+    every trial to the round cap — deterministic multi-round work, so
+    heartbeats genuinely fire and bit-identity pins aren't vacuous."""
+    base = dict(n_nodes=24, n_faulty=4, trials=8, delivery="quorum",
+                scheduler="adversarial", coin_mode="private",
+                path="histogram", max_rounds=8, seed=3)
+    base.update(kw)
+    return SimConfig(**base)
+
+
+def _inputs(cfg):
+    faults = FaultSpec.none(cfg.trials, cfg.n_nodes)
+    state = init_state(cfg, balanced_inputs(cfg.trials, cfg.n_nodes),
+                       faults)
+    return state, faults, jax.random.key(cfg.seed)
+
+
+def _assert_state_equal(a, b):
+    np.testing.assert_array_equal(np.asarray(a.x), np.asarray(b.x))
+    np.testing.assert_array_equal(np.asarray(a.decided),
+                                  np.asarray(b.decided))
+    np.testing.assert_array_equal(np.asarray(a.k), np.asarray(b.k))
+    np.testing.assert_array_equal(np.asarray(a.killed),
+                                  np.asarray(b.killed))
+
+
+# --------------------------------------------------------------------------
+# Off-path bit-identity + compile counts, per regime
+# --------------------------------------------------------------------------
+
+
+def test_heartbeat_off_on_bit_identical_sharded():
+    """Sharded regime: heartbeat on publishes (gauges move) but results,
+    recorder AND compile counts match the off run exactly."""
+    from benor_tpu.parallel import make_mesh
+    from benor_tpu.parallel.sharded import run_consensus_slice_sharded
+    from benor_tpu.sim import start_state
+
+    mesh = make_mesh(2, 2)
+    outs, compiles = {}, {}
+    for hb in (0, 2):
+        cfg = _livelock_cfg(record=True, heartbeat_rounds=hb)
+        state, faults, key = _inputs(cfg)
+        st = start_state(cfg, state)
+        args = (cfg, st, faults, key, mesh, 1, cfg.max_rounds + 2)
+        before = REGISTRY.counter("heartbeat.published").value
+        int(run_consensus_slice_sharded(*args)[0])        # warm-up
+        with count_backend_compiles() as cc:
+            out = run_consensus_slice_sharded(*args)
+            int(out[0])
+        outs[hb] = out
+        compiles[hb] = cc.count
+        if hb:
+            assert REGISTRY.counter("heartbeat.published").value > before
+    assert int(outs[0][0]) == int(outs[2][0])
+    _assert_state_equal(outs[0][1], outs[2][1])
+    np.testing.assert_array_equal(np.asarray(outs[0][2]),
+                                  np.asarray(outs[2][2]))
+    # steady state: publishing compiles NOTHING — both paths hit the
+    # jit cache identically
+    assert compiles[0] == compiles[2] == 0
+
+
+def test_heartbeat_off_on_bit_identical_multihost():
+    """Multihost slice wrapper (single-process (1, 2) mesh — the same
+    compiled executable a pod run uses): heartbeat on/off bit-identical
+    in results and compile counts."""
+    from benor_tpu.parallel import make_mesh
+    from benor_tpu.parallel.multihost import run_consensus_slice_multihost
+    from benor_tpu.parallel.sharded import shard_inputs
+    from benor_tpu.sim import start_state
+
+    mesh = make_mesh(1, 2)
+    outs, compiles = {}, {}
+    for hb in (0, 3):
+        cfg = _livelock_cfg(record=True, heartbeat_rounds=hb)
+        state, faults, key = _inputs(cfg)
+        st, fl = shard_inputs(start_state(cfg, state), faults, mesh)
+        args = (cfg, st, fl, key, mesh, 1, cfg.max_rounds + 2)
+        int(run_consensus_slice_multihost(*args)[0])      # warm-up
+        with count_backend_compiles() as cc:
+            out = run_consensus_slice_multihost(*args)
+            int(out[0])
+        outs[hb] = out
+        compiles[hb] = cc.count
+    assert int(outs[0][0]) == int(outs[3][0])
+    _assert_state_equal(outs[0][1], outs[3][1])
+    np.testing.assert_array_equal(np.asarray(outs[0][2]),
+                                  np.asarray(outs[3][2]))
+    assert compiles[0] == compiles[3] == 0
+
+
+def test_heartbeat_off_on_bit_identical_sliced_network(tmp_path):
+    """Sliced regime (TpuNetwork poll loop): heartbeat on writes the
+    JSON-lines plane and closes with done=true, while final state,
+    rounds and compile counts match the off run."""
+    from benor_tpu.api import launch_network
+    from benor_tpu.meshscope.heartbeat import read_heartbeats
+
+    n, f = 10, 5
+    vals = [1, 1, 0, 0, 1, 1, 0, 0, 1, 1]
+    faulty = [True] * f + [False] * (n - f)
+    nets, compiles = {}, {}
+    hb_path = str(tmp_path / "hb.jsonl")
+    for hb in (0, 2):
+        def mk():
+            return launch_network(n, f, vals, faulty, backend="tpu",
+                                  seed=0, delivery="quorum",
+                                  max_rounds=12, poll_rounds=2,
+                                  record=True, heartbeat_rounds=hb)
+        mk().start()                  # warm-up: compile the slice
+        net = mk()
+        if hb:
+            net.heartbeat_path = hb_path
+        with count_backend_compiles() as cc:
+            net.start()
+        nets[hb] = net
+        compiles[hb] = cc.count
+    assert nets[0].rounds_executed == nets[2].rounds_executed
+    assert nets[0].get_states() == nets[2].get_states()
+    assert nets[0].get_round_history() == nets[2].get_round_history()
+    assert compiles[0] == compiles[2] == 0
+    beats = read_heartbeats(hb_path)
+    assert beats and beats[-1]["done"] is True
+    assert beats[-1]["round"] == nets[2].rounds_executed
+    # the livelock never decides: the recorder-derived fraction says so
+    assert beats[-1]["decided_frac"] == 0.0
+    assert any(b["rounds_per_sec"] is not None for b in beats)
+
+
+def test_one_shot_network_heartbeat_publishes_final_beat(tmp_path):
+    """poll_rounds=0 (one-shot run_consensus) has no slice boundaries,
+    but an armed heartbeat must not be a silent no-op — `watch` would
+    block on an empty file forever.  The run publishes its one honest
+    record: the final state, done=true."""
+    from benor_tpu.api import launch_network
+    from benor_tpu.meshscope.heartbeat import read_heartbeats
+
+    n, f = 10, 5
+    vals = [1, 1, 0, 0, 1, 1, 0, 0, 1, 1]
+    faulty = [True] * f + [False] * (n - f)
+    hb_path = str(tmp_path / "hb.jsonl")
+    net = launch_network(n, f, vals, faulty, backend="tpu", seed=0,
+                         delivery="quorum", max_rounds=12,
+                         poll_rounds=0, record=True, heartbeat_rounds=2)
+    net.heartbeat_path = hb_path
+    net.start()
+    beats = read_heartbeats(hb_path)
+    assert len(beats) == 1
+    assert beats[0]["done"] is True
+    assert beats[0]["round"] == net.rounds_executed
+
+
+def test_sharded_network_heartbeat_not_double_published(tmp_path):
+    """TpuNetwork.start on a mesh runs its OWN publisher (it owns the
+    file plane); the sharded slice wrapper must not publish the same
+    beat a second time into the shared heartbeat.* gauges — every
+    registry publish has exactly one JSON-lines record."""
+    from benor_tpu.api import launch_network
+    from benor_tpu.meshscope.heartbeat import read_heartbeats
+
+    n, f = 10, 5
+    vals = [1, 1, 0, 0, 1, 1, 0, 0, 1, 1]
+    faulty = [True] * f + [False] * (n - f)
+    hb_path = str(tmp_path / "hb.jsonl")
+    net = launch_network(n, f, vals, faulty, backend="tpu", seed=0,
+                         delivery="quorum", max_rounds=12,
+                         poll_rounds=2, record=True, heartbeat_rounds=2,
+                         mesh_shape=(1, 2))
+    net.heartbeat_path = hb_path
+    before = REGISTRY.counter("heartbeat.published").value
+    net.start()
+    published = REGISTRY.counter("heartbeat.published").value - before
+    beats = read_heartbeats(hb_path)
+    assert beats and beats[-1]["done"] is True
+    assert published == len(beats)
+
+
+def test_heartbeat_off_on_bit_identical_batched_sweep():
+    """Batched dynamic-F sweep: per-bucket heartbeats (progress plane)
+    leave every point summary and the compile count untouched."""
+    from benor_tpu.sweep import run_curve_batched
+
+    f_values = [2, 4]
+    curves, compiles = {}, {}
+    for hb in (0, 2):
+        cfg = _livelock_cfg(heartbeat_rounds=hb)
+        before = REGISTRY.counter("heartbeat.published").value
+        cb = run_curve_batched(cfg, f_values)
+        curves[hb] = cb
+        compiles[hb] = cb.compile_count
+        if hb:
+            assert REGISTRY.counter("heartbeat.published").value > before
+            assert REGISTRY.gauge("heartbeat.progress").value == 1.0
+    for p0, p1 in zip(curves[0].points, curves[2].points):
+        d0, d1 = p0.to_dict(), p1.to_dict()
+        for volatile in ("seconds", "trials_per_sec"):
+            d0.pop(volatile), d1.pop(volatile)
+        assert d0 == d1
+    assert compiles[0] == compiles[2]
+
+
+# --------------------------------------------------------------------------
+# Telemetry: collective attribution, memory, stragglers, shard tracks
+# --------------------------------------------------------------------------
+
+
+def test_collective_bytes_derive_from_layout_tables():
+    from benor_tpu.meshscope import collective_bytes
+    from benor_tpu.ops.pallas_round import PARTIAL_COLS
+    from benor_tpu.state import REC_WIDTH, WIT_WIDTH
+
+    cfg = _livelock_cfg(record=True, witness_trials=(0, 1),
+                        witness_nodes=4)
+    fam = collective_bytes(cfg)
+    assert fam["recorder_psum"] == REC_WIDTH * 4
+    assert fam["witness_psum"] == 2 * 4 * WIT_WIDTH * 4
+    assert fam["tally_psum"] == 2 * cfg.trials * 3 * 4
+    assert fam["total"] == sum(v for k, v in fam.items() if k != "total")
+    assert REGISTRY.gauge(
+        "meshscope.collective.recorder_psum_bytes").value == REC_WIDTH * 4
+
+    # dense path swaps the psum family for the all-gather family
+    dense = collective_bytes(_livelock_cfg(path="dense"))
+    assert "tally_allgather" in dense and "tally_psum" not in dense
+
+    # the fused round's only traffic is the partial-column rows
+    fused = SimConfig(n_nodes=128, n_faulty=26, trials=4,
+                      delivery="quorum", scheduler="adversarial",
+                      coin_mode="common", path="histogram",
+                      use_pallas_round=True, record=True, max_rounds=8)
+    from benor_tpu.ops.tally import pallas_round_active
+    assert pallas_round_active(fused)
+    fp = collective_bytes(fused)
+    assert fp["pallas_partials"] == 2 * 4 * PARTIAL_COLS * 4
+    assert "recorder_psum" not in fp      # rides the partial columns
+
+
+def test_straggler_detector_trips_on_2x_step_time():
+    from benor_tpu.meshscope import STRAGGLER_TRIP, detect_stragglers
+
+    before = REGISTRY.counter("meshscope.straggler_detected").value
+    ok = detect_stragglers([1.0, 1.0, 1.0, 1.1])
+    assert not ok.tripped and ok.stragglers == []
+    assert REGISTRY.counter("meshscope.straggler_detected").value == before
+
+    # the acceptance fixture: one shard at 2x the median step time
+    bad = detect_stragglers([1.0, 1.0, 1.0, 2.0])
+    assert bad.tripped and bad.ratio == pytest.approx(2.0)
+    assert bad.stragglers == [3]
+    assert bad.ratio >= STRAGGLER_TRIP
+    assert REGISTRY.counter(
+        "meshscope.straggler_detected").value == before + 1
+    assert REGISTRY.gauge(
+        "meshscope.straggler_ratio").value == pytest.approx(2.0)
+
+
+def test_device_memory_watermarks_and_probe():
+    from benor_tpu.meshscope import probe_shard_step_times, \
+        sample_device_memory
+    from benor_tpu.parallel import make_mesh
+
+    keep = jnp.ones((64, 64), jnp.float32) + 0    # a live buffer to see
+    rows = sample_device_memory()
+    assert len(rows) == len(jax.local_devices())
+    assert any(r["live_bytes"] > 0 for r in rows)
+    assert REGISTRY.gauge("meshscope.mem.live_bytes.d0").value >= 0
+    del keep
+
+    mesh = make_mesh(1, 4)
+    times = probe_shard_step_times(mesh=mesh, reps=2, size=64)
+    assert len(times) == 4 and all(t > 0 for t in times)
+
+
+def test_export_shard_trace_renders_per_shard_tracks(tmp_path):
+    from benor_tpu.meshscope import export_shard_trace
+
+    path = str(tmp_path / "shards.trace.json")
+    n = export_shard_trace(path, [[0.1, 0.1], [0.2, 0.2]])
+    assert n == 4
+    doc = json.load(open(path))
+    tids = {e["tid"] for e in doc["traceEvents"]}
+    assert tids == {"shard 0", "shard 1"}
+    slow = [e for e in doc["traceEvents"] if e["tid"] == "shard 1"]
+    assert all(e["dur"] == pytest.approx(0.2e6) for e in slow)
+
+
+# --------------------------------------------------------------------------
+# Scaling ladder, manifest schema, gate exit codes
+# --------------------------------------------------------------------------
+
+
+def _small_ladder():
+    from benor_tpu.meshscope import (build_scaling_manifest,
+                                     run_scaling_ladder)
+    rows, scale = run_scaling_ladder([1, 2], n_nodes=64, trials=4,
+                                     max_rounds=4, reps=1)
+    return build_scaling_manifest(rows, "weak", "nodes", scale)
+
+
+def test_scaling_ladder_manifest_schema_valid():
+    cms = _load_tool("check_metrics_schema")
+    manifest = _small_ladder()
+    assert cms.check_scaling_manifest(manifest) == []
+    rows = manifest["rows"]
+    assert [r["devices"] for r in rows] == [1, 2]
+    assert rows[0]["efficiency"] == 1.0
+    # weak mode: the node axis grew with the rung; the livelock shape
+    # makes the round count the full cap on every rung
+    assert rows[1]["n_nodes"] == 2 * rows[0]["n_nodes"]
+    assert all(r["rounds"] == 4 for r in rows)
+    assert all(r["node_rounds_per_sec"] > 0 for r in rows)
+    assert all(len(r["shard_probe_s"]) == r["devices"] for r in rows)
+
+
+def test_scaling_manifest_cross_field_validation():
+    cms = _load_tool("check_metrics_schema")
+    manifest = _small_ladder()
+    tampered = json.loads(json.dumps(manifest))
+    tampered["rows"][1]["efficiency"] = 0.123456
+    errs = cms.check_scaling_manifest(tampered)
+    assert any("throughput ratio" in e for e in errs)
+
+    no_anchor = json.loads(json.dumps(manifest))
+    no_anchor["rows"] = [r for r in no_anchor["rows"]
+                         if r["devices"] != 1]
+    errs = cms.check_scaling_manifest(no_anchor)
+    assert any("1-device rung" in e for e in errs)
+
+    bad_mesh = json.loads(json.dumps(manifest))
+    bad_mesh["rows"][1]["mesh_shape"] = [1, 3]
+    errs = cms.check_scaling_manifest(bad_mesh)
+    assert any("mesh_shape" in e for e in errs)
+
+
+def test_scale_cli_emits_schema_valid_manifest(tmp_path):
+    """`python -m benor_tpu scale --mesh 1,2 --profile-out ...` on CPU:
+    the acceptance surface, end to end in-process."""
+    from benor_tpu.__main__ import main
+
+    out = str(tmp_path / "scaling.json")
+    rc = main(["scale", "--mesh", "1,2", "--n", "64", "--trials", "4",
+               "--max-rounds", "4", "--reps", "1",
+               "--profile-out", out,
+               "--baseline", str(tmp_path / "missing.json")])
+    assert rc == 0
+    manifest = json.load(open(out))
+    assert manifest["kind"] == "scaling_manifest"
+    cms = _load_tool("check_metrics_schema")
+    assert cms.check_scaling_manifest(manifest) == []
+    assert {r["devices"] for r in manifest["rows"]} == {1, 2}
+
+
+def test_committed_baseline_passes_gate_and_straggler_fixture_exits_2(
+        tmp_path):
+    """Acceptance: SCALING_BASELINE.json passes the gate (exit 0); an
+    injected 2x step-time straggler drives it to exit 2; a different
+    platform is refused with exit 3.  Runs the real tool as a
+    subprocess — the no-jax stdlib path CI takes."""
+    assert os.path.exists(BASELINE)
+    tool = os.path.join(TOOLS, "check_scaling_regression.py")
+    r = subprocess.run([sys.executable, tool, BASELINE],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    fixture = json.load(open(BASELINE))
+    fixture["rows"][-1]["straggler_ratio"] = 2.0
+    fx_path = str(tmp_path / "straggler.json")
+    json.dump(fixture, open(fx_path, "w"))
+    r = subprocess.run([sys.executable, tool, fx_path],
+                       capture_output=True, text=True)
+    assert r.returncode == 2
+    assert "straggler_ratio" in r.stdout
+
+    other = json.load(open(BASELINE))
+    other["platform"] = "tpu"
+    ot_path = str(tmp_path / "other.json")
+    json.dump(other, open(ot_path, "w"))
+    r = subprocess.run([sys.executable, tool, ot_path],
+                       capture_output=True, text=True)
+    assert r.returncode == 3
+
+
+def test_scalegate_efficiency_collapse_rules():
+    from benor_tpu.meshscope import compare_scaling
+
+    base = json.load(open(BASELINE))
+    assert compare_scaling(base, base) == []
+
+    # efficiency under the band
+    worse = json.loads(json.dumps(base))
+    worse["rows"][1]["efficiency"] = base["rows"][1]["efficiency"] * 0.5
+    findings = compare_scaling(worse, base)
+    assert any(f.metric == "efficiency" for f in findings)
+
+    # missing/zero efficiency = the worst collapse
+    zero = json.loads(json.dumps(base))
+    zero["rows"][1]["efficiency"] = 0.0
+    findings = compare_scaling(zero, base)
+    assert any("worst possible collapse" in f.message for f in findings)
+
+    # a vanished rung is a finding on its own
+    gone = json.loads(json.dumps(base))
+    gone["rows"] = gone["rows"][:-1]
+    findings = compare_scaling(gone, base)
+    assert any(f.metric == "row" for f in findings)
+
+    # the straggler trip is ABSOLUTE: it fires even on a manifest rung
+    # the baseline never captured (`scale --mesh 1,2,4` vs a d=1,2
+    # baseline must not silently skip the d=4 health check)
+    wider = json.loads(json.dumps(base))
+    extra = dict(wider["rows"][-1])
+    extra["devices"] *= 2
+    extra["straggler_ratio"] = 2.0
+    wider["rows"].append(extra)
+    findings = compare_scaling(wider, base)
+    assert [f.metric for f in findings] == ["straggler_ratio"]
+    assert findings[0].devices == extra["devices"]
+
+
+# --------------------------------------------------------------------------
+# Satellite: the MULTICHIP_r*.json trajectory walk
+# --------------------------------------------------------------------------
+
+
+def test_multichip_trajectory_missing_or_zero_is_worst_collapse(tmp_path):
+    from benor_tpu.perfscope.baseline import check_multichip_trajectory
+
+    def rec(name, **kw):
+        path = str(tmp_path / name)
+        json.dump(kw, open(path, "w"))
+        return path
+
+    paths = [
+        rec("MULTICHIP_r01.json", n_devices=8, ok=False, rc=124),
+        rec("MULTICHIP_r02.json", n_devices=8, ok=True,
+            scaling_efficiency=0.9),
+        rec("MULTICHIP_r03.json", n_devices=8, ok=True),    # missing
+        rec("MULTICHIP_r04.json", n_devices=8, ok=True,
+            scaling_efficiency=0.0),                        # zero
+        rec("MULTICHIP_r05.json", n_devices=4, ok=True),    # other key
+    ]
+    findings = check_multichip_trajectory(paths)
+    regressions = [f for f in findings if f.startswith("REGRESSION")]
+    # r03 (missing) and r04 (zero) both collapse vs r02's 0.9; r05 has
+    # no same-device-count bar so it only notes
+    assert len(regressions) == 2
+    assert "r03" in regressions[0] and "r04" in regressions[1]
+    assert any("treated as 0.0" in f for f in findings)
+    assert any("skipped/failed" in f for f in findings)
+
+    # the committed repo records predate the metric: notes only, no
+    # regression (nothing ever set an efficiency bar)
+    import glob
+    committed = sorted(glob.glob(os.path.join(REPO, "MULTICHIP_r*.json")))
+    assert committed
+    assert not any(f.startswith("REGRESSION")
+                   for f in check_multichip_trajectory(committed))
+
+
+# --------------------------------------------------------------------------
+# Heartbeat plane + watch CLI
+# --------------------------------------------------------------------------
+
+
+def test_heartbeat_publisher_records_and_gauges(tmp_path):
+    from benor_tpu.meshscope import HeartbeatPublisher, read_heartbeats
+
+    cfg = _livelock_cfg(heartbeat_rounds=1)
+    path = str(tmp_path / "hb.jsonl")
+    pub = HeartbeatPublisher(cfg, path=path, label="t")
+    pub.publish(2, decided_frac=0.25)
+    time.sleep(0.01)
+    pub.publish(4, decided_frac=0.5)
+    pub.close(8)
+    recs = read_heartbeats(path)
+    assert [r["round"] for r in recs] == [2, 4, 8]
+    assert recs[1]["rounds_per_sec"] > 0
+    assert recs[1]["eta_s"] is not None and recs[1]["eta_s"] >= 0
+    assert recs[-1]["done"] is True and recs[-1]["progress"] == 1.0
+    assert REGISTRY.gauge("heartbeat.round").value == 8.0
+    for r in recs:
+        assert r["kind"] == "heartbeat" and "ts" in r
+
+
+def test_slice_publisher_resets_between_runs():
+    """The per-label slice publisher is only reused when a slice picks
+    up exactly where the previous one stopped; a NEW run (from_round=1)
+    gets fresh rate state even when its boundary round is past the old
+    run's — otherwise its first beat's rounds/sec would span the idle
+    and compile gap between the two runs."""
+    from benor_tpu.meshscope import heartbeat as hb
+
+    cfg = _livelock_cfg(heartbeat_rounds=2)
+    label = "test.slice.reset"
+    hb.publish_slice_heartbeat(cfg, 5, label=label, from_round=1)
+    pub1 = hb._SLICE_PUBS[label][0]
+    # continuation: next slice of the same run keeps the publisher
+    hb.publish_slice_heartbeat(cfg, 9, label=label, from_round=5)
+    assert hb._SLICE_PUBS[label][0] is pub1
+    # fresh run whose first boundary lands PAST the old cursor: the
+    # from_round=1 restart is the only signal a new run began
+    hb.publish_slice_heartbeat(cfg, 11, label=label, from_round=1)
+    assert hb._SLICE_PUBS[label][0] is not pub1
+
+
+def test_watch_cli_tails_live_heartbeat_end_to_end(tmp_path, capsys):
+    """A writer thread appends beats while `watch` tails the file — the
+    full live-progress loop, two actors, one file."""
+    from benor_tpu.__main__ import main
+    from benor_tpu.meshscope import HeartbeatPublisher
+
+    cfg = _livelock_cfg(heartbeat_rounds=1)
+    path = str(tmp_path / "hb.jsonl")
+
+    def writer():
+        pub = HeartbeatPublisher(cfg, path=path, label="sweep")
+        for r in (2, 4, 6):
+            pub.publish(r, decided_frac=r / 8)
+            time.sleep(0.05)
+        pub.close(8)
+
+    t = threading.Thread(target=writer)
+    t.start()
+    try:
+        rc = main(["watch", path, "--poll", "0.02", "--timeout", "20"])
+    finally:
+        t.join()
+    assert rc == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 4
+    assert "round=2/8" in lines[0]
+    assert lines[-1].endswith("DONE")
+
+
+def test_watch_cli_times_out_on_silent_file(tmp_path, capsys):
+    from benor_tpu.__main__ import main
+
+    path = str(tmp_path / "empty.jsonl")
+    open(path, "w").close()
+    rc = main(["watch", path, "--poll", "0.02", "--timeout", "0.1"])
+    assert rc == 1

@@ -151,3 +151,77 @@ def test_compile_counter_feeds_registry():
                                      ).block_until_ready()
     assert cc.count >= 1
     assert c.value >= before + cc.count
+
+
+def test_concurrent_writers_exports_never_tear(tmp_path, registry):
+    """ISSUE 6 satellite: the heartbeat publisher (appending JSON lines)
+    and the main loop (snapshot exports) run on different threads; every
+    intermediate file must parse as clean JSON-lines and the final
+    counts must be exact — no interleaved bytes, no torn snapshots."""
+    import threading
+
+    snap_path = str(tmp_path / "snap.jsonl")
+    hb_path = str(tmp_path / "hb.jsonl")
+    writers, incs_each, beats_each = 4, 200, 50
+    stop = threading.Event()
+    torn = []
+
+    def hammer(i):
+        c = registry.counter("w.count")
+        t = registry.timer("w.timer")
+        for j in range(incs_each):
+            c.inc()
+            if j % (incs_each // beats_each) == 0:
+                with t.time():
+                    pass
+                metrics.append_jsonl(hb_path, {"kind": "heartbeat",
+                                               "writer": i, "beat": j})
+
+    def exporter():
+        while not stop.is_set():
+            metrics.export_jsonl(snap_path, registry)
+            try:
+                with open(snap_path) as fh:
+                    for line in fh:
+                        json.loads(line)
+            except ValueError as e:   # a torn export would land here
+                torn.append(str(e))
+
+    threads = [threading.Thread(target=hammer, args=(i,))
+               for i in range(writers)]
+    exp = threading.Thread(target=exporter)
+    exp.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    exp.join()
+    assert torn == []
+    # exact totals: no lost increments under contention
+    metrics.export_jsonl(snap_path, registry)
+    snap = {m["name"]: m for m in registry.snapshot()}
+    assert snap["w.count"]["value"] == writers * incs_each
+    assert snap["w.timer"]["count"] == writers * beats_each
+    # every heartbeat line is whole and attributable
+    lines = [json.loads(ln) for ln in open(hb_path)]
+    assert len(lines) == writers * beats_each
+    per_writer = {i: 0 for i in range(writers)}
+    for rec in lines:
+        assert rec["kind"] == "heartbeat" and "ts" in rec
+        per_writer[rec["writer"]] += 1
+    assert all(n == beats_each for n in per_writer.values())
+
+
+def test_export_jsonl_is_atomic_replace(tmp_path, registry):
+    """export_jsonl rewrites via temp-file + os.replace: no .tmp
+    leftovers and the target always holds one complete snapshot."""
+    import os
+
+    registry.counter("x").inc()
+    path = str(tmp_path / "m.jsonl")
+    for _ in range(3):
+        metrics.export_jsonl(path, registry)
+    assert [p for p in os.listdir(tmp_path) if ".tmp." in p] == []
+    recs = [json.loads(ln) for ln in open(path)]
+    assert any(r["name"] == "x" for r in recs)

@@ -30,7 +30,12 @@ JSON report (``python -m benor_tpu lint --format json`` — validated by
 ``check_lint_report`` against the inline ``LINT_REPORT_SCHEMA``), or
 perfscope manifest (``python -m benor_tpu profile`` /
 ``PERF_BASELINE.json``, tagged ``kind: perf_manifest`` — validated by
-``check_perf_manifest`` against ``tools/perf_report_schema.json``).
+``check_perf_manifest`` against ``tools/perf_report_schema.json``), or
+scaling manifest (``python -m benor_tpu scale`` /
+``SCALING_BASELINE.json``, tagged ``kind: scaling_manifest`` —
+validated by ``check_scaling_manifest`` against
+``tools/scaling_manifest_schema.json`` plus the efficiency/mesh-shape
+cross-field pins).
 """
 
 from __future__ import annotations
@@ -203,6 +208,70 @@ def check_perf_manifest(manifest: dict,
     return errors
 
 
+SCALING_SCHEMA_PATH = os.path.join(HERE, "scaling_manifest_schema.json")
+
+
+def check_scaling_manifest(manifest: dict,
+                           schema_path: str = SCALING_SCHEMA_PATH
+                           ) -> List[str]:
+    """Validate a scaling manifest (`python -m benor_tpu scale`,
+    SCALING_BASELINE.json, bench.py's meshscope sidecar blob) against
+    tools/scaling_manifest_schema.json; returns the error list (empty =
+    ok).
+
+    ``rows`` elements are validated against the schema file's ``row``
+    entry (the same indirection the perf manifest uses for its dynamic
+    regime map), plus the cross-field facts the scaling gate relies on:
+    at least one rung, a mandatory 1-device rung (efficiency's anchor),
+    unique (devices, n_nodes) rungs, mesh_shape product == devices, and
+    efficiency == node_rounds_per_sec / (devices x the 1-device rung's
+    node_rounds_per_sec) — a drifted efficiency would silently skew the
+    gate's whole verdict."""
+    errors: List[str] = []
+    with open(schema_path) as fh:
+        schema = json.load(fh)
+    _validate(manifest, schema, "$", errors)
+    if errors:
+        return errors
+    row_schema = schema["row"]
+    rows = manifest["rows"]
+    if not rows:
+        return ["$.rows: a scaling manifest must carry at least one "
+                "rung"]
+    for i, row in enumerate(rows):
+        before = len(errors)
+        _validate(row, row_schema, f"$.rows[{i}]", errors)
+        if len(errors) > before:
+            continue    # this rung's cross-field checks would be noise
+        if row["devices"] != row["mesh_shape"][0] * row["mesh_shape"][1]:
+            errors.append(f"$.rows[{i}]: mesh_shape {row['mesh_shape']} "
+                          f"does not multiply to devices="
+                          f"{row['devices']}")
+    if errors:
+        return errors
+    rungs = [(r["devices"], r["n_nodes"]) for r in rows]
+    if len(set(rungs)) != len(rungs):
+        errors.append(f"$.rows: duplicate (devices, n_nodes) rungs in "
+                      f"{rungs}")
+    ones = [r for r in rows if r["devices"] == 1]
+    if not ones:
+        errors.append("$.rows: no 1-device rung — efficiency has no "
+                      "anchor and the gate would pass vacuously")
+        return errors
+    base = ones[0]["node_rounds_per_sec"]
+    for i, row in enumerate(rows):
+        ideal = row["devices"] * base
+        eff = row.get("efficiency")
+        if not ideal:
+            continue
+        want = row["node_rounds_per_sec"] / ideal
+        if eff is None or abs(eff - want) > max(1e-3, 1e-3 * want):
+            errors.append(
+                f"$.rows[{i}]: efficiency {eff} != throughput ratio vs "
+                f"the 1-device rung ({want:.6f})")
+    return errors
+
+
 WITNESS_SCHEMA_PATH = os.path.join(HERE, "witness_bundle_schema.json")
 
 
@@ -275,6 +344,14 @@ def main(argv=None) -> int:
         for e in errors:
             print(f"FAIL {e}", file=sys.stderr)
         print(f"{os.path.basename(path)}: witness bundle "
+              f"{'OK' if not errors else 'INVALID'}")
+        return 1 if errors else 0
+    if detail.get("kind") == "scaling_manifest":
+        # a meshscope scaling manifest (scale CLI / SCALING_BASELINE)
+        errors = check_scaling_manifest(detail)
+        for e in errors:
+            print(f"FAIL {e}", file=sys.stderr)
+        print(f"{os.path.basename(path)}: scaling manifest "
               f"{'OK' if not errors else 'INVALID'}")
         return 1 if errors else 0
     if detail.get("kind") == "perf_manifest":

@@ -45,6 +45,7 @@ BASELINE_MODULE = os.path.join(REPO, "benor_tpu", "perfscope",
                                "baseline.py")
 DEFAULT_BASELINE = os.path.join(REPO, "PERF_BASELINE.json")
 DEFAULT_TRAJECTORY = os.path.join(REPO, "BENCH_r*.json")
+MULTICHIP_TRAJECTORY = os.path.join(REPO, "MULTICHIP_r*.json")
 
 
 def _load_baseline_module():
@@ -82,7 +83,11 @@ def main(argv=None) -> int:
                     default=None, metavar="GLOB",
                     help="also walk the committed bench records for "
                          "same-platform throughput collapses (default "
-                         "glob: BENCH_r*.json in the repo root)")
+                         "glob: BENCH_r*.json in the repo root) AND the "
+                         "MULTICHIP_r*.json series for scaling-"
+                         "efficiency collapses (missing/zero "
+                         "scaling_efficiency on an ok record = the "
+                         "worst collapse)")
     ap.add_argument("--strict", action="store_true",
                     help="a missing baseline is exit 3, not a pass")
     args = ap.parse_args(argv)
@@ -131,6 +136,19 @@ def main(argv=None) -> int:
         else:
             print(f"trajectory: no same-platform collapse across "
                   f"{len(paths)} records")
+        # the multichip capture series rides the same flag: a missing or
+        # zero scaling_efficiency on an ok record is the WORST collapse
+        # (mirroring the node_rounds_per_sec=0.0 rule; see
+        # baseline.check_multichip_trajectory)
+        mpaths = sorted(glob.glob(MULTICHIP_TRAJECTORY))
+        mfindings = baseline_mod.check_multichip_trajectory(mpaths)
+        for f in mfindings:
+            print(f)
+        if any(f.startswith("REGRESSION") for f in mfindings):
+            rc = max(rc, 2)
+        else:
+            print(f"multichip trajectory: no scaling-efficiency "
+                  f"collapse across {len(mpaths)} records")
 
     return rc
 

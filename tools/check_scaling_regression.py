@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Scaling regression gate: manifest vs the committed SCALING_BASELINE.
+
+Compares a scaling manifest (``python -m benor_tpu scale
+--profile-out``) against a committed baseline manifest with the
+efficiency / straggler / determinism rules in
+``benor_tpu/meshscope/scalegate.py`` — efficiency (throughput vs d x the
+1-device rung) gates at a ratio band, a missing or zero efficiency where
+the baseline had substance is the WORST collapse, and a straggler ratio
+at or past the absolute trip (default 1.5, so a 2x step-time straggler
+always fires) is a regression on its own.  Wall clocks are carried for
+trend reading but never banded.
+
+Exit codes (the CI contract, same convention as
+``check_perf_regression.py`` / ``benor_tpu lint`` / ``benor_tpu audit``):
+
+  0  in-band (or nothing to compare: use --strict to forbid that)
+  2  at least one scaling regression / straggler trip
+  3  the documents are not comparable (different platform / mode / axis
+     / scale / schema) or unreadable — the gate REFUSES rather than
+     producing confident nonsense; recapture at the baseline scale or
+     re-baseline
+
+NO-JAX CONTRACT: this script must gate a CI image without initializing
+any backend, so it loads ``benor_tpu/meshscope/scalegate.py`` by FILE
+PATH — importing the ``benor_tpu.meshscope`` package would pull in jax
+via telemetry.py.  scalegate.py is stdlib-only by design; this loader
+keeps it honest (an import creep there breaks this gate immediately).
+
+Usage:
+    python tools/check_scaling_regression.py MANIFEST [BASELINE]
+        [--efficiency-band X] [--straggler-trip X] [--strict]
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+SCALEGATE_MODULE = os.path.join(REPO, "benor_tpu", "meshscope",
+                                "scalegate.py")
+DEFAULT_BASELINE = os.path.join(REPO, "SCALING_BASELINE.json")
+
+
+def _load_scalegate():
+    """meshscope/scalegate.py as a standalone module (see NO-JAX
+    CONTRACT in the module docstring)."""
+    spec = importlib.util.spec_from_file_location("_meshscope_scalegate",
+                                                  SCALEGATE_MODULE)
+    mod = importlib.util.module_from_spec(spec)
+    # dataclass processing resolves string annotations through
+    # sys.modules[cls.__module__]; an unregistered module breaks it
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="scaling manifest vs baseline regression gate "
+                    "(exit 0 in-band, 2 regression, 3 incomparable)")
+    ap.add_argument("manifest", help="manifest to check (scale "
+                                     "--profile-out output)")
+    ap.add_argument("baseline", nargs="?", default=DEFAULT_BASELINE,
+                    help="baseline manifest (default: the committed "
+                         "SCALING_BASELINE.json)")
+    ap.add_argument("--efficiency-band", type=float, default=None,
+                    help="floor on new/baseline efficiency ratio "
+                         "(default: scalegate.EFFICIENCY_BAND)")
+    ap.add_argument("--straggler-trip", type=float, default=None,
+                    help="absolute max/median step-time ratio that "
+                         "trips on its own (default: "
+                         "scalegate.STRAGGLER_TRIP)")
+    ap.add_argument("--strict", action="store_true",
+                    help="a missing baseline is exit 3, not a pass")
+    args = ap.parse_args(argv)
+
+    gate = _load_scalegate()
+    if not os.path.exists(args.baseline):
+        print(f"no baseline at {args.baseline} — nothing to gate "
+              f"against (run `python -m benor_tpu scale "
+              f"--update-baseline`)", file=sys.stderr)
+        return 3 if args.strict else 0
+    try:
+        with open(args.manifest) as fh:
+            manifest = json.load(fh)
+        with open(args.baseline) as fh:
+            base = json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"unreadable input: {e}", file=sys.stderr)
+        return 3
+    kw = {}
+    if args.efficiency_band is not None:
+        kw["efficiency_band"] = args.efficiency_band
+    if args.straggler_trip is not None:
+        kw["straggler_trip"] = args.straggler_trip
+    try:
+        findings = gate.compare_scaling(manifest, base, **kw)
+    except gate.IncomparableScaling as e:
+        print(f"not comparable: {e}", file=sys.stderr)
+        return 3
+    for f in findings:
+        print(f"REGRESSION: {f.message}")
+    if findings:
+        return 2
+    print(f"{os.path.basename(args.manifest)}: in-band vs "
+          f"{os.path.basename(args.baseline)} "
+          f"({len(manifest.get('rows', []))} rungs)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
