@@ -472,7 +472,15 @@ def _profile(args) -> int:
     with trace_cm as trace_path:
         reports = capture_all(regimes=regimes,
                               steady_reps=args.steady_reps, **scale)
-    manifest = build_manifest(reports, scale)
+        fvx = None
+        if regimes is None:
+            # the paired fused-vs-XLA measurement (PR 8) rides every FULL
+            # capture; a --regimes subset records an explicit null so the
+            # gate sees "not measured", never a stale pass
+            from .perfscope.regimes import capture_fused_vs_xla
+            fvx = capture_fused_vs_xla(steady_reps=args.steady_reps,
+                                       **scale)
+    manifest = build_manifest(reports, scale, fused_vs_xla=fvx)
     if args.trace_dir:
         # the XLA trace and the registry's counter tracks side by side:
         # load both files into ui.perfetto.dev for one merged timeline

@@ -35,7 +35,8 @@ _KERNEL_TABLES = ("PROP_PARTIAL_LAYOUT", "VOTE_PARTIAL_LAYOUT",
                   "VOTE_RECORD_LAYOUT")
 
 
-def _table(project: Project, rel: str, name: str
+def _table(project: Project, rel: str, name: str,
+           rule_name: str = "layout-overlap"
            ) -> Tuple[Optional[dict], int, List[Finding]]:
     """(table, line, findings): parse one layout table; a missing or
     non-literal table is itself a finding (deleting the table must not
@@ -47,7 +48,7 @@ def _table(project: Project, rel: str, name: str
     line = assign_line(src, name)
     if table is None:
         return None, line, [Finding(
-            "layout-overlap", rel, line, 0,
+            rule_name, rel, line, 0,
             f"machine-readable layout table {name} is missing (or no "
             f"longer a pure literal) — the kernels and the layout "
             f"checker both consume it",
@@ -57,7 +58,7 @@ def _table(project: Project, rel: str, name: str
             isinstance(v, tuple) and len(v) == 2 and
             all(isinstance(x, int) for x in v) for v in table.values()):
         return None, line, [Finding(
-            "layout-overlap", rel, line, 0,
+            rule_name, rel, line, 0,
             f"layout table {name} must map name -> (base, width) int "
             f"pairs",
             hint="see state.REC_LAYOUT for the shape")]
@@ -70,7 +71,8 @@ def _by_base(table: dict) -> List[Tuple[str, int, int]]:
 
 
 def _check_ranges(rel: str, line: int, label: str, entries,
-                  start: int) -> List[Finding]:
+                  start: int,
+                  rule_name: str = "layout-overlap") -> List[Finding]:
     """Disjoint + contiguous from ``start`` (positional renderers and
     the kernels' emission order both index columns densely)."""
     findings = []
@@ -78,12 +80,12 @@ def _check_ranges(rel: str, line: int, label: str, entries,
     for name, base, width in entries:
         if width < 1:
             findings.append(Finding(
-                "layout-overlap", rel, line, 0,
+                rule_name, rel, line, 0,
                 f"{label}[{name!r}] has width {width} < 1"))
             continue
         if base < expect:
             findings.append(Finding(
-                "layout-overlap", rel, line, 0,
+                rule_name, rel, line, 0,
                 f"{label}[{name!r}] at columns [{base}, {base + width}) "
                 f"overlaps the previous entry (next free column is "
                 f"{expect})",
@@ -91,7 +93,7 @@ def _check_ranges(rel: str, line: int, label: str, entries,
                      "follow the table automatically"))
         elif base > expect:
             findings.append(Finding(
-                "layout-overlap", rel, line, 0,
+                rule_name, rel, line, 0,
                 f"{label} has a gap before {name!r}: columns "
                 f"[{expect}, {base}) are unassigned — positional "
                 f"consumers (REC_COLUMNS zips, kernel emission order) "
@@ -232,6 +234,113 @@ def check_layout_parity(project: Project) -> List[Finding]:
                     f"buffer",
                     hint="shrink config.WITNESS_MAX_NODES or widen "
                          "PARTIAL_COLS (and re-check VMEM cost)"))
+    return findings
+
+
+@rule("pack-layout", "layout",
+      "the packed-state bit-field table must be overlap-free, dense and "
+      "fit one uint32 word")
+def check_pack_layout(project: Project) -> List[Finding]:
+    """state.PACK_LAYOUT (PR 8) is the declarative bit-field layout of
+    the fused kernels' plane-packed node state — the same silent-
+    corruption surface as the partial-column tables: two fields on the
+    same plane, a gap the loads mis-index across, or a field running off
+    the 32-bit word all keep compiling and merely corrupt one regime's
+    numbers.  Prove: (base, width) ranges disjoint + dense from bit 0,
+    every width >= 1, and total extent <= the word width
+    (state.PACK_NODES_PER_WORD — one bit per node per plane word, so the
+    whole layout must fit a 32-plane stack)."""
+    findings: List[Finding] = []
+    if project.source(STATE_FILE) is None:
+        return findings
+    table, line, errs = _table(project, STATE_FILE, "PACK_LAYOUT",
+                               rule_name="pack-layout")
+    findings += errs
+    if table is None:
+        return findings
+    findings += _check_ranges(STATE_FILE, line, "PACK_LAYOUT",
+                              _by_base(table), 0,
+                              rule_name="pack-layout")
+    src = project.source(STATE_FILE)
+    word = literal_assign(src, "PACK_NODES_PER_WORD")
+    if word is None:
+        findings.append(Finding(
+            "pack-layout", STATE_FILE,
+            assign_line(src, "PACK_NODES_PER_WORD"), 0,
+            "PACK_NODES_PER_WORD is missing (or not a pure literal) — "
+            "the pack word width must be machine-readable",
+            hint="declare it as a literal int next to PACK_LAYOUT"))
+        return findings
+    extent = max(b + w for b, w in table.values())
+    if extent > word:
+        findings.append(Finding(
+            "pack-layout", STATE_FILE, line, 0,
+            f"PACK_LAYOUT spans {extent} bits but the pack word is "
+            f"{word} bits wide: the plane stack could not be transposed "
+            f"into one word per node and the declared widths lie",
+            hint="shrink a field width (the k cap is the usual culprit) "
+                 "or re-base the table"))
+    return findings
+
+
+def _netstate_fields(src) -> Optional[List[str]]:
+    """NetState's annotated field names, by PARSING state.py (never by
+    import — the core.py contract)."""
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.ClassDef) and node.name == "NetState":
+            return [s.target.id for s in node.body
+                    if isinstance(s, ast.AnnAssign)
+                    and isinstance(s.target, ast.Name)]
+    return None
+
+
+@rule("pack-parity", "layout",
+      "PACK_LAYOUT field names must cover NetState's fields plus "
+      "PACK_EXTRA_FIELDS exactly")
+def check_pack_parity(project: Project) -> List[Finding]:
+    """The packed/unpacked parity contract: every NetState leaf must
+    have a bit-field in PACK_LAYOUT (or pack/unpack silently drops
+    state), and every non-NetState field the kernels pack must be
+    declared in PACK_EXTRA_FIELDS (or it rides the stack undocumented).
+    Removing any single field from the table breaks the set equality —
+    the mutation tests in tests/test_lint.py pin that."""
+    findings: List[Finding] = []
+    src = project.source(STATE_FILE)
+    if src is None:
+        return findings
+    table, line, errs = _table(project, STATE_FILE, "PACK_LAYOUT")
+    if table is None:
+        return findings          # pack-layout already reports this
+    extra = literal_assign(src, "PACK_EXTRA_FIELDS")
+    if extra is None or not isinstance(extra, tuple) or not all(
+            isinstance(e, str) for e in extra):
+        findings.append(Finding(
+            "pack-parity", STATE_FILE,
+            assign_line(src, "PACK_EXTRA_FIELDS"), 0,
+            "PACK_EXTRA_FIELDS is missing (or not a literal tuple of "
+            "strings) — the non-NetState packed fields must be declared",
+            hint="declare the extra packed fields as a literal tuple"))
+        return findings
+    fields = _netstate_fields(src)
+    if fields is None:
+        findings.append(Finding(
+            "pack-parity", STATE_FILE, line, 0,
+            "NetState class not found in state.py — the packed/unpacked "
+            "parity check has nothing to compare against"))
+        return findings
+    want = set(fields) | set(extra)
+    have = set(table)
+    if have != want:
+        missing = sorted(want - have)
+        undeclared = sorted(have - want)
+        findings.append(Finding(
+            "pack-parity", STATE_FILE, line, 0,
+            f"PACK_LAYOUT fields and NetState + PACK_EXTRA_FIELDS "
+            f"disagree (unpacked fields with no bit-field: {missing}; "
+            f"packed fields neither NetState nor declared extra: "
+            f"{undeclared})",
+            hint="add/remove the field in PACK_LAYOUT and, for "
+                 "non-NetState fields, PACK_EXTRA_FIELDS together"))
     return findings
 
 

@@ -21,8 +21,9 @@ _TRACED_NS = ("jnp.", "jax.numpy.", "lax.", "jax.lax.")
 
 #: 64-bit dtype spellings — off the int32 state discipline (state.py):
 #: with jax's default x64-disabled config these silently truncate back to
-#: 32 bits; with x64 enabled they double the packed-word width and break
-#: ops/pallas_round.pack_state's bit layout.  Either way: drift.
+#: 32 bits; with x64 enabled they widen the uint32 plane words and break
+#: the state.PACK_LAYOUT bit-plane layout ops/pallas_round.pack_state
+#: builds.  Either way: drift.
 _WIDE_DTYPES = {"jnp.int64", "jnp.uint64", "jnp.float64",
                 "np.int64", "np.uint64", "np.float64",
                 "numpy.int64", "numpy.uint64", "numpy.float64"}
@@ -222,7 +223,8 @@ def check_dtype_drift(project: Project) -> List[Finding]:
                 f"{name} in traced function {info.name!r}: the state "
                 f"discipline is int32 (state.py) — with x64 disabled "
                 f"this silently truncates, with it enabled it breaks "
-                f"the packed-word layout (ops/pallas_round.pack_state)",
+                f"the bit-plane pack layout (state.PACK_LAYOUT / "
+                f"ops/pallas_round.pack_state)",
                 hint="use an int32/float32 dtype on device; 64-bit "
                      "belongs to host-side summaries only"))
     return findings

@@ -332,13 +332,13 @@ class SimConfig:
                 "silent no-op would fake mid-run observability, so use "
                 "backend='tpu'")
         if self.use_pallas_round and self.max_rounds + 1 >= (1 << 26):
-            # pack_state (ops/pallas_round.py) stores k at bits 5..31 of
-            # an int32; k reaches max_rounds + 1, and (k << 5) must stay
-            # positive or the packed decided/killed/faulty bits corrupt
+            # the packed bit-plane layout (state.PACK_LAYOUT) caps the
+            # round counter k at 26 planes; k reaches max_rounds + 1, so
+            # its bit length must fit the declared width
             raise ValueError(
-                "use_pallas_round packs the round counter k into the top "
-                "27 bits of an int32; max_rounds must be < 2**26 - 1 "
-                f"(got {self.max_rounds})")
+                "use_pallas_round packs the round counter k into at most "
+                "26 bit-planes (state.PACK_LAYOUT['k']); max_rounds must "
+                f"be < 2**26 - 1 (got {self.max_rounds})")
         if self.witness_trials is not None:
             # normalize to a sorted unique tuple: the config must stay
             # hashable (jit-static) and the witness row layout deterministic

@@ -94,21 +94,25 @@ def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
                 "dynamic-F tracing cannot drive the fused pallas round "
                 "(kernels bake the quorum into their closures); bucket "
                 "such configs statically (sweep.quorum_specialized)")
-        # Fully-fused round (r3 VERDICT item 2): BOTH phases run as pallas
-        # kernels over the packed per-lane state word
-        # (ops/pallas_round.py) with the decide/adopt/coin/commit chain
-        # inside the vote kernel — no [T,N,3] counts, x1, or coin tensor
-        # ever reaches HBM.  Bit-identical to the unfused pallas path
-        # (same streams), mesh-safe (global-id offsets + psum'd partials).
-        # This per-round wrapper packs/unpacks at the round boundary; the
+        # Fully-fused round (r3 VERDICT item 2, relaid in PR 8): the round
+        # runs as pallas kernels over BIT-PLANE packed state
+        # (state.PACK_LAYOUT — x/decided/killed/coin-commit/faulty bits +
+        # k planes at 32 nodes per uint32 word) with the decide/adopt/
+        # coin/commit chain in-kernel — no [T,N,3] counts, x1, or coin
+        # tensor ever reaches HBM, and on a single device the whole round
+        # is ONE kernel pass (pallas_round.fused_round_pallas).
+        # Bit-identical to the unfused pallas path (same streams),
+        # mesh-safe (global-id offsets + psum'd partials).  This
+        # per-round wrapper packs/unpacks at the round boundary; the
         # single-device runner (sim.run_consensus) instead carries the
-        # packed array through the whole loop (pallas_round.run_packed).
+        # plane stack through the whole loop (pallas_round.run_packed).
         # state.killed is packed PRE-crash-update: the kernels (and
         # sent_hist_from_pack) re-derive killed_now from crash_round + r,
         # matching the XLA path's start-of-round update below.
         from ..ops import pallas_round as pr
-        pack = pr.pack_state(state, faults.faulty)
-        cr = (pr._pad_cr(faults, pack.shape[1])
+        pack = pr.pack_state(cfg, state, faults.faulty)
+        np_total = pack.shape[2] * pr.PACK_NODES_PER_WORD
+        cr = (pr._pad_cr(faults, np_total)
               if cfg.fault_model == "crash_at_round" else None)
         hist1 = pr.sent_hist_from_pack(cfg, pack, cr, r, ctx)
         new_pack, _, _, row, wrow = pr.packed_round(

@@ -1,34 +1,47 @@
-"""Fused round kernels: the whole Ben-Or round as two VMEM passes over a
-PACKED per-lane state word.
+"""Fused round kernels over BIT-PLANE packed node state.
 
 r3 VERDICT item 2 (the HBM roofline gap): on the flagship path each
 phase's sampler kernel (ops/pallas_hist.py:cf_counts_pallas) writes int32
 counts [T, N, 3] (12 B/lane) that a chain of XLA elementwise kernels then
-re-reads — phase 1 to compute x1/vote values, phase 2 to compute
-decide0/decide1 (node.ts:99-104), plurality-adopt (node.ts:106-112), the
-coin (a separate pallas kernel, 4 B/lane write + read), and the commit
-masks — every intermediate materialized in HBM because XLA cannot fuse
-INTO a pallas call.  The two kernels here eliminate all of it, and the
-whole per-lane state travels as ONE int32 word so the boundary costs no
-dtype-conversion or padding copies either:
+re-reads — every intermediate materialized in HBM because XLA cannot fuse
+INTO a pallas call.  PR 8 finishes the job the two-kernel packed pipeline
+started: the hot per-lane state now travels as BIT-PLANES — a uint32
+[T, planes, N/32] stack laid out by the declarative ``state.PACK_LAYOUT``
+table (x 2 bits, decided / killed / coin-commit / faulty 1 bit each, the
+round counter k in ``state.pack_k_bits(cfg)`` planes) at 32 nodes per
+word — and the whole round runs as ONE pallas pass where the regime
+allows it:
 
-    bits 0-1  x (0, 1, 2 = "?")          bit 4   faulty (byzantine flip)
-    bit  2    decided                     bits 5+ k (round counter)
-    bit  3    killed
+  fused_round_pallas    — proposal tallies + majority -> vote values ->
+                          the vote-phase GLOBAL histogram + quorum gate
+                          IN-REGISTER -> vote tallies + coin + decide/
+                          adopt/commit -> the new plane stack, plus both
+                          per-tile partial buffers.  No inter-kernel HBM
+                          round-trip: per round the kernel moves
+                          2 x (6 + k_bits)/8 bytes per node (2.5 B at the
+                          bench geometry's max_rounds=12) where the old
+                          two-kernel int32-word pipeline moved 12 — the
+                          >= 4x traffic cut perfscope prices from the
+                          layout tables (perfscope/roofline.py).
+  proposal_hist_pallas  — the two-kernel fallback's proposal pass (the
+  vote_commit_pallas      cross-shard vote histogram needs an ICI psum
+                          between phases, which no single kernel can
+                          perform), also serving the closed-form
+                          count-controlling adversaries; both now read
+                          and write the plane stack.
 
-  proposal_hist_pallas  — per-lane proposal tallies + majority/tie + the
-                          vote value, reduced IN-KERNEL to per-tile
-                          partials: vote-class histogram + alive count
-                          (~1 B/lane of output; the [T,N,3] counts and
-                          [T,N] x1 never exist).
-  vote_commit_pallas    — per-lane vote tallies + coin + decide/adopt/
-                          commit -> the new packed word, plus per-tile
-                          partials of the NEXT round's proposal histogram
-                          and the settled/unsettled counts (so the
-                          while-loop predicate reads no per-lane data).
+The single-pass kernel engages for counts_mode='sampled' (the CF-regime
+flagship — the memory-bound path the relayout targets) on a single
+device (ctx SINGLE) within the FUSED_ONE_PASS_* VMEM caps; everything
+else — node-sharded meshes, the 'delivered'/'camps' adversaries, larger
+tiles — takes the two-kernel plane path, with bit-identical results
+(README "The fused fast path" documents the demotion policy).  Per-tile
+partials are narrowed from int32 to int16/int8 where the N-F quorum
+bound and the tile width provably fit (``partial_dtype``) and widened
+back to int32 before any cross-tile or cross-shard reduction.
 
-``run_packed`` (used by sim.run_consensus) carries the padded packed
-array through the entire while-loop: pack/unpack happen once per RUN.
+``run_packed`` (used by sim.run_consensus) carries the padded plane
+stack through the entire while-loop: pack/unpack happen once per RUN.
 ``packed_round`` wraps one round for the per-round callers
 (models/benor.py under the sharded runner, trajectory/slice paths).
 
@@ -37,7 +50,9 @@ cf_counts_pallas / equiv_counts_pallas / coin_flips_pallas /
 weak_coin_flips_pallas, so a ``use_pallas_round=True`` run is
 BIT-IDENTICAL to the unfused ``use_pallas_hist=True`` path — pinned by
 tests/test_pallas_round.py, which makes interpret-mode CPU testing exact
-rather than statistical.
+rather than statistical — and the one-pass and two-kernel plane paths
+share every stream and every integer reduction, so regime dispatch can
+never move a result bit (tests/test_packed_state.py).
 
 Engages (ops/tally.py:pallas_round_active) on top of the pallas-hist
 regime for every fault model (equivocate runs the mixed-population
@@ -58,17 +73,27 @@ from .pallas_hist import (_COIN_SALT, _EQUIV_SALT_OFFSET, TILE_N,
                           _bits_to_uniform, _cf_draw, _lane_ids,
                           _ndtri_as241, _stream_scal, _threefry2x32)
 from ..config import VAL0, VAL1, VALQ
-from ..state import NetState
+from ..state import (NetState, PACK_COINED, PACK_DECIDED, PACK_FAULTY,
+                     PACK_K, PACK_KILLED, PACK_LAYOUT,
+                     PACK_NODES_PER_WORD, PACK_STATIC_WIDTH, PACK_X,
+                     pack_k_bits)
 from ..perfscope.instrument import instrumented_jit
 
-_DEC, _KILL, _FAULT, _KSHIFT = 2, 3, 4, 5
-
-#: Physical width of both kernels' [tiles, T, PARTIAL_COLS] per-tile
+#: Physical width of all kernels' [tiles, T, PARTIAL_COLS] per-tile
 #: reduction layout.  128 = one TPU lane register row; every out_spec and
 #: partial constructor below must be sized with THIS NAME (the static
 #: layout checker, analysis/rules_layout.py, flags bare literals) so the
 #: declared layouts and the shipped buffer shapes cannot drift apart.
 PARTIAL_COLS = 128
+
+#: Single-pass fused-kernel caps: the one-pass kernel holds the whole
+#: padded node axis of a trial block in VMEM (per-lane f32 temporaries
+#: for both phases), so it engages only when the padded node count and
+#: the total lane count fit; past either cap packed_round demotes to the
+#: two-kernel plane path (bit-identical — shared streams and integer
+#: reductions).  README "The fused fast path" carries the cost model.
+FUSED_ONE_PASS_MAX_NODES = 8192
+FUSED_ONE_PASS_MAX_LANES = 1 << 18
 
 #: Per-tile partial-column layouts — name -> (base, width), pure literals
 #: (the layout checker PARSES these tables out of this file and proves:
@@ -80,6 +105,9 @@ PARTIAL_COLS = 128
 #:
 #: Proposal kernel: vote-class histogram over honest live lanes + the
 #: tile's alive count; witness blocks (2 cols per watched node) follow.
+#: The single-pass fused kernel emits this SAME layout as its first
+#: partial output, so the cross-regime assembly in packed_round is one
+#: code path.
 PROP_PARTIAL_LAYOUT = {
     "vote_hist": (0, 3),    # cols 0-2: sent-vote class histogram 0/1/"?"
     "alive": (3, 1),        # alive count (quorum gate / n_alive)
@@ -141,6 +169,11 @@ _WITA_BASE = _extent(PROP_PARTIAL_LAYOUT)
 _WITA_PER_NODE = len(WITNESS_PROP_FIELDS)
 _WITB_PER_NODE = len(WITNESS_VOTE_FIELDS)
 
+#: Plane-words per lane tile: each grid step covers TILE_N nodes =
+#: _TILE_W uint32 words per plane.
+_TILE_W = TILE_N // PACK_NODES_PER_WORD
+_X_BITS = PACK_LAYOUT["x"][1]
+
 
 def _witb_base(record: bool) -> int:
     """First vote-kernel witness column: after the base partials and,
@@ -148,6 +181,45 @@ def _witb_base(record: bool) -> int:
     if record:
         return _extent(VOTE_PARTIAL_LAYOUT, VOTE_RECORD_LAYOUT)
     return _extent(VOTE_PARTIAL_LAYOUT)
+
+
+def fused_one_pass_eligible(cfg, trials: int, n_nodes: int) -> bool:
+    """True iff packed_round would take the SINGLE-PASS kernel for this
+    (config, shape) on a single device: sampled counts (the closed-form
+    adversaries run no sampler — nothing to fuse) and the padded node
+    axis within the VMEM caps.  The one condition packed_round's
+    dispatch and perfscope's fused_vs_xla labeling
+    (regimes.capture_fused_vs_xla) both consume — so the measurement can
+    never claim a kernel the dispatch would not run."""
+    from . import tally
+
+    if tally.pallas_round_counts_mode(cfg) != "sampled":
+        return False
+    np_total = n_nodes + (-n_nodes) % TILE_N
+    return (np_total <= FUSED_ONE_PASS_MAX_NODES
+            and trials * np_total <= FUSED_ONE_PASS_MAX_LANES)
+
+
+def partial_dtype(m: int, tile_nodes: int):
+    """Narrowest dtype every per-tile partial column provably fits.
+
+    The quorum bound is the whole trick: per-tile counts (histograms,
+    settled/unsettled, the recorder classes) never exceed the tile's
+    lane count (pads included), and per-lane tallies / margins never
+    exceed the quorum m = N - F — so the bound is max(tile, m) and the
+    kernels can emit int16 partials instead of int32, halving the
+    partial-buffer HBM traffic.  (The int8 rung needs a sub-128-lane
+    tile; with node padding to TILE_N = 512 it is unreachable from the
+    shipped kernels and exists for smaller future tilings.)  Widened
+    back to int32 by packed_round BEFORE any cross-tile or cross-shard
+    sum, so the reductions can never wrap.
+    """
+    bound = max(m, tile_nodes)          # both static python ints
+    if bound < (1 << 7):
+        return jnp.int8
+    if bound < (1 << 15):
+        return jnp.int16
+    return jnp.int32
 
 
 def _witness_cols(scal_ref, shape, witness_ids, n_local, fields):
@@ -158,7 +230,9 @@ def _witness_cols(scal_ref, shape, witness_ids, n_local, fields):
     the NEXT shard's real id range (same caveat _camp_select documents)
     and their in-kernel draws are keyed on those aliased global ids — an
     unmasked pad lane would exactly double the real lane's contribution
-    after the node-axis psum."""
+    after the node-axis psum.  The bit-plane relayout does not move this
+    boundary: pads live inside the last plane words, but their local
+    lane index (word * 32 + bit) is >= n_local exactly as before."""
     node, _ = _lane_ids(scal_ref, shape)
     tile = shape[1]
     lidx = (jax.lax.broadcasted_iota(jnp.uint32, shape, 1)
@@ -176,48 +250,141 @@ def _witness_cols(scal_ref, shape, witness_ids, n_local, fields):
     return cols
 
 
-def pack_state(state: NetState, faulty: jax.Array) -> jax.Array:
-    """NetState leaves + faulty mask -> padded packed int32 [T, Np].
+# --------------------------------------------------------------------------
+# Bit-plane pack / unpack — XLA side.
+#
+# state.PACK_LAYOUT is the single source of truth for plane indices; the
+# helpers here (and the in-kernel loads/stores below) derive everything
+# from the PACK_* constants state.py exports from it.
+# --------------------------------------------------------------------------
 
-    Pad lanes carry the killed bit (inert everywhere: excluded from
-    histograms and alive counts, never active, counted as settled)."""
-    p = (state.x.astype(jnp.int32) & 3
-         | (state.decided.astype(jnp.int32) << _DEC)
-         | (state.killed.astype(jnp.int32) << _KILL)
-         | (faulty.astype(jnp.int32) << _FAULT)
-         | (state.k.astype(jnp.int32) << _KSHIFT))
-    n = p.shape[-1]
+
+def pack_state(cfg, state: NetState, faulty: jax.Array) -> jax.Array:
+    """NetState leaves + faulty mask -> padded plane stack uint32
+    [T, state.pack_width(cfg), Np/32].
+
+    Pad lanes carry the killed bit and x = "?" (inert everywhere:
+    excluded from histograms and alive counts, never active, counted as
+    settled); every other pad plane is 0.  The coin-commit plane starts
+    0 (no round has run).
+    """
+    kb = pack_k_bits(cfg)
+    n = state.x.shape[-1]
     n_pad = (-n) % TILE_N
-    if n_pad:
-        p = jnp.pad(p, ((0, 0), (0, n_pad)),
-                    constant_values=(VALQ | (1 << _KILL)))
-    return p
+
+    def lanes(a, pad_const):
+        a = a.astype(jnp.uint32)
+        if n_pad:
+            a = jnp.pad(a, ((0, 0), (0, n_pad)),
+                        constant_values=jnp.uint32(pad_const))
+        return a.reshape(a.shape[0], -1, PACK_NODES_PER_WORD)
+
+    x = lanes(state.x, VALQ)
+    dec = lanes(state.decided, 0)
+    kil = lanes(state.killed, 1)
+    fau = lanes(faulty, 0)
+    k = lanes(state.k, 0)
+    planes = [None] * (PACK_STATIC_WIDTH + kb)
+    for b in range(_X_BITS):
+        planes[PACK_X + b] = (x >> b) & 1
+    planes[PACK_DECIDED] = dec
+    planes[PACK_KILLED] = kil
+    planes[PACK_COINED] = jnp.zeros_like(dec)
+    planes[PACK_FAULTY] = fau
+    for b in range(kb):
+        planes[PACK_K + b] = (k >> b) & 1
+    j = jnp.arange(PACK_NODES_PER_WORD, dtype=jnp.uint32)
+    words = [jnp.sum(p << j, axis=-1, dtype=jnp.uint32) for p in planes]
+    return jnp.stack(words, axis=1)
+
+
+def plane_field(pack: jax.Array, base: int, width: int) -> jax.Array:
+    """One PACK_LAYOUT field of a plane stack -> int32 [T, Np] per-lane
+    values (XLA side; the in-kernel twin is _kfield)."""
+    T, _, n_w = pack.shape
+    j = jnp.arange(PACK_NODES_PER_WORD, dtype=jnp.uint32)
+    val = jnp.zeros((T, n_w, PACK_NODES_PER_WORD), jnp.uint32)
+    for b in range(width):
+        val = val | (((pack[:, base + b, :, None] >> j) & 1)
+                     << jnp.uint32(b))
+    return val.reshape(T, n_w * PACK_NODES_PER_WORD).astype(jnp.int32)
 
 
 def unpack_state(pack: jax.Array, n_nodes: int) -> NetState:
-    p = pack[:, :n_nodes]
-    return NetState(x=(p & 3).astype(jnp.int8),
-                    decided=((p >> _DEC) & 1).astype(bool),
-                    k=(p >> _KSHIFT),
-                    killed=((p >> _KILL) & 1).astype(bool))
+    """Plane stack -> NetState (pad lanes dropped).  The k width is
+    whatever the stack carries (pack.shape[1] - PACK_STATIC_WIDTH), so
+    unpack needs no config."""
+    kb = pack.shape[1] - PACK_STATIC_WIDTH
+    x = plane_field(pack, PACK_X, _X_BITS)[:, :n_nodes]
+    dec = plane_field(pack, PACK_DECIDED, 1)[:, :n_nodes]
+    kil = plane_field(pack, PACK_KILLED, 1)[:, :n_nodes]
+    k = plane_field(pack, PACK_K, kb)[:, :n_nodes]
+    return NetState(x=x.astype(jnp.int8), decided=dec.astype(bool),
+                    k=k, killed=kil.astype(bool))
 
 
-def _fields(p, rr, cr, fault_model, freeze):
-    """Unpack the state word + apply the crash-at-round update in-kernel.
+# --------------------------------------------------------------------------
+# Bit-plane loads / stores — kernel side.
+# --------------------------------------------------------------------------
 
-    Returns (x, decided, killed_now, faulty, k, alive, frozen) — all int32
-    except the bool masks."""
-    x = p & 3
-    decided = (p >> _DEC) & 1
-    killed = (p >> _KILL) & 1
-    faulty = (p >> _FAULT) & 1
-    k = p >> _KSHIFT
+
+def _kfield(w, base, width):
+    """One field of a loaded plane block uint32 [T, P, TW] -> per-lane
+    int32 [T, TW * 32] (node order: word-major, bit = in-word lane)."""
+    t, _, tw = w.shape
+    j = jax.lax.broadcasted_iota(jnp.uint32, (t, tw, PACK_NODES_PER_WORD),
+                                 2)
+    val = jnp.zeros((t, tw, PACK_NODES_PER_WORD), jnp.uint32)
+    for b in range(width):
+        val = val | (((w[:, base + b, :][..., None] >> j) & 1)
+                     << jnp.uint32(b))
+    return val.reshape(t, tw * PACK_NODES_PER_WORD).astype(jnp.int32)
+
+
+def _load_fields(p, kbits, rr, cr, fault_model, freeze):
+    """Loaded plane block + the crash-at-round update, in-kernel.
+
+    Returns (x, decided, killed_now, faulty, k, alive, frozen) — all
+    per-lane int32 [T, TILE] except the bool masks (the same contract the
+    old int32-word ``_fields`` had, so the phase logic is unchanged)."""
+    x = _kfield(p, PACK_X, _X_BITS)
+    decided = _kfield(p, PACK_DECIDED, 1)
+    killed = _kfield(p, PACK_KILLED, 1)
+    faulty = _kfield(p, PACK_FAULTY, 1)
+    k = _kfield(p, PACK_K, kbits)
     if fault_model == "crash_at_round":
         crashing = (faulty == 1) & (cr > 0) & (rr >= cr)
         killed = jnp.where(crashing, 1, killed)
     alive = killed == 0
     frozen = (decided == 1) if freeze else jnp.zeros_like(alive)
     return x, decided, killed, faulty, k, alive, frozen
+
+
+def _store_planes(np_ref, kbits, new_x, new_dec, killed, faulty, new_k,
+                  coined):
+    """Commit the per-lane fields back to the plane layout -> the new
+    uint32 [T, P, TW] block.  Pad lanes arrive with the killed bit and
+    inert values, so the stored words keep the pad invariants."""
+    t, tile = new_x.shape
+    tw = tile // PACK_NODES_PER_WORD
+    jj = jax.lax.broadcasted_iota(jnp.uint32, (t, tw, PACK_NODES_PER_WORD),
+                                  2)
+
+    def fold(v, b):
+        lanes = v.astype(jnp.uint32).reshape(t, tw, PACK_NODES_PER_WORD)
+        return jnp.sum(((lanes >> jnp.uint32(b)) & 1) << jj, axis=-1,
+                       dtype=jnp.uint32)
+
+    planes = [None] * (PACK_STATIC_WIDTH + kbits)
+    for b in range(_X_BITS):
+        planes[PACK_X + b] = fold(new_x, b)
+    planes[PACK_DECIDED] = fold(new_dec, 0)
+    planes[PACK_KILLED] = fold(killed, 0)
+    planes[PACK_COINED] = fold(coined, 0)
+    planes[PACK_FAULTY] = fold(faulty, 0)
+    for b in range(kbits):
+        planes[PACK_K + b] = fold(new_k, b)
+    np_ref[...] = jnp.stack(planes, axis=1)
 
 
 def _flip(v):
@@ -272,14 +439,32 @@ def _mixed_draws(m, scal_ref, scal2_ref, c0, c1, cq, ne, shape):
     return h0 + (h_b - bs), h1 + bs
 
 
-def _partial_cols(t, cols):
+def _cf_pair_draws(m, scal_ref, c0, c1, cq, shape):
+    """The uniform CF-regime per-lane tally pair — verbatim from
+    pallas_hist._cf_kernel (one threefry block per lane yields both
+    uniforms), shared by the two-kernel and single-pass paths so their
+    streams cannot drift."""
+    node, trial = _lane_ids(scal_ref, shape)
+    b0, b1 = _threefry2x32(scal_ref[0], scal_ref[1], node, trial)
+    u0 = _bits_to_uniform(b0)
+    u1 = _bits_to_uniform(b1)
+    total = c0 + c1 + cq
+    mf = jnp.float32(m)
+    p0 = _cf_draw(u0, total, c0, mf)
+    p1 = _cf_draw(u1, jnp.maximum(total - c0, 0.0), c1,
+                  jnp.maximum(mf - p0, 0.0))
+    return p0, p1
+
+
+def _partial_cols(t, cols, dtype=jnp.int32):
     """[T]-vectors -> the [1, T, PARTIAL_COLS] partial layout
-    (col i = cols[i])."""
+    (col i = cols[i]); built in int32 and narrowed once at the end
+    (every value is bounded by ``partial_dtype``'s argument bound)."""
     col = jax.lax.broadcasted_iota(jnp.int32, (1, t, PARTIAL_COLS), 2)
     out = jnp.zeros((1, t, PARTIAL_COLS), jnp.int32)
     for i, v in enumerate(cols):
         out = out + (col == i) * v[None, :, None]
-    return out
+    return out.astype(dtype)
 
 
 def _camp_select(scal_ref, shape, camp_b0, camp_b1, vecs):
@@ -290,9 +475,9 @@ def _camp_select(scal_ref, shape, camp_b0, camp_b1, vecs):
     lanes may select ANY camp — on a node-sharded mesh a non-final
     shard's pad ids overlap the next shard's real range, so no camp
     assignment can be promised for them; the invariant that matters is
-    the killed-bit exclusion: pad lanes carry the killed bit, so neither
-    their commit nor the histogram partials ever see them, whichever
-    camp triple they happened to read."""
+    the killed-bit exclusion: pad lanes carry the killed PLANE bit, so
+    neither their commit nor the histogram partials ever see them,
+    whichever camp triple they happened to read."""
     c0h0, c0h1, c1h0, c1h1, qh0, qh1 = [v[...] for v in vecs]
     node, _ = _lane_ids(scal_ref, shape)
     in1 = node >= jnp.uint32(camp_b1)
@@ -302,9 +487,98 @@ def _camp_select(scal_ref, shape, camp_b0, camp_b1, vecs):
     return p0, p1
 
 
+def _decide_commit(n_faulty, rule, coin_mode, eps, shape, coin_scal,
+                   shared, qok, rk, x, decided, killed, k, alive, frozen,
+                   v0, v1):
+    """The coin + decide / adopt / commit chain (node.ts:99-112), shared
+    by the two-kernel vote pass and the single-pass fused kernel so the
+    two dispatch targets are bit-aligned by construction.
+
+    ``shared``/``qok`` are [T, 1] int32 (per-trial shared coin bit /
+    quorum gate); returns (new_x, new_dec, new_k, coined) per-lane
+    int32/bool tensors.  The coin stream is verbatim _coin_kernel /
+    _weak_coin_kernel (word 0 private bit, word 1 deviation uniform)."""
+    node, trial = _lane_ids(coin_scal, shape)
+    pbits, dbits = _threefry2x32(coin_scal[0], coin_scal[1], node, trial)
+    private = (pbits & jnp.uint32(1)).astype(jnp.int32)
+    if coin_mode == "private":
+        coin = private
+    elif coin_mode == "common":
+        coin = jnp.broadcast_to(shared, private.shape)
+    else:  # weak_common, 0 < eps < 1
+        dev = _bits_to_uniform(dbits) < jnp.float32(eps)
+        coin = jnp.where(dev, private, shared)
+
+    ff = jnp.float32(n_faulty)
+    decide0 = v0 > ff
+    decide1 = v1 > ff
+    no_adopt = None
+    if rule == "reference":                              # quirk 9
+        any_votes = (v0 + v1) > 0.0
+        adopt0 = any_votes & (v0 > v1)
+        adopt1 = any_votes & (v0 < v1)
+        no_adopt = ~adopt0 & ~adopt1
+        x2 = jnp.where(decide0, VAL0,
+             jnp.where(decide1, VAL1,
+             jnp.where(adopt0, VAL0,
+             jnp.where(adopt1, VAL1, coin))))
+    else:                                                # textbook
+        x2 = jnp.where(decide0, VAL0,
+             jnp.where(decide1, VAL1, coin))
+
+    active = alive & (qok != 0) & ~frozen
+    newly = active & (decide0 | decide1)
+    new_x = jnp.where(active, x2, x)
+    new_dec = jnp.where(newly, 1, decided)
+    new_k = jnp.where(active, rk, k)
+    # coin-commit mask, same branch structure as the XLA path in
+    # models/benor.py (the coined PLANE + recorder/witness partials)
+    coined = active & ~decide0 & ~decide1
+    if no_adopt is not None:
+        coined = coined & no_adopt
+    return new_x, new_dec, new_k, coined, active
+
+
+def _vote_partial_cols(fault_model, record, witness_ids, n_local,
+                       vote_scal, shape, new_x, new_dec, killed, faulty,
+                       alive, active, coined, v0, v1):
+    """The vote pass's per-tile partial columns (VOTE_PARTIAL_LAYOUT +
+    optional VOTE_RECORD_LAYOUT + witness blocks) — shared by the
+    two-kernel and single-pass paths."""
+    sent_next = _sent(fault_model, new_x, faulty)
+    settled = (new_dec == 1) | (killed == 1)
+    hon = _honest(fault_model, alive, faulty)
+    cols = [
+        jnp.sum((sent_next == v) & hon, axis=1, dtype=jnp.int32)
+        for v in (VAL0, VAL1, VALQ)
+    ] + [jnp.sum(settled, axis=1, dtype=jnp.int32),
+         jnp.sum(~settled, axis=1, dtype=jnp.int32)]
+    if record:
+        # flight-recorder partials (_RP_* layout, same masks as the XLA
+        # path in models/benor.py — so the delivered/camps regimes, where
+        # both paths share every bit, record identical rows)
+        undec = (new_dec == 0) & (killed == 0)
+        margin = jnp.where(active, jnp.abs(v0 - v1), 0.0)
+        cols = cols + [
+            jnp.sum(new_dec == 1, axis=1, dtype=jnp.int32),
+            jnp.sum(killed == 1, axis=1, dtype=jnp.int32),
+            jnp.sum(undec & (new_x == VAL0), axis=1, dtype=jnp.int32),
+            jnp.sum(undec & (new_x == VAL1), axis=1, dtype=jnp.int32),
+            jnp.sum(undec & (new_x == VALQ), axis=1, dtype=jnp.int32),
+            jnp.sum(coined, axis=1, dtype=jnp.int32),
+            jnp.max(margin, axis=1).astype(jnp.int32),
+        ]
+    if witness_ids:
+        cols = cols + _witness_cols(
+            vote_scal, shape, witness_ids, n_local,
+            [new_x, new_dec, killed, coined.astype(jnp.int32), v0, v1])
+    return cols
+
+
 def _prop_hist_kernel(m, fault_model, freeze, has_cr, counts_mode,
-                      camp_b0, camp_b1, witness_ids, n_local, *refs):
-    """One lane-tile of the fused PROPOSAL phase.
+                      camp_b0, camp_b1, witness_ids, n_local, kbits,
+                      *refs):
+    """One lane-tile of the two-kernel path's PROPOSAL phase.
 
     Per-lane tallies -> phase-1 majority/tie (node.ts:63-69) -> each
     lane's (byzantine-flipped) vote value -> per-tile partials: cols 0-2
@@ -336,50 +610,44 @@ def _prop_hist_kernel(m, fault_model, freeze, has_cr, counts_mode,
     cr = refs.pop(0)[...] if has_cr else None
     (out_ref,) = refs
     p = p_ref[...]
-    x, decided, killed, faulty, k, alive, frozen = _fields(
-        p, rr_ref[0], cr, fault_model, freeze)
+    x, decided, killed, faulty, k, alive, frozen = _load_fields(
+        p, kbits, rr_ref[0], cr, fault_model, freeze)
+    shape = x.shape
 
     if counts_mode == "delivered":
         p0, p1 = cvecs[0][...], cvecs[1][...]
     elif counts_mode == "camps":
-        p0, p1 = _camp_select(scal_ref, p.shape, camp_b0, camp_b1, cvecs)
+        p0, p1 = _camp_select(scal_ref, shape, camp_b0, camp_b1, cvecs)
     elif has_eq:
         c0, c1, cq = (v[...] for v in cvecs)
         p0, p1 = _mixed_draws(m, scal_ref, scal2_ref, c0, c1, cq,
-                              ne_ref[...], p.shape)
+                              ne_ref[...], shape)
     else:
         c0, c1, cq = (v[...] for v in cvecs)
-        node, trial = _lane_ids(scal_ref, p.shape)
-        b0, b1 = _threefry2x32(scal_ref[0], scal_ref[1], node, trial)
-        u0 = _bits_to_uniform(b0)
-        u1 = _bits_to_uniform(b1)
-        total = c0 + c1 + cq
-        mf = jnp.float32(m)
-        p0 = _cf_draw(u0, total, c0, mf)
-        p1 = _cf_draw(u1, jnp.maximum(total - c0, 0.0), c1,
-                      jnp.maximum(mf - p0, 0.0))
+        p0, p1 = _cf_pair_draws(m, scal_ref, c0, c1, cq, shape)
     x1 = jnp.where(p0 > p1, VAL0, jnp.where(p1 > p0, VAL1, VALQ))
 
     vote = _sent(fault_model, jnp.where(frozen, x, x1), faulty)
     hon = _honest(fault_model, alive, faulty)
-    t = p.shape[0]
+    t = shape[0]
     cols = [
         jnp.sum((vote == v) & hon, axis=1, dtype=jnp.int32)
         for v in (VAL0, VAL1, VALQ)
     ] + [jnp.sum(alive, axis=1, dtype=jnp.int32)]
     if witness_ids:
-        cols += _witness_cols(scal_ref, p.shape, witness_ids, n_local,
+        cols += _witness_cols(scal_ref, shape, witness_ids, n_local,
                               [p0, p1])
-    out_ref[...] = _partial_cols(t, cols)
+    out_ref[...] = _partial_cols(t, cols, out_ref.dtype)
 
 
 def _vote_commit_kernel(m, n_faulty, rule, coin_mode, eps, freeze,
                         fault_model, has_cr, counts_mode, camp_b0,
-                        camp_b1, record, witness_ids, n_local, *refs):
-    """One lane-tile of the fused VOTE phase + commit.
+                        camp_b1, record, witness_ids, n_local, kbits,
+                        *refs):
+    """One lane-tile of the two-kernel path's VOTE phase + commit.
 
     Per-lane vote tallies (by counts_mode, as in _prop_hist_kernel) ->
-    decide/adopt/coin (node.ts:99-112) -> the new packed state word, plus
+    decide/adopt/coin (node.ts:99-112) -> the new plane-stack block, plus
     per-tile partials: cols 0-2 the NEXT round's proposal histogram (of
     the new sent values over HONEST live lanes; exact for static-killed
     fault models — the crash_at_round caller recomputes it in XLA
@@ -417,109 +685,127 @@ def _vote_commit_kernel(m, n_faulty, rule, coin_mode, eps, freeze,
     np_ref, part_ref = refs
     p = p_ref[...]
     rr = rk_ref[0] - 1
-    x, decided, killed, faulty, k, alive, frozen = _fields(
-        p, rr, cr, fault_model, freeze)
+    x, decided, killed, faulty, k, alive, frozen = _load_fields(
+        p, kbits, rr, cr, fault_model, freeze)
+    shape = x.shape
 
     # --- the vote tallies ------------------------------------------------
     # 'sampled': verbatim from pallas_hist._cf_kernel (or _equiv_kernel in
     # the equivocate regime); 'delivered'/'camps': the adversary's
     # closed-form counts, broadcast / camp-selected — no draws.
-    node, trial = _lane_ids(vote_scal_ref, p.shape)
     if counts_mode == "delivered":
         v0, v1 = cvecs[0][...], cvecs[1][...]
     elif counts_mode == "camps":
-        v0, v1 = _camp_select(vote_scal_ref, p.shape, camp_b0, camp_b1,
+        v0, v1 = _camp_select(vote_scal_ref, shape, camp_b0, camp_b1,
                               cvecs)
     elif has_eq:
         c0, c1, cq = (v[...] for v in cvecs)
         v0, v1 = _mixed_draws(m, vote_scal_ref, vote_scal2_ref, c0, c1,
-                              cq, ne_ref[...], p.shape)
+                              cq, ne_ref[...], shape)
     else:
         c0, c1, cq = (v[...] for v in cvecs)
-        b0, b1 = _threefry2x32(vote_scal_ref[0], vote_scal_ref[1],
-                               node, trial)
-        u0 = _bits_to_uniform(b0)
-        u1 = _bits_to_uniform(b1)
-        total = c0 + c1 + cq
-        mf = jnp.float32(m)
-        v0 = _cf_draw(u0, total, c0, mf)
-        v1 = _cf_draw(u1, jnp.maximum(total - c0, 0.0), c1,
-                      jnp.maximum(mf - v0, 0.0))
+        v0, v1 = _cf_pair_draws(m, vote_scal_ref, c0, c1, cq, shape)
 
-    # --- the coin, verbatim from _coin_kernel / _weak_coin_kernel -------
-    pbits, dbits = _threefry2x32(coin_scal_ref[0], coin_scal_ref[1],
-                                 node, trial)
-    private = (pbits & jnp.uint32(1)).astype(jnp.int32)
-    if coin_mode == "private":
-        coin = private
-    elif coin_mode == "common":
-        coin = jnp.broadcast_to(shared_ref[...], private.shape)
-    else:  # weak_common, 0 < eps < 1
-        dev = _bits_to_uniform(dbits) < jnp.float32(eps)
-        coin = jnp.where(dev, private, shared_ref[...])
+    new_x, new_dec, new_k, coined, active = _decide_commit(
+        n_faulty, rule, coin_mode, eps, shape, coin_scal_ref,
+        shared_ref[...], qok_ref[...], rk_ref[0], x, decided, killed, k,
+        alive, frozen, v0, v1)
+    _store_planes(np_ref, kbits, new_x, new_dec, killed, faulty, new_k,
+                  coined)
+    cols = _vote_partial_cols(fault_model, record, witness_ids, n_local,
+                              vote_scal_ref, shape, new_x, new_dec,
+                              killed, faulty, alive, active, coined, v0,
+                              v1)
+    part_ref[...] = _partial_cols(shape[0], cols, part_ref.dtype)
 
-    # --- decide / adopt / commit (models/benor.py) ----------------------
-    ff = jnp.float32(n_faulty)
-    decide0 = v0 > ff
-    decide1 = v1 > ff
-    no_adopt = None
-    if rule == "reference":                              # quirk 9
-        any_votes = (v0 + v1) > 0.0
-        adopt0 = any_votes & (v0 > v1)
-        adopt1 = any_votes & (v0 < v1)
-        no_adopt = ~adopt0 & ~adopt1
-        x2 = jnp.where(decide0, VAL0,
-             jnp.where(decide1, VAL1,
-             jnp.where(adopt0, VAL0,
-             jnp.where(adopt1, VAL1, coin))))
-    else:                                                # textbook
-        x2 = jnp.where(decide0, VAL0,
-             jnp.where(decide1, VAL1, coin))
 
-    active = alive & (qok_ref[...] != 0) & ~frozen
-    newly = active & (decide0 | decide1)
-    new_x = jnp.where(active, x2, x)
-    new_dec = jnp.where(newly, 1, decided)
-    new_k = jnp.where(active, rk_ref[0], k)
-    np_ref[...] = (new_x | (new_dec << _DEC) | (killed << _KILL)
-                   | (faulty << _FAULT) | (new_k << _KSHIFT))
+def _fused_round_kernel(m, n_faulty, rule, coin_mode, eps, freeze,
+                        fault_model, has_cr, record, witness_ids, n_local,
+                        kbits, *refs):
+    """The SINGLE-PASS fused round: both phases of one Ben-Or round over
+    the whole (padded) node axis in one kernel invocation.
 
-    sent_next = _sent(fault_model, new_x, faulty)
-    settled = (new_dec == 1) | (killed == 1)
+    The cross-phase dependency — the vote-phase sampler draws from the
+    GLOBAL vote-class histogram, which depends on every lane's phase-1
+    result — is resolved in-register: with the full node axis resident,
+    the histogram is three integer row-sums, and the quorum gate
+    (n_alive >= m) one more.  Those sums are the exact integers the
+    two-kernel path obtains from its proposal partials (+ psum), so the
+    two dispatch targets are bit-identical by construction.  Serves
+    counts_mode='sampled' only (the closed-form adversaries run no
+    sampler and keep the two-kernel path; see packed_round).
+
+    Emits the new plane stack plus BOTH partial buffers — partsA in the
+    proposal kernel's PROP_PARTIAL_LAYOUT (+ witness p0/p1 blocks) and
+    partsB in the vote kernel's layout — so packed_round's recorder /
+    witness / predicate assembly is one code path for every dispatch.
+    """
+    has_eq = fault_model == "equivocate"
+    refs = list(refs)
+    prop_scal = refs.pop(0)
+    prop_scal2 = refs.pop(0) if has_eq else None
+    vote_scal = refs.pop(0)
+    vote_scal2 = refs.pop(0) if has_eq else None
+    coin_scal = refs.pop(0)
+    rk_ref = refs.pop(0)
+    c0_ref, c1_ref, cq_ref = refs[:3]
+    refs = refs[3:]
+    ne_ref = refs.pop(0) if has_eq else None
+    shared_ref = refs.pop(0)
+    p_ref = refs.pop(0)
+    cr = refs.pop(0)[...] if has_cr else None
+    np_ref, partA_ref, partB_ref = refs
+    p = p_ref[...]
+    rr = rk_ref[0] - 1
+    x, decided, killed, faulty, k, alive, frozen = _load_fields(
+        p, kbits, rr, cr, fault_model, freeze)
+    shape = x.shape
+    t = shape[0]
+
+    # --- phase 1: proposal tallies -> majority -> vote values ------------
+    c0, c1, cq = c0_ref[...], c1_ref[...], cq_ref[...]
+    if has_eq:
+        p0, p1 = _mixed_draws(m, prop_scal, prop_scal2, c0, c1, cq,
+                              ne_ref[...], shape)
+    else:
+        p0, p1 = _cf_pair_draws(m, prop_scal, c0, c1, cq, shape)
+    x1 = jnp.where(p0 > p1, VAL0, jnp.where(p1 > p0, VAL1, VALQ))
+    vote = _sent(fault_model, jnp.where(frozen, x, x1), faulty)
     hon = _honest(fault_model, alive, faulty)
-    t = p.shape[0]
-    cols = [
-        jnp.sum((sent_next == v) & hon, axis=1, dtype=jnp.int32)
+
+    colsA = [
+        jnp.sum((vote == v) & hon, axis=1, dtype=jnp.int32)
         for v in (VAL0, VAL1, VALQ)
-    ] + [jnp.sum(settled, axis=1, dtype=jnp.int32),
-         jnp.sum(~settled, axis=1, dtype=jnp.int32)]
-    coined = None
-    if record or witness_ids:
-        # coin-commit mask, same branch structure as the XLA path in
-        # models/benor.py (shared by the recorder and witness partials)
-        coined = active & ~decide0 & ~decide1
-        if no_adopt is not None:
-            coined = coined & no_adopt
-    if record:
-        # flight-recorder partials (_RP_* layout, same masks as the XLA
-        # path in models/benor.py — so the delivered/camps regimes, where
-        # both paths share every bit, record identical rows)
-        undec = (new_dec == 0) & (killed == 0)
-        margin = jnp.where(active, jnp.abs(v0 - v1), 0.0)
-        cols = cols + [
-            jnp.sum(new_dec == 1, axis=1, dtype=jnp.int32),
-            jnp.sum(killed == 1, axis=1, dtype=jnp.int32),
-            jnp.sum(undec & (new_x == VAL0), axis=1, dtype=jnp.int32),
-            jnp.sum(undec & (new_x == VAL1), axis=1, dtype=jnp.int32),
-            jnp.sum(undec & (new_x == VALQ), axis=1, dtype=jnp.int32),
-            jnp.sum(coined, axis=1, dtype=jnp.int32),
-            jnp.max(margin, axis=1).astype(jnp.int32),
-        ]
+    ] + [jnp.sum(alive, axis=1, dtype=jnp.int32)]
     if witness_ids:
-        cols = cols + _witness_cols(
-            vote_scal_ref, p.shape, witness_ids, n_local,
-            [new_x, new_dec, killed, coined.astype(jnp.int32), v0, v1])
-    part_ref[...] = _partial_cols(t, cols)
+        colsA += _witness_cols(prop_scal, shape, witness_ids, n_local,
+                               [p0, p1])
+    partA_ref[...] = _partial_cols(t, colsA, partA_ref.dtype)
+
+    # --- the vote-phase GLOBAL histogram + quorum gate, in-register ------
+    # (the full node axis is resident, so the tile sums ARE the globals
+    # the two-kernel path psums from its proposal partials)
+    c0v = colsA[0].astype(jnp.float32)[:, None]
+    c1v = colsA[1].astype(jnp.float32)[:, None]
+    cqv = colsA[2].astype(jnp.float32)[:, None]
+    qok = (colsA[3] >= m).astype(jnp.int32)[:, None]
+
+    # --- phase 2: vote tallies -> decide/adopt/coin -> commit ------------
+    if has_eq:
+        v0, v1 = _mixed_draws(m, vote_scal, vote_scal2, c0v, c1v, cqv,
+                              ne_ref[...], shape)
+    else:
+        v0, v1 = _cf_pair_draws(m, vote_scal, c0v, c1v, cqv, shape)
+    new_x, new_dec, new_k, coined, active = _decide_commit(
+        n_faulty, rule, coin_mode, eps, shape, coin_scal,
+        shared_ref[...], qok, rk_ref[0], x, decided, killed, k, alive,
+        frozen, v0, v1)
+    _store_planes(np_ref, kbits, new_x, new_dec, killed, faulty, new_k,
+                  coined)
+    colsB = _vote_partial_cols(fault_model, record, witness_ids, n_local,
+                               vote_scal, shape, new_x, new_dec, killed,
+                               faulty, alive, active, coined, v0, v1)
+    partB_ref[...] = _partial_cols(t, colsB, partB_ref.dtype)
 
 
 def _smem():
@@ -532,6 +818,13 @@ def _vec(t):
 
 def _lane(t):
     return pl.BlockSpec((t, TILE_N), lambda j: (0, j),
+                        memory_space=pltpu.VMEM)
+
+
+def _planes(t, p):
+    """Plane-stack block: the same TILE_N nodes per grid step as _lane,
+    expressed as _TILE_W uint32 words per plane."""
+    return pl.BlockSpec((t, p, _TILE_W), lambda j: (0, 0, j),
                         memory_space=pltpu.VMEM)
 
 
@@ -564,8 +857,9 @@ def proposal_hist_pallas(base_key, r, phase, hist, pack, crash_round,
                          counts_mode: str = "sampled", camp_b0: int = 0,
                          camp_b1: int = 0, witness_ids: tuple = (),
                          n_local: int = 0):
-    """Fused proposal phase over the packed state -> partials int32
-    [T, 128]: cols 0-2 this shard's LOCAL vote histogram, col 3 its alive
+    """Fused proposal phase over the plane stack -> partials
+    [T, PARTIAL_COLS] (partial_dtype-narrowed; cast to int32 before
+    summing): cols 0-2 this shard's LOCAL vote histogram, col 3 its alive
     count (callers psum both over the nodes axis under a mesh).
 
     hist: by counts_mode — 'sampled': int32 [T, 3] global PROPOSAL class
@@ -578,19 +872,26 @@ def proposal_hist_pallas(base_key, r, phase, hist, pack, crash_round,
     so the kernel is deterministic given them); 'camps': int32 [T, 3, 3]
     per-camp triples (tally.targeted_camp_triples), selected per lane by
     global id against the static camp boundaries camp_b0/camp_b1.
-    pack: padded packed state [T, Np]; crash_round: int32 [T, Np-padded]
-    (crash_at_round only, else None); n_equiv: int32 [T] global
-    live-equivocator count ('equivocate' + 'sampled' only, else None).
+    pack: padded plane stack uint32 [T, PACK planes, Np/32];
+    crash_round: int32 [T, Np] (crash_at_round only, else None);
+    n_equiv: int32 [T] global live-equivocator count ('equivocate' +
+    'sampled' only, else None).  The k-plane count is read off the stack
+    (pack.shape[1] - PACK_STATIC_WIDTH), so a caller can never hand the
+    kernel fewer planes than the stack carries.
     """
-    T, np_total = pack.shape
+    T = pack.shape[0]
+    np_total = pack.shape[2] * PACK_NODES_PER_WORD
+    n_planes = pack.shape[1]
+    kbits = n_planes - PACK_STATIC_WIDTH
     r = jnp.asarray(r, jnp.int32)
     scal = _stream_scal(base_key, r, phase, node_offset, trial_offset)
     cvecs = _count_vecs(hist, counts_mode)
     has_cr = fault_model == "crash_at_round"
     has_eq = fault_model == "equivocate" and counts_mode == "sampled"
+    pdtype = partial_dtype(m, TILE_N)
 
     args = [scal, r.reshape(1), *cvecs, pack]
-    specs = [_smem(), _smem(), *[_vec(T)] * len(cvecs), _lane(T)]
+    specs = [_smem(), _smem(), *[_vec(T)] * len(cvecs), _planes(T, n_planes)]
     if has_eq:
         scal2 = _stream_scal(base_key, r, phase + _EQUIV_SALT_OFFSET,
                              node_offset, trial_offset)
@@ -604,15 +905,15 @@ def proposal_hist_pallas(base_key, r, phase, hist, pack, crash_round,
     parts = pl.pallas_call(
         functools.partial(_prop_hist_kernel, m, fault_model, freeze,
                           has_cr, counts_mode, camp_b0, camp_b1,
-                          witness_ids, n_local),
+                          witness_ids, n_local, kbits),
         out_shape=jax.ShapeDtypeStruct((np_total // TILE_N, T,
-                                        PARTIAL_COLS), jnp.int32),
+                                        PARTIAL_COLS), pdtype),
         grid=(np_total // TILE_N,),
         in_specs=specs,
         out_specs=_part(T),
         interpret=interpret,
     )(*args)
-    return jnp.sum(parts, axis=0)
+    return jnp.sum(parts.astype(jnp.int32), axis=0)
 
 
 @instrumented_jit(static_argnames=(
@@ -627,7 +928,8 @@ def vote_commit_pallas(base_key, r, phase, hist, pack, crash_round,
                        counts_mode: str = "sampled", camp_b0: int = 0,
                        camp_b1: int = 0, record: bool = False,
                        witness_ids: tuple = (), n_local: int = 0):
-    """Fused vote phase + commit -> (new_pack [T, Np], partials [T, 128]).
+    """Fused vote phase + commit -> (new plane stack, partials
+    [T, PARTIAL_COLS] int32).
 
     Partials: cols 0-2 the next round's LOCAL proposal histogram (valid
     for static-killed fault models; honest senders only under
@@ -638,9 +940,13 @@ def vote_commit_pallas(base_key, r, phase, hist, pack, crash_round,
     triples); quorum_ok: bool [T]; shared: int32-able [T] per-trial
     shared coin bit (ignored for coin_mode='private'); n_equiv: int32 [T]
     global live-equivocator count ('equivocate' + 'sampled' only, else
-    None).
+    None).  The k-plane count is read off the stack, as in
+    proposal_hist_pallas.
     """
-    T, np_total = pack.shape
+    T = pack.shape[0]
+    np_total = pack.shape[2] * PACK_NODES_PER_WORD
+    n_planes = pack.shape[1]
+    kbits = n_planes - PACK_STATIC_WIDTH
     r = jnp.asarray(r, jnp.int32)
     vote_scal = _stream_scal(base_key, r, phase, node_offset, trial_offset)
     coin_scal = _stream_scal(base_key, r, _COIN_SALT, node_offset,
@@ -651,10 +957,11 @@ def vote_commit_pallas(base_key, r, phase, hist, pack, crash_round,
     sh = shared.astype(jnp.int32)[:, None]
     has_cr = fault_model == "crash_at_round"
     has_eq = fault_model == "equivocate" and counts_mode == "sampled"
+    pdtype = partial_dtype(m, TILE_N)
 
     args = [vote_scal, coin_scal, rk, *cvecs, qok, sh, pack]
     specs = [_smem(), _smem(), _smem(), *[_vec(T)] * len(cvecs),
-             _vec(T), _vec(T), _lane(T)]
+             _vec(T), _vec(T), _planes(T, n_planes)]
     if has_eq:
         vote_scal2 = _stream_scal(base_key, r,
                                   phase + _EQUIV_SALT_OFFSET,
@@ -670,21 +977,107 @@ def vote_commit_pallas(base_key, r, phase, hist, pack, crash_round,
         functools.partial(_vote_commit_kernel, m, n_faulty, rule,
                           coin_mode, eps, freeze, fault_model, has_cr,
                           counts_mode, camp_b0, camp_b1, record,
-                          witness_ids, n_local),
-        out_shape=[jax.ShapeDtypeStruct((T, np_total), jnp.int32),
+                          witness_ids, n_local, kbits),
+        out_shape=[jax.ShapeDtypeStruct((T, n_planes,
+                                         np_total // PACK_NODES_PER_WORD),
+                                        jnp.uint32),
                    jax.ShapeDtypeStruct((np_total // TILE_N, T,
-                                         PARTIAL_COLS), jnp.int32)],
+                                         PARTIAL_COLS), pdtype)],
         grid=(np_total // TILE_N,),
         in_specs=specs,
-        out_specs=[_lane(T), _part(T)],
+        out_specs=[_planes(T, n_planes), _part(T)],
         interpret=interpret,
     )(*args)
+    parts = parts.astype(jnp.int32)
     summed = jnp.sum(parts, axis=0)
     if record:
         # the margin partial is a per-tile MAX, not a sum
         summed = summed.at[:, _RP_MARGIN].set(
             jnp.max(parts[:, :, _RP_MARGIN], axis=0))
     return new_pack, summed
+
+
+@instrumented_jit(static_argnames=(
+    "m", "n_faulty", "rule", "coin_mode", "eps", "freeze", "fault_model",
+    "interpret", "record", "witness_ids", "n_local"))
+def fused_round_pallas(base_key, r, hist1, pack, crash_round, shared,
+                       m: int, n_faulty: int, rule: str, coin_mode: str,
+                       eps: float, freeze: bool, fault_model: str,
+                       interpret: bool = False, n_equiv=None,
+                       record: bool = False, witness_ids: tuple = (),
+                       n_local: int = 0):
+    """ONE pallas pass for a whole Ben-Or round (single device,
+    counts_mode='sampled', within the FUSED_ONE_PASS_* caps) ->
+    (new plane stack, partsA, partsB) with partsA/partsB int32
+    [T, PARTIAL_COLS] in the proposal / vote kernels' layouts.
+
+    hist1: int32 [T, 3] — this round's global proposal histogram (the
+    loop carry; honest-only under 'equivocate'); shared: int32-able [T]
+    per-trial shared coin bit.  Node/trial offsets are 0 by definition
+    (the pass only serves ctx SINGLE), so every stream key matches the
+    two-kernel path's.
+    """
+    from . import rng
+
+    T = pack.shape[0]
+    n_w = pack.shape[2]
+    np_total = n_w * PACK_NODES_PER_WORD
+    n_planes = pack.shape[1]
+    kbits = n_planes - PACK_STATIC_WIDTH
+    r = jnp.asarray(r, jnp.int32)
+    prop_scal = _stream_scal(base_key, r, rng.PHASE_PROPOSAL, 0, 0)
+    vote_scal = _stream_scal(base_key, r, rng.PHASE_VOTE, 0, 0)
+    coin_scal = _stream_scal(base_key, r, _COIN_SALT, 0, 0)
+    rk = (r + 1).reshape(1)
+    cvecs = _count_vecs(hist1, "sampled")
+    sh = shared.astype(jnp.int32)[:, None]
+    has_cr = fault_model == "crash_at_round"
+    has_eq = fault_model == "equivocate"
+    pdtype = partial_dtype(m, np_total)
+
+    # whole-axis blocks: the single grid step sees every node of every
+    # trial (that residency is what lets the vote-phase histogram and the
+    # quorum gate happen in-register)
+    whole_planes = pl.BlockSpec((T, n_planes, n_w), lambda j: (0, 0, 0),
+                                memory_space=pltpu.VMEM)
+    whole_lane = pl.BlockSpec((T, np_total), lambda j: (0, 0),
+                              memory_space=pltpu.VMEM)
+    whole_part = pl.BlockSpec((1, T, PARTIAL_COLS), lambda j: (0, 0, 0),
+                              memory_space=pltpu.VMEM)
+
+    args = [prop_scal, vote_scal, coin_scal, rk, *cvecs, sh, pack]
+    specs = [_smem(), _smem(), _smem(), _smem(), *[_vec(T)] * 3,
+             _vec(T), whole_planes]
+    if has_eq:
+        prop_scal2 = _stream_scal(base_key, r,
+                                  rng.PHASE_PROPOSAL + _EQUIV_SALT_OFFSET,
+                                  0, 0)
+        vote_scal2 = _stream_scal(base_key, r,
+                                  rng.PHASE_VOTE + _EQUIV_SALT_OFFSET,
+                                  0, 0)
+        args.insert(1, prop_scal2)
+        specs.insert(1, _smem())
+        args.insert(3, vote_scal2)
+        specs.insert(3, _smem())
+        args.insert(9, n_equiv.astype(jnp.float32)[:, None])
+        specs.insert(9, _vec(T))
+    if has_cr:
+        args.append(crash_round)
+        specs.append(whole_lane)
+    new_pack, partsA, partsB = pl.pallas_call(
+        functools.partial(_fused_round_kernel, m, n_faulty, rule,
+                          coin_mode, eps, freeze, fault_model, has_cr,
+                          record, witness_ids, n_local, kbits),
+        out_shape=[jax.ShapeDtypeStruct((T, n_planes, n_w), jnp.uint32),
+                   jax.ShapeDtypeStruct((1, T, PARTIAL_COLS), pdtype),
+                   jax.ShapeDtypeStruct((1, T, PARTIAL_COLS), pdtype)],
+        grid=(1,),
+        in_specs=specs,
+        out_specs=[whole_planes, whole_part, whole_part],
+        interpret=interpret,
+    )(*args)
+    return (new_pack, jnp.sum(partsA.astype(jnp.int32), axis=0),
+            jnp.sum(partsB.astype(jnp.int32), axis=0))
 
 
 def _pad_cr(faults, np_total):
@@ -701,10 +1094,9 @@ def sent_hist_from_pack(cfg, pack, crash_round, r, ctx):
     vote kernel's emitted next-round partials).  Under 'equivocate' the
     histogram spans HONEST live senders only (equivocator values are
     drawn receiver-side)."""
-    p = pack
-    x = p & 3
-    killed = (p >> _KILL) & 1
-    faulty = (p >> _FAULT) & 1
+    x = plane_field(pack, PACK_X, _X_BITS)
+    killed = plane_field(pack, PACK_KILLED, 1)
+    faulty = plane_field(pack, PACK_FAULTY, 1)
     if cfg.fault_model == "crash_at_round":
         rr = jnp.asarray(r, jnp.int32)
         crashing = (faulty == 1) & (crash_round > 0) & (rr >= crash_round)
@@ -719,19 +1111,20 @@ def sent_hist_from_pack(cfg, pack, crash_round, r, ctx):
 
 def n_equiv_from_pack(cfg, pack, ctx):
     """Global live-equivocator count int32 [T] (RUN-constant under
-    'equivocate': the killed and faulty bits are static for this fault
+    'equivocate': the killed and faulty planes are static for this fault
     model, so run_packed hoists this out of the while-loop); None for
-    every other fault model."""
+    every other fault model.  Pure plane-word math: popcount of
+    faulty & ~killed, no per-lane expansion."""
     if cfg.fault_model != "equivocate":
         return None
-    alive = ((pack >> _KILL) & 1) == 0
-    eqv = ((pack >> _FAULT) & 1) == 1
-    return ctx.psum_nodes(jnp.sum(eqv & alive, axis=-1, dtype=jnp.int32))
+    live_eqv = pack[:, PACK_FAULTY, :] & ~pack[:, PACK_KILLED, :]
+    return ctx.psum_nodes(jnp.sum(
+        jax.lax.population_count(live_eqv), axis=-1).astype(jnp.int32))
 
 
 def packed_round(cfg, pack, faults, base_key, r, hist1, ctx, n_local,
                  n_equiv=None):
-    """One fused round over the packed state.
+    """One fused round over the plane-packed state.
 
     ``n_local`` is this shard's TRUE (unpadded) node count — the global-id
     base derivation needs it.  ``hist1`` is this round's global proposal
@@ -746,11 +1139,21 @@ def packed_round(cfg, pack, faults, base_key, r, hist1, ctx, n_local,
     ``wrow`` is the witness row int32 [W, k, state.WIT_WIDTH] when
     cfg.witness (assembled from the kernels' per-tile witness partials,
     psum-globalized over both mesh axes) and None otherwise.
+
+    Dispatch: counts_mode='sampled' on a single device within the
+    FUSED_ONE_PASS_* caps takes the SINGLE-PASS kernel
+    (fused_round_pallas — both phases, no inter-kernel HBM round trip);
+    meshes, the closed-form adversaries, and over-cap tiles take the
+    two-kernel plane pipeline.  Both emit the same partial layouts, so
+    everything below the kernel calls is one code path — and both share
+    every stream and integer reduction, so results are bit-identical.
     """
     from . import rng, tally
+    from .collectives import SINGLE
     from ..state import witness_node_ids
 
-    T, np_total = pack.shape
+    T = pack.shape[0]
+    np_total = pack.shape[2] * PACK_NODES_PER_WORD
     interp = jax.default_backend() == "cpu"
     m = cfg.quorum
     cr = (_pad_cr(faults, np_total)
@@ -782,30 +1185,40 @@ def packed_round(cfg, pack, faults, base_key, r, hist1, ctx, n_local,
             return tally.targeted_camp_triples(cfg, hist, n_free=n_equiv)
         return hist
 
-    partsA = proposal_hist_pallas(
-        base_key, r, rng.PHASE_PROPOSAL, kernel_counts(hist1), pack, cr, m,
-        cfg.fault_model, bool(cfg.freeze_decided), interpret=interp,
-        node_offset=node_off, trial_offset=trial_off, n_equiv=n_equiv,
-        counts_mode=mode, camp_b0=camp_b0, camp_b1=camp_b1,
-        witness_ids=wids, n_local=n_local)
-    hist2 = ctx.psum_nodes(partsA[:, :3])
-    n_alive = ctx.psum_nodes(partsA[:, 3])
-    quorum_ok = n_alive >= m
-
     if cfg.coin_mode == "private":
         shared = jnp.zeros((T,), jnp.int32)
     else:
         shared = rng.coin_flips(base_key, r, ctx.trial_ids(T),
                                 rng.ids(1), common=True)[:, 0]
 
-    new_pack, partsB = vote_commit_pallas(
-        base_key, r, rng.PHASE_VOTE, kernel_counts(hist2), pack, cr,
-        quorum_ok, shared, m, cfg.n_faulty, cfg.rule, cfg.coin_mode,
-        float(cfg.coin_eps), bool(cfg.freeze_decided), cfg.fault_model,
-        interpret=interp, node_offset=node_off, trial_offset=trial_off,
-        n_equiv=n_equiv, counts_mode=mode, camp_b0=camp_b0,
-        camp_b1=camp_b1, record=bool(cfg.record), witness_ids=wids,
-        n_local=n_local)
+    one_pass = (ctx is SINGLE
+                and fused_one_pass_eligible(cfg, T, n_local))
+    if one_pass:
+        new_pack, partsA, partsB = fused_round_pallas(
+            base_key, r, hist1, pack, cr, shared, m, cfg.n_faulty,
+            cfg.rule, cfg.coin_mode, float(cfg.coin_eps),
+            bool(cfg.freeze_decided), cfg.fault_model, interpret=interp,
+            n_equiv=n_equiv, record=bool(cfg.record), witness_ids=wids,
+            n_local=n_local)
+    else:
+        partsA = proposal_hist_pallas(
+            base_key, r, rng.PHASE_PROPOSAL, kernel_counts(hist1), pack,
+            cr, m, cfg.fault_model, bool(cfg.freeze_decided),
+            interpret=interp, node_offset=node_off,
+            trial_offset=trial_off, n_equiv=n_equiv, counts_mode=mode,
+            camp_b0=camp_b0, camp_b1=camp_b1, witness_ids=wids,
+            n_local=n_local)
+        hist2 = ctx.psum_nodes(partsA[:, :3])
+        n_alive = ctx.psum_nodes(partsA[:, 3])
+        quorum_ok = n_alive >= m
+        new_pack, partsB = vote_commit_pallas(
+            base_key, r, rng.PHASE_VOTE, kernel_counts(hist2), pack, cr,
+            quorum_ok, shared, m, cfg.n_faulty, cfg.rule, cfg.coin_mode,
+            float(cfg.coin_eps), bool(cfg.freeze_decided),
+            cfg.fault_model, interpret=interp, node_offset=node_off,
+            trial_offset=trial_off, n_equiv=n_equiv, counts_mode=mode,
+            camp_b0=camp_b0, camp_b1=camp_b1, record=bool(cfg.record),
+            witness_ids=wids, n_local=n_local)
     hist1_next = (None if cfg.fault_model == "crash_at_round"
                   else ctx.psum_nodes(partsB[:, :3]))
     unsettled = ctx.psum_nodes(partsB[:, 4])
@@ -867,7 +1280,7 @@ def run_packed_slice(cfg, state, faults, base_key, from_round, until_round,
     """The packed while-loop, generalized over (mesh ctx, round bounds).
 
     At most ``until_round - from_round`` rounds from ``from_round`` (both
-    TRACED), carrying the packed per-lane word: pack/unpack and every
+    TRACED), carrying the bit-plane stack: pack/unpack and every
     per-lane XLA op run once per CALL, not per round.  Under a mesh
     ``ctx`` the loop predicate reads the globally psum'd unsettled count
     (node-axis psum from the vote kernel's partials, trial-axis psum
@@ -888,7 +1301,7 @@ def run_packed_slice(cfg, state, faults, base_key, from_round, until_round,
     the kernels' per-tile witness partials land in the same buffer the
     XLA regimes fill, with no demotion.
     """
-    from ..ops.collectives import SINGLE
+    from .collectives import SINGLE
     from ..state import (new_recorder, new_witness, recorder_write,
                          witness_write)
 
@@ -898,14 +1311,17 @@ def run_packed_slice(cfg, state, faults, base_key, from_round, until_round,
         recorder = new_recorder(cfg, state, ctx)
     if cfg.witness and witness is None:
         witness = new_witness(cfg, state, ctx)
-    pack = pack_state(state, faults.faulty)
-    cr = (_pad_cr(faults, pack.shape[1])
+    pack = pack_state(cfg, state, faults.faulty)
+    np_total = pack.shape[2] * PACK_NODES_PER_WORD
+    cr = (_pad_cr(faults, np_total)
           if cfg.fault_model == "crash_at_round" else None)
     n_equiv = n_equiv_from_pack(cfg, pack, ctx)      # run-constant, hoisted
     hist1 = sent_hist_from_pack(cfg, pack, cr, from_round, ctx)
+    # unsettled lanes straight off the decided/killed planes (pads carry
+    # the killed bit, so ~(dec | kill) is 0 on every pad word bit)
+    unsett_bits = ~(pack[:, PACK_DECIDED, :] | pack[:, PACK_KILLED, :])
     unsettled0 = ctx.psum_all(jnp.sum(
-        ~(((pack >> _DEC) & 1) | ((pack >> _KILL) & 1)).astype(bool),
-        dtype=jnp.int32))
+        jax.lax.population_count(unsett_bits)).astype(jnp.int32))
 
     def cond(carry):
         r, unsettled = carry[0], carry[3]

@@ -23,6 +23,11 @@ Because every random draw keys on GLOBAL (trial, node, round) ids
 single-device run — the same guarantee tests/test_parallel.py pins for
 single-process meshes, extended across process boundaries by
 tests/test_multihost.py (two real OS processes, Gloo CPU collectives).
+The fused-round regime rides the same delegation: this module reuses
+sharded.py's slice bodies, whose packed path carries the bit-plane state
+stack (state.PACK_LAYOUT) through the two-kernel plane pipeline — the
+single-pass fused kernel is a single-device dispatch, and the dispatch
+boundary is bit-invisible (tests/multihost_worker.py's fused-round leg).
 
 No host ever materializes the full [T, N] arrays: each process builds only
 its addressable slab and `jax.make_array_from_process_local_data` assembles

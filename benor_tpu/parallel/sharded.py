@@ -145,13 +145,18 @@ def _local_slice_packed(cfg: SimConfig, state: NetState, faults: FaultSpec,
                         base_key: jax.Array, from_round: jax.Array,
                         until_round: jax.Array, recorder=None,
                         witness=None):
-    """The fused-round fast path of _local_slice: the PACKED per-lane
-    word is the while-loop carry (the sharded counterpart of
-    pallas_round.run_packed).
+    """The fused-round fast path of _local_slice: the BIT-PLANE packed
+    state stack (state.PACK_LAYOUT) is the while-loop carry (the sharded
+    counterpart of pallas_round.run_packed).  Under a mesh the round
+    always runs the two-kernel plane pipeline — the vote-phase histogram
+    needs an ICI psum between phases, so the single-pass kernel is a
+    single-device dispatch (pallas_round.packed_round documents the
+    boundary; results are bit-identical across it).
 
     Per shard, pack/unpack and every per-lane XLA op run once per SLICE
     instead of once per round — between rounds only the kernels' psum'd
-    partials move.  One shared loop definition (run_packed_slice) serves
+    partials move (int16/int8-narrowed per the quorum bound, widened
+    before the psum).  One shared loop definition (run_packed_slice) serves
     this runner and the single-device run_packed; bit-identity with the
     unfused path is pinned by tests/test_pallas_round.py's sharded
     one-shot/slice/resume cases and the dryrun legs.

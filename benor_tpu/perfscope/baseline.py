@@ -142,6 +142,163 @@ def compare_manifests(new: dict, base: dict,
     return out
 
 
+def _headline(rec: dict) -> dict:
+    """Unwrap a committed bench record to its headline dict.
+
+    The driver commits BENCH_r*.json as a wrapper ({cmd, rc, tail,
+    parsed}) whose ``parsed`` key holds the stdout headline; a raw
+    headline (bench.py stdout piped straight to a file) is its own
+    record.  The trajectory walkers accept both — before PR 8 the
+    wrapper records silently read as pre-metric captures and the whole
+    committed series was skipped."""
+    parsed = rec.get("parsed")
+    if isinstance(parsed, dict):
+        return parsed
+    return rec
+
+
+#: The PR-8 acceptance bound: the bit-plane relayout must cut per-node
+#: round traffic at least this much AT THE BENCH GEOMETRY
+#: (max_rounds = PACKED_RATIO_REF_MAX_ROUNDS).  The raw
+#: packed_traffic_ratio in a manifest is a pure function of the capture's
+#: max_rounds (more rounds = more k planes), so check_fused_vs_xla
+#: NORMALIZES it to the reference geometry before gating — a
+#: --max-rounds 64 capture must not read as a layout regression, and a
+#: widened PACK_LAYOUT field must not hide behind a small capture.
+PACKED_TRAFFIC_MIN_RATIO = 4.0
+PACKED_RATIO_REF_MAX_ROUNDS = 12
+
+
+def _k_planes(max_rounds: int) -> int:
+    """state.pack_k_bits_for, stdlib twin (this module must not import
+    jax-bearing modules — the NO-JAX gate contract)."""
+    return max(int(max_rounds + 1).bit_length(), 1)
+
+
+def normalized_traffic_ratio(fvx: dict):
+    """The capture's layout re-priced at the reference bench geometry:
+    old-layout bytes over new-layout bytes per node per round with the k
+    field resized to PACKED_RATIO_REF_MAX_ROUNDS.  None when the block
+    lacks the packing fields (schema drift — the schema gate owns
+    that)."""
+    bits = fvx.get("packed_bits_per_node")
+    old_bytes = fvx.get("unpacked_round_bytes_per_node")
+    mr = fvx.get("max_rounds")
+    if bits is None or not old_bytes or mr is None:
+        return None
+    static_bits = bits - _k_planes(mr)
+    ref_bits = static_bits + _k_planes(PACKED_RATIO_REF_MAX_ROUNDS)
+    if ref_bits <= 0:
+        return None
+    return old_bytes / (2.0 * ref_bits / 8.0)
+
+
+def check_fused_vs_xla(manifest: dict) -> List[str]:
+    """The fused-beats-XLA acceptance gate over a manifest's
+    ``fused_vs_xla`` block (PR 8) — "REGRESSION: ..." strings drive exit
+    2, "note: ..." strings are informational.
+
+    On a real backend the fused round kernel must BEAT the plain XLA
+    loop (speedup > 1.0) — the committed-bench era where the flagship
+    fast path lost to XLA (BENCH_r05 pallas_speedups.round = 0.628) is
+    what this pin forbids forever.  ``interpret_mode`` captures (CPU:
+    the pallas kernels run under the interpreter, so the ratio measures
+    emulation overhead, not the kernels) are EXCLUDED from the speedup
+    gate and held to the layout-derived ``packed_traffic_ratio`` >=
+    PACKED_TRAFFIC_MIN_RATIO instead.  A missing block (pre-PR-8
+    manifest) or an explicit null (--regimes-subset capture) is a note,
+    never a silent pass of the speedup claim."""
+    findings: List[str] = []
+    if "fused_vs_xla" not in manifest:
+        findings.append("note: manifest predates the fused_vs_xla block "
+                        "(schema_version < 2); fused-vs-XLA not gated")
+        return findings
+    fvx = manifest["fused_vs_xla"]
+    if fvx is None:
+        findings.append("note: fused_vs_xla is null (subset capture); "
+                        "fused-vs-XLA not gated")
+        return findings
+    if not fvx.get("bit_equal", False):
+        findings.append(
+            "REGRESSION: fused_vs_xla.bit_equal is false — the fused "
+            "and XLA legs diverged; the fused path is WRONG, not slow")
+    ratio = normalized_traffic_ratio(fvx)
+    if ratio is None or ratio < PACKED_TRAFFIC_MIN_RATIO:
+        findings.append(
+            f"REGRESSION: fused_vs_xla packed traffic ratio "
+            f"{ratio if ratio is None else round(ratio, 4)} < "
+            f"{PACKED_TRAFFIC_MIN_RATIO} at the reference geometry "
+            f"(max_rounds={PACKED_RATIO_REF_MAX_ROUNDS}; the capture's "
+            f"own k width is normalized out) — the bit-plane relayout "
+            f"no longer cuts per-node round traffic enough (did a "
+            f"field widen in state.PACK_LAYOUT?)")
+    if fvx.get("interpret_mode"):
+        findings.append(
+            f"note: interpret-mode capture — fused/XLA speedup "
+            f"{fvx.get('speedup')} measures the pallas interpreter and "
+            f"is excluded from gating (the geometry-normalized traffic "
+            f"ratio above carries the acceptance bound)")
+        return findings
+    speedup = fvx.get("speedup")
+    if speedup is None or speedup <= 1.0:
+        findings.append(
+            f"REGRESSION: fused_vs_xla.speedup {speedup} <= 1.0 on a "
+            f"real backend ({fvx.get('rounds_executed')} rounds at "
+            f"N={fvx.get('n_nodes')}) — the fused fast path trails the "
+            f"plain XLA loop again")
+    return findings
+
+
+def check_pallas_speedup_trajectory(paths: Sequence[str],
+                                    collapse_ratio: float = 3.0
+                                    ) -> List[str]:
+    """Same-platform pallas-kernel speedup collapses along the committed
+    BENCH_r*.json series — with interpret-mode captures EXCLUDED.
+
+    Records carrying ``pallas_interpret: true`` measured the kernels
+    under the CPU pallas interpreter: their ratios price XLA-vs-emulator
+    and systematically read as losses (BENCH_r05's round=0.628 was this
+    artifact).  Treating them as regressions — or their occasional
+    emulator-beats-XLA flukes as wins — would gate on noise, so they are
+    noted and skipped; only real-backend ratios participate, per
+    (platform, kernel), against the best earlier same-platform value."""
+    findings: List[str] = []
+    best: Dict[tuple, tuple] = {}    # (platform, kernel) -> (ratio, path)
+    for path in paths:
+        try:
+            with open(path) as fh:
+                rec = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            findings.append(f"note: {path}: unreadable ({e})")
+            continue
+        if not isinstance(rec, dict) or rec.get("error"):
+            continue                 # the bench walk already notes these
+        head = _headline(rec)
+        speedups = head.get("pallas_speedups")
+        if not isinstance(speedups, dict) or not speedups:
+            continue                 # pre-metric capture
+        if head.get("pallas_interpret"):
+            findings.append(
+                f"note: {path}: pallas_speedups captured under the "
+                f"interpreter (pallas_interpret=true) — excluded from "
+                f"kernel-ratio gating")
+            continue
+        plat = head.get("platform")
+        for kernel, ratio in speedups.items():
+            if not isinstance(ratio, (int, float)) or not plat:
+                continue
+            key = (plat, kernel)
+            prev = best.get(key)
+            if prev and ratio * collapse_ratio < prev[0]:
+                findings.append(
+                    f"REGRESSION: {path}: pallas_speedups.{kernel} "
+                    f"{ratio:.3g} is >{collapse_ratio}x below the "
+                    f"{plat} best {prev[0]:.3g} ({prev[1]})")
+            if prev is None or ratio > prev[0]:
+                best[key] = (ratio, path)
+    return findings
+
+
 def check_bench_trajectory(paths: Sequence[str],
                            collapse_ratio: float = 3.0) -> List[str]:
     """Same-platform throughput collapses along a BENCH_r*.json series.
@@ -164,8 +321,9 @@ def check_bench_trajectory(paths: Sequence[str],
         if not isinstance(rec, dict) or rec.get("error"):
             findings.append(f"note: {path}: error record, skipped")
             continue
-        plat = rec.get("platform")
-        nrps = rec.get("node_rounds_per_sec")
+        head = _headline(rec)
+        plat = head.get("platform")
+        nrps = head.get("node_rounds_per_sec")
         if not plat or nrps is None:
             # ABSENT metric = pre-metric capture; a present 0.0 is the
             # worst possible collapse and must flow into the comparison

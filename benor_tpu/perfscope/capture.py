@@ -40,7 +40,9 @@ from .roofline import roofline
 
 #: PerfReport / manifest schema version; bump on any key change
 #: (tools/perf_report_schema.json is the pinned schema).
-REPORT_VERSION = 1
+#: v2 (PR 8): top-level ``fused_vs_xla`` block — the paired fused-vs-XLA
+#: measurement + the bit-plane packing cost model.
+REPORT_VERSION = 2
 
 
 @dataclasses.dataclass

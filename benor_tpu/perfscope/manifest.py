@@ -26,8 +26,15 @@ from .regimes import REGIME_NAMES
 MANIFEST_KIND = "perf_manifest"
 
 
-def build_manifest(reports: Sequence[PerfReport], scale: dict) -> dict:
-    """Assemble the manifest document from a capture session's reports."""
+def build_manifest(reports: Sequence[PerfReport], scale: dict,
+                   fused_vs_xla: dict = None) -> dict:
+    """Assemble the manifest document from a capture session's reports.
+
+    ``fused_vs_xla`` (regimes.capture_fused_vs_xla) is the PR-8 paired
+    fused-vs-XLA measurement + the layout-derived packing cost model;
+    None (a --regimes-subset capture that skipped the pair) records an
+    explicit null, which the regression gate treats as "nothing to
+    gate" rather than a pass."""
     import jax
 
     dev = jax.devices()[0]
@@ -41,6 +48,7 @@ def build_manifest(reports: Sequence[PerfReport], scale: dict) -> dict:
         "scale": {k: int(scale[k])
                   for k in ("n_nodes", "trials", "max_rounds", "seed")},
         "regimes": {r.regime: r.to_dict() for r in reports},
+        "fused_vs_xla": fused_vs_xla,
     }
 
 

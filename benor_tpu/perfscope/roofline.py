@@ -52,6 +52,57 @@ def flops_peak_for(device_kind: str) -> Optional[float]:
     return _lookup(FLOPS_PEAKS, device_kind)
 
 
+#: HBM bytes the PRE-PR-8 packed round moved per node per round: the
+#: two-kernel int32-word pipeline read the 4-byte pack in the proposal
+#: kernel, re-read it in the vote kernel, and wrote the new word — the
+#: inter-kernel round-trip the single-pass fused kernel eliminates.
+#: The denominator of ``packing_report``'s traffic ratio (and the bound
+#: the CPU-only acceptance gate in tools/check_perf_regression.py holds
+#: the relayout to).
+UNPACKED_WORD_ROUND_BYTES = 12.0
+
+#: Bits the old layout spent per node (one int32 word).
+UNPACKED_WORD_BITS = 32
+
+
+def packed_bits_per_node(max_rounds: int) -> int:
+    """Hot-state bits per node under the bit-plane layout
+    (state.PACK_LAYOUT): the static protocol planes plus the
+    config-sized k planes.  Derived from the declarative table — a
+    relayout is a table edit and this report follows it."""
+    from ..state import PACK_STATIC_WIDTH, pack_k_bits_for
+
+    return PACK_STATIC_WIDTH + pack_k_bits_for(max_rounds)
+
+
+def packed_round_bytes_per_node(max_rounds: int) -> float:
+    """HBM bytes the single-pass fused round moves per node per round:
+    one plane-stack read + one write (the partial buffers are O(T), not
+    O(N), and the count vectors O(T) — neither scales with nodes)."""
+    return 2.0 * packed_bits_per_node(max_rounds) / 8.0
+
+
+def packing_report(max_rounds: int) -> dict:
+    """The packing cost model as manifest-ready numbers.
+
+    ``packed_traffic_ratio`` is old-layout bytes over new-layout bytes
+    per node per round (>= 4 at the bench geometry — the acceptance
+    criterion tools/check_perf_regression.py pins when kernel wall
+    clocks are interpret-mode noise); ``packing_efficiency`` is how much
+    of the old 32-bit word the hot state actually needed (what the
+    relayout recovered)."""
+    bits = packed_bits_per_node(max_rounds)
+    new_bytes = packed_round_bytes_per_node(max_rounds)
+    return {
+        "packed_bits_per_node": bits,
+        "packed_round_bytes_per_node": round(new_bytes, 4),
+        "unpacked_round_bytes_per_node": UNPACKED_WORD_ROUND_BYTES,
+        "packed_traffic_ratio": round(UNPACKED_WORD_ROUND_BYTES
+                                      / new_bytes, 4),
+        "packing_efficiency": round(bits / UNPACKED_WORD_BITS, 4),
+    }
+
+
 def roofline(flops: float, bytes_accessed: float, exec_s: float,
              device_kind: str) -> dict:
     """Place one executed program on the device roofline.

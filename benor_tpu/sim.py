@@ -133,10 +133,13 @@ def run_consensus(cfg: SimConfig, state: NetState, faults: FaultSpec,
     witness buffer when ``cfg.witness`` is set (in that order).
     jit-compiled once per config (SimConfig is static/hashable); the loop
     is on-device, zero host round trips per round.  In the fused-kernel
-    regime (tally.pallas_round_active) the loop carries the PACKED
-    per-lane state word instead of NetState — pack/unpack and every
-    per-lane XLA op run once per RUN, not per round — with bit-identical
-    results (the kernels share the unfused path's exact random streams).
+    regime (tally.pallas_round_active) the loop carries the BIT-PLANE
+    packed state stack (state.PACK_LAYOUT: ~6 + k_bits bits per node at
+    32 nodes per uint32 word) instead of NetState — pack/unpack and
+    every per-lane XLA op run once per RUN, not per round, and on a
+    single device the whole round is ONE kernel pass
+    (pallas_round.fused_round_pallas) — with bit-identical results (the
+    kernels share the unfused path's exact random streams).
 
     PERF CLIFF — ``cfg.debug`` is NOT zero-cost in the fused regime: the
     per-round host callbacks cannot run inside the packed kernels, so a

@@ -153,6 +153,92 @@ class FaultSpec:
 
 
 # --------------------------------------------------------------------------
+# Packed node state (the fused-kernel fast path, SimConfig.use_pallas_round):
+# the declarative BIT-FIELD layout of the hot per-node state.
+#
+# PR 8 relaid the packed representation from one int32 word per node
+# (4 B/node, bits 0-1 x / 2 decided / 3 killed / 4 faulty / 5+ k) to
+# BIT-PLANES: a uint32 [T, planes, N/32] stack where plane ``base + b``
+# holds bit ``b`` of the named field for 32 nodes per word.  The hot
+# protocol state (x, decided, killed, coin-commit, faulty) costs 6 bits
+# per node; the round counter k adds only ``pack_k_bits(cfg)`` planes
+# (the bit length of max_rounds + 1 — 4 planes at the bench geometry's
+# max_rounds=12) instead of a fixed 26.  The fused round kernels
+# (ops/pallas_round.py) read and write this stack directly, so one round
+# moves ~2 x (6 + k_bits)/8 bytes per node instead of the old layout's
+# 12 (two kernels x 4-byte word read + one write) — the 4x+ traffic cut
+# perfscope's bytes-per-node report (perfscope/roofline.py) prices from
+# THIS table.
+# --------------------------------------------------------------------------
+
+#: Packed-state bit-field layout — name -> (base, width) in BITS (= plane
+#: indices), the same machine-readable pure-literal discipline as
+#: REC_LAYOUT / WIT_LAYOUT below: the runtime (pack/unpack here, the
+#: kernel plane loads/stores in ops/pallas_round.py, the perfscope
+#: bytes-per-node pricing) derives every index from this table and the
+#: static layout checker (benor_tpu/analysis/rules_layout.py) re-parses
+#: it and proves: ranges overlap-free and dense from bit 0, the total
+#: width fits one uint32 word (so a 32-plane stack — or a transposed
+#: one-word-per-node view — can always hold it), and the field names
+#: cover NetState's fields plus PACK_EXTRA_FIELDS exactly.  ``k``'s
+#: declared width is the CAP; at runtime only ``pack_k_bits(cfg)``
+#: planes of it are materialized (config.py rejects max_rounds that
+#: would not fit).
+PACK_LAYOUT = {
+    "x": (0, 2),        # protocol value VAL0 | VAL1 | VALQ
+    "decided": (2, 1),  # decided bit (node.ts:100,103)
+    "killed": (3, 1),   # killed bit (pad lanes carry it too)
+    "coined": (4, 1),   # lane committed a coin flip this round
+    "faulty": (5, 1),   # fault mask (byzantine flip / equivocator tag)
+    "k": (6, 26),       # round counter, low bit first (width = the cap)
+}
+
+#: Packed fields that are NOT NetState leaves (the layout checker proves
+#: set(PACK_LAYOUT) == NetState fields + these, so a field can neither
+#: silently vanish from the pack nor ride it undeclared).  ``faulty``
+#: packs the FaultSpec mask the kernels consult every round; ``coined``
+#: carries each round's coin-commit bit in the stack for forensic
+#: unpacking (``pallas_round.plane_field(pack, PACK_COINED, 1)``) — the
+#: recorder/witness partials compute their own coined mask in-register,
+#: so dropping this plane would save 1 bit/node at the cost of the
+#: post-hoc evidence channel.
+PACK_EXTRA_FIELDS = ("faulty", "coined")
+
+PACK_X = PACK_LAYOUT["x"][0]
+PACK_DECIDED = PACK_LAYOUT["decided"][0]
+PACK_KILLED = PACK_LAYOUT["killed"][0]
+PACK_COINED = PACK_LAYOUT["coined"][0]
+PACK_FAULTY = PACK_LAYOUT["faulty"][0]
+PACK_K = PACK_LAYOUT["k"][0]
+PACK_K_MAX_BITS = PACK_LAYOUT["k"][1]
+#: Planes below the (variable-width) k field — the hot protocol bits.
+PACK_STATIC_WIDTH = PACK_K
+#: Nodes per uint32 plane word.
+PACK_NODES_PER_WORD = 32
+
+
+def pack_k_bits_for(max_rounds: int) -> int:
+    """Planes a round counter capped at ``max_rounds`` needs: k reaches
+    max_rounds + 1, low bit first.  Config-free so jax-light consumers
+    (perfscope/roofline.py's packing cost model) can share the one
+    formula."""
+    return max(int(max_rounds + 1).bit_length(), 1)
+
+
+def pack_k_bits(cfg: SimConfig) -> int:
+    """Planes the round counter needs for this config.  Static
+    (config-only), <= the PACK_LAYOUT cap — config.py rejects max_rounds
+    past it."""
+    return pack_k_bits_for(cfg.max_rounds)
+
+
+def pack_width(cfg: SimConfig) -> int:
+    """Total planes a packed [T, planes, N/32] stack carries for this
+    config: the static protocol bits + the k planes."""
+    return PACK_STATIC_WIDTH + pack_k_bits(cfg)
+
+
+# --------------------------------------------------------------------------
 # Flight recorder (SimConfig.record): the on-device round-history buffer.
 #
 # One int32 row per executed round, written inside the compiled while-loop

@@ -256,7 +256,10 @@ def quorum_specialized(cfg: SimConfig) -> bool:
     a static bucket each.  The single source of truth for the batched
     engine's bucketing (state.DynParams documents the constraint)."""
     if tally.pallas_stream_active(cfg) or tally.pallas_round_active(cfg):
-        return True                 # kernels bake m/F into their closures
+        # kernels bake m/F into their closures; the PR-8 plane-packed
+        # round additionally sizes its k-plane stack and partial dtype
+        # (pallas_round.partial_dtype's quorum bound) per static config
+        return True
     if (cfg.delivery == "quorum" and cfg.resolved_path == "dense"
             and cfg.scheduler not in ("adversarial", "targeted")):
         return True                 # top-k delivery mask: static m shape

@@ -386,6 +386,72 @@ def test_witness_budget_pinned_to_partial_cols(tmp_path):
 
 
 # --------------------------------------------------------------------------
+# pack rules: mutations of the REAL bit-field layout table (PR 8)
+# --------------------------------------------------------------------------
+
+
+_PACK_RULES = ["pack-layout", "pack-parity"]
+
+
+def test_pack_rules_clean_on_shipped_table(tmp_path):
+    root = _layout_tree(tmp_path)
+    active, _ = _findings(root, rules=_PACK_RULES)
+    assert active == []
+
+
+@pytest.mark.parametrize("field", ["x", "decided", "killed", "coined",
+                                   "faulty", "k"])
+def test_removing_any_pack_field_fails(tmp_path, field):
+    # acceptance: removing ANY single bit-field from PACK_LAYOUT must
+    # fail lint — NetState fields via pack-parity, the extra fields via
+    # parity-or-density (coined/faulty leave a plane gap AND break the
+    # PACK_EXTRA_FIELDS set)
+    root = _layout_tree(tmp_path)
+    base = {"x": "(0, 2)", "decided": "(2, 1)", "killed": "(3, 1)",
+            "coined": "(4, 1)", "faulty": "(5, 1)", "k": "(6, 26)"}[field]
+    _edit(root, "state.py", f'    "{field}": {base},', "", count=1)
+    active, _ = _findings(root, rules=_PACK_RULES)
+    assert any(f.path == "state.py" for f in active), \
+        f"dropping packed field {field} went unnoticed"
+
+
+def test_pack_overlap_fails(tmp_path):
+    root = _layout_tree(tmp_path)
+    _edit(root, "state.py", '    "killed": (3, 1),',
+          '    "killed": (2, 1),', count=1)
+    active, _ = _findings(root, rules=["pack-layout"])
+    assert any("overlaps" in f.message for f in active)
+
+
+def test_pack_width_must_fit_word(tmp_path):
+    # widening k past the uint32 word budget must fail — the declared
+    # cap is what config.py's max_rounds validation enforces at runtime
+    root = _layout_tree(tmp_path)
+    _edit(root, "state.py", '    "k": (6, 26),', '    "k": (6, 30),',
+          count=1)
+    active, _ = _findings(root, rules=["pack-layout"])
+    assert any("word" in f.message for f in active)
+
+
+def test_pack_undeclared_extra_field_fails(tmp_path):
+    # a packed field that is neither a NetState leaf nor declared in
+    # PACK_EXTRA_FIELDS rides the stack undocumented -> pack-parity
+    root = _layout_tree(tmp_path)
+    _edit(root, "state.py", 'PACK_EXTRA_FIELDS = ("faulty", "coined")',
+          'PACK_EXTRA_FIELDS = ("faulty",)', count=1)
+    active, _ = _findings(root, rules=["pack-parity"])
+    assert any("coined" in f.message for f in active)
+
+
+def test_deleting_pack_table_is_itself_a_finding(tmp_path):
+    root = _layout_tree(tmp_path)
+    _edit(root, "state.py", "PACK_LAYOUT = {", "PACK_LAYOUT_RENAMED = {",
+          count=1)
+    active, _ = _findings(root, rules=["pack-layout"])
+    assert any("missing" in f.message for f in active)
+
+
+# --------------------------------------------------------------------------
 # config parity: fixture + mutation of the real sharded regime
 # --------------------------------------------------------------------------
 

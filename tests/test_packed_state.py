@@ -1,0 +1,356 @@
+"""Bit-plane packed node state (PR 8: state.PACK_LAYOUT +
+ops/pallas_round.py pack_state/unpack_state/fused_round_pallas).
+
+Three contracts:
+
+  1. pack/unpack round-trip: property-style over random [T, N] states —
+     every NetState leaf survives the plane transpose bit-for-bit, pad
+     lanes carry the killed bit + inert "?" value, and the stack's plane
+     count follows state.pack_width(cfg).
+  2. packed-vs-unpacked BIT-IDENTITY in results AND compile counts
+     across the compiled regimes: the fused dispatch (one-pass kernel or
+     two-kernel plane pipeline) must equal the unfused pallas path,
+     whether entered via run_consensus (traced/fused), the slice
+     primitive, the batched sweep's static bucket, or the sharded
+     runner.
+  3. pad-lane masking for the word layout (the PR 3 witness-aliasing bug
+     class): node-sharded pads alias the next shard's global id range,
+     so an unmasked pad bit inside the last plane words would
+     double-count tallies/witness columns after the psum — sharded
+     witness rows must equal single-device rows exactly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from benor_tpu.config import SimConfig
+from benor_tpu.ops import sampling, tally
+from benor_tpu.ops.pallas_round import (FUSED_ONE_PASS_MAX_NODES,
+                                        pack_state, partial_dtype,
+                                        plane_field, unpack_state)
+from benor_tpu.sim import run_consensus
+from benor_tpu.state import (PACK_COINED, PACK_FAULTY, PACK_KILLED,
+                             PACK_LAYOUT, PACK_STATIC_WIDTH, FaultSpec,
+                             NetState, init_state, pack_k_bits,
+                             pack_width)
+from benor_tpu.sweep import balanced_inputs
+
+
+def _random_state(rng, t, n, max_k):
+    return NetState(
+        x=jnp.asarray(rng.integers(0, 3, size=(t, n)), jnp.int8),
+        decided=jnp.asarray(rng.integers(0, 2, size=(t, n)), bool),
+        k=jnp.asarray(rng.integers(0, max_k + 1, size=(t, n)), jnp.int32),
+        killed=jnp.asarray(rng.integers(0, 2, size=(t, n)), bool),
+    )
+
+
+@pytest.mark.parametrize("t,n", [(1, 1), (3, 31), (2, 32), (4, 96),
+                                 (2, 512), (1, 513)])
+def test_pack_unpack_round_trip(t, n):
+    """Property-style: random states (every (t, n) crossing word and
+    tile boundaries, so pad lanes exist in most cases) round-trip
+    bit-for-bit, faulty mask included."""
+    rng = np.random.default_rng(1234 + t * 1000 + n)
+    cfg = SimConfig(n_nodes=n, n_faulty=0, trials=t, max_rounds=37)
+    for trial in range(3):
+        state = _random_state(rng, t, n, cfg.max_rounds + 1)
+        faulty = jnp.asarray(rng.integers(0, 2, size=(t, n)), bool)
+        pack = pack_state(cfg, state, faulty)
+        assert pack.dtype == jnp.uint32
+        assert pack.shape[1] == pack_width(cfg)
+        back = unpack_state(pack, n)
+        np.testing.assert_array_equal(np.asarray(back.x),
+                                      np.asarray(state.x))
+        np.testing.assert_array_equal(np.asarray(back.decided),
+                                      np.asarray(state.decided))
+        np.testing.assert_array_equal(np.asarray(back.k),
+                                      np.asarray(state.k))
+        np.testing.assert_array_equal(np.asarray(back.killed),
+                                      np.asarray(state.killed))
+        # the faulty mask rides its declared plane
+        fb = plane_field(pack, PACK_FAULTY, 1)[:, :n]
+        np.testing.assert_array_equal(np.asarray(fb).astype(bool),
+                                      np.asarray(faulty))
+
+
+def test_pad_lanes_killed_and_inert():
+    """Pad lanes (both in-word and whole pad words) carry the killed bit
+    and x = "?", with zero k/faulty/coined — the invariant every
+    histogram, alive count and settled count relies on."""
+    from benor_tpu.config import VALQ
+
+    t, n = 2, 70                     # pads 70..511 inside the plane words
+    cfg = SimConfig(n_nodes=n, n_faulty=0, trials=t, max_rounds=5)
+    rng = np.random.default_rng(7)
+    state = _random_state(rng, t, n, cfg.max_rounds)
+    pack = pack_state(cfg, state, jnp.zeros((t, n), bool))
+    np_total = pack.shape[2] * 32
+    assert np_total >= n
+    killed = plane_field(pack, PACK_KILLED, 1)
+    x = plane_field(pack, 0, PACK_LAYOUT["x"][1])
+    coined = plane_field(pack, PACK_COINED, 1)
+    assert bool((killed[:, n:] == 1).all())
+    assert bool((x[:, n:] == VALQ).all())
+    assert bool((coined == 0).all())  # no round has run anywhere
+
+
+def test_k_planes_follow_max_rounds():
+    """The k field materializes only the planes this config's round cap
+    needs — the whole point of the variable-width relayout."""
+    for mr, bits in ((1, 2), (6, 3), (12, 4), (200, 8), (40000, 16)):
+        cfg = SimConfig(n_nodes=8, n_faulty=0, max_rounds=mr)
+        assert pack_k_bits(cfg) == bits, mr
+        assert pack_width(cfg) == PACK_STATIC_WIDTH + bits
+    assert pack_k_bits(SimConfig(n_nodes=8, n_faulty=0, max_rounds=12)) \
+        <= PACK_LAYOUT["k"][1]
+
+
+def test_partial_dtype_quorum_bounds():
+    """The tally-partial narrowing follows the N-F quorum bound: int16
+    whenever the quorum and tile fit 15 bits, int32 past that, int8 for
+    genuinely tiny tiles."""
+    assert partial_dtype(72, 512) == jnp.int16
+    assert partial_dtype(20000, 512) == jnp.int16
+    assert partial_dtype(40000, 512) == jnp.int32
+    assert partial_dtype(500, 40000) == jnp.int32
+    assert partial_dtype(60, 100) == jnp.int8
+
+
+def _fused_cfg(n, t, seed, **kw):
+    kw.setdefault("n_faulty", n // 4)
+    kw.setdefault("max_rounds", 16)
+    return SimConfig(n_nodes=n, trials=t, delivery="quorum",
+                     scheduler="uniform", path="histogram",
+                     use_pallas_hist=True, use_pallas_round=True,
+                     seed=seed, **kw)
+
+
+def _run_pair(cfg_fused, faults, state, key):
+    """(unfused pallas run, fused run) final tuples for one config."""
+    outs = []
+    for use_round in (False, True):
+        cfg = cfg_fused.replace(use_pallas_round=use_round)
+        r, fin = run_consensus(cfg, state, faults, key)
+        outs.append((int(r), np.asarray(fin.x), np.asarray(fin.decided),
+                     np.asarray(fin.k), np.asarray(fin.killed)))
+    return outs
+
+
+def test_packed_vs_unpacked_bit_identity_smoke():
+    """Tier-1 (non-slow) pin of the PR-8 acceptance: a fused
+    (plane-packed, one-pass kernel) run equals the unfused pallas run
+    bit-for-bit at a compact geometry.  The full battery (all fault
+    models / coins / regimes) lives in the slow marks here and in
+    tests/test_pallas_round.py."""
+    old = sampling.EXACT_TABLE_MAX
+    sampling.EXACT_TABLE_MAX = 4
+    try:
+        n, t = 64, 4
+        cfg = _fused_cfg(n, t, seed=2, n_faulty=26, max_rounds=6)
+        assert tally.pallas_round_active(cfg)
+        faults = FaultSpec.none(t, n)
+        state = init_state(cfg, balanced_inputs(t, n), faults)
+        outs = _run_pair(cfg, faults, state, jax.random.key(cfg.seed))
+        (r0, *a), (r1, *b) = outs
+        assert r0 == r1
+        for x, y, name in zip(a, b, ("x", "decided", "k", "killed")):
+            np.testing.assert_array_equal(x, y, err_msg=name)
+    finally:
+        sampling.EXACT_TABLE_MAX = old
+
+
+@pytest.mark.slow
+def test_one_pass_vs_two_kernel_bit_identity():
+    """The single-pass kernel (within the FUSED_ONE_PASS caps) and the
+    two-kernel plane pipeline must agree bit-for-bit: force the
+    two-kernel path by dropping the cap, then compare against the
+    default dispatch on the same config."""
+    from benor_tpu.ops import pallas_round as pr
+
+    old = sampling.EXACT_TABLE_MAX
+    sampling.EXACT_TABLE_MAX = 4
+    try:
+        n, t = 96, 8
+        cfg = _fused_cfg(n, t, seed=2, n_faulty=40)
+        assert tally.pallas_round_active(cfg)
+        faults = FaultSpec.none(t, n)
+        state = init_state(cfg, balanced_inputs(t, n), faults)
+        key = jax.random.key(cfg.seed)
+        r1, f1 = run_consensus(cfg, state, faults, key)
+
+        old_cap = pr.FUSED_ONE_PASS_MAX_NODES
+        pr.FUSED_ONE_PASS_MAX_NODES = 0          # demote to two-kernel
+        try:
+            # run the packed loop EAGERLY (run_packed is the function
+            # run_consensus jits): an equal-hash cfg through the jitted
+            # entry would be served the cached one-pass executable and
+            # the comparison would be vacuous
+            out = pr.run_packed(cfg, state, faults,
+                                jax.random.key(cfg.seed))
+            r2, f2 = out[0], out[1]
+        finally:
+            pr.FUSED_ONE_PASS_MAX_NODES = old_cap
+        assert int(r1) == int(r2)
+        for name in ("x", "decided", "k", "killed"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(f1, name)),
+                np.asarray(getattr(f2, name)), err_msg=name)
+        assert int(r1) > 1, "needs a multi-round scenario to pin anything"
+    finally:
+        sampling.EXACT_TABLE_MAX = old
+
+
+@pytest.mark.slow
+def test_fused_compile_counts_match_unfused():
+    """Regime discipline: the plane relayout must not change HOW MANY
+    backend compiles a fused run costs vs the unfused pallas path (one
+    jit entry per config either way)."""
+    from benor_tpu.utils.compile_counter import count_backend_compiles
+
+    old = sampling.EXACT_TABLE_MAX
+    sampling.EXACT_TABLE_MAX = 4
+    try:
+        n, t = 96, 4
+        counts = []
+        for use_round, seed in ((False, 51), (True, 53)):
+            cfg = _fused_cfg(n, t, seed=seed, n_faulty=24,
+                             max_rounds=8).replace(
+                                 use_pallas_round=use_round)
+            faults = FaultSpec.none(t, n)
+            state = init_state(cfg, balanced_inputs(t, n), faults)
+            with count_backend_compiles() as cc:
+                r, _ = run_consensus(cfg, state, faults,
+                                     jax.random.key(seed))
+                int(r)
+            counts.append(cc.count)
+        assert counts[0] == counts[1] == 1, counts
+    finally:
+        sampling.EXACT_TABLE_MAX = old
+
+
+@pytest.mark.slow
+def test_packed_bit_identity_sliced_and_batched():
+    """The slice primitive and the batched sweep's static bucket both
+    dispatch onto the plane loop; both must equal the one-shot fused
+    run (and hence, transitively, the unfused path)."""
+    from benor_tpu.sim import run_consensus_slice, start_state
+
+    old = sampling.EXACT_TABLE_MAX
+    sampling.EXACT_TABLE_MAX = 4
+    try:
+        n, t = 96, 8
+        cfg = _fused_cfg(n, t, seed=2, n_faulty=40)
+        faults = FaultSpec.none(t, n)
+        state = init_state(cfg, balanced_inputs(t, n), faults)
+        key = jax.random.key(cfg.seed)
+        r1, f1 = run_consensus(cfg, state, faults, key)
+        assert int(r1) > 1
+
+        st, r = start_state(cfg, state), 1
+        while True:
+            r_next, st = run_consensus_slice(cfg, st, faults, key,
+                                             jnp.int32(r),
+                                             jnp.int32(r + 3))
+            rn = int(r_next)
+            if rn == r or rn > cfg.max_rounds or bool(np.asarray(
+                    (st.decided | st.killed).all())):
+                break
+            r = rn
+        assert rn - 1 == int(r1)
+        for name in ("x", "decided", "k", "killed"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(f1, name)),
+                np.asarray(getattr(st, name)), err_msg=name)
+
+        # the batched sweep buckets pallas configs statically
+        # (quorum_specialized): the static bucket runs the SAME fused
+        # loop — its per-point summary must match the one-shot run's
+        from benor_tpu.sweep import run_curve_batched, summarize_final
+        # faults_for must match the one-shot run's zero-crash spec (the
+        # default is the first-F-faulty crash mask, a different network)
+        curve = run_curve_batched(cfg, [cfg.n_faulty],
+                                  balanced_inputs(t, n),
+                                  faults_for=lambda c: faults)
+        pt = curve.points[0]
+        dec, mk, ones, _khist, dis = summarize_final(
+            f1, faults.faulty, cfg.max_rounds)
+        assert pt.rounds_executed == int(r1)
+        assert pt.decided_frac == pytest.approx(float(dec))
+        assert pt.mean_k == pytest.approx(float(mk))
+        assert pt.ones_frac == pytest.approx(float(ones))
+        assert pt.disagree_frac == pytest.approx(float(dis))
+    finally:
+        sampling.EXACT_TABLE_MAX = old
+
+
+@pytest.mark.slow
+def test_per_round_packed_branch_crash_at_round():
+    """benor_round's packed branch (pack/unpack at the round boundary —
+    the trajectory/per-round callers) under crash_at_round: the caller
+    must pad crash_round to the padded NODE total, not the plane count
+    (the PR-8 relayout moved the node axis to pack.shape[2] * 32; a
+    review caught the stale shape[1] crashing this exact path)."""
+    from benor_tpu.models.benor import benor_round
+    from benor_tpu.sim import start_state
+
+    old = sampling.EXACT_TABLE_MAX
+    sampling.EXACT_TABLE_MAX = 4
+    try:
+        n, t = 96, 4
+        cr = np.where(np.arange(n) < 20, 2, 0)
+        outs = {}
+        for fused in (False, True):
+            cfg = _fused_cfg(n, t, seed=17, n_faulty=20,
+                             fault_model="crash_at_round").replace(
+                                 use_pallas_round=fused)
+            faults = FaultSpec.first_f(cfg, crash_rounds=cr)
+            state = start_state(cfg, init_state(
+                cfg, balanced_inputs(t, n), faults))
+            st = state
+            for r in (1, 2, 3):
+                st = benor_round(cfg, st, faults, jax.random.key(cfg.seed),
+                                 jnp.int32(r))
+            outs[fused] = st
+        for name in ("x", "decided", "k", "killed"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(outs[False], name)),
+                np.asarray(getattr(outs[True], name)), err_msg=name)
+        assert bool(np.asarray(outs[True].killed)[:, :20].all())
+    finally:
+        sampling.EXACT_TABLE_MAX = old
+
+
+@pytest.mark.slow
+def test_pad_alias_no_double_count_sharded_witness():
+    """The pad-lane masking audit for the word layout (satellite: the
+    PR 3 witness bug class).  On a (1, 4) node-sharded mesh each shard
+    pads its 24 local nodes to a full tile whose pad ids ALIAS the next
+    shard's real range; if a pad bit inside the plane words leaked into
+    the witness partials, the psum would double every aliased watched
+    node's columns.  Sharded witness rows must equal the single-device
+    rows bit-for-bit."""
+    from benor_tpu.parallel import make_mesh, run_consensus_sharded
+
+    old = sampling.EXACT_TABLE_MAX
+    sampling.EXACT_TABLE_MAX = 4
+    try:
+        n, t = 96, 8
+        cfg = _fused_cfg(n, t, seed=35, n_faulty=24).replace(
+            witness_trials=(0, 3), witness_nodes=4)
+        assert tally.pallas_round_active(cfg)
+        faults = FaultSpec.none(t, n)
+        state = init_state(cfg, balanced_inputs(t, n), faults)
+        key = jax.random.key(cfg.seed)
+        r1, f1, w1 = run_consensus(cfg, state, faults, key)
+        r2, f2, w2 = run_consensus_sharded(cfg, state, faults, key,
+                                           make_mesh(1, 4))
+        assert int(r1) == int(r2)
+        np.testing.assert_array_equal(np.asarray(w1), np.asarray(w2))
+        np.testing.assert_array_equal(np.asarray(f1.x), np.asarray(f2.x))
+        # non-vacuous: some witnessed tally column must be non-zero
+        assert np.asarray(w1).max() > 0
+    finally:
+        sampling.EXACT_TABLE_MAX = old

@@ -197,11 +197,12 @@ def test_gating():
 
 
 def test_packed_k_field_overflow_rejected():
-    """ADVICE r4: max_rounds must fit the packed word's 27-bit k field
-    (pack_state stores k at bits 5..31; k reaches max_rounds + 1)."""
+    """ADVICE r4 (re-anchored on the PR 8 plane layout): max_rounds must
+    fit the PACK_LAYOUT k field's declared 26-plane cap (k reaches
+    max_rounds + 1)."""
     SimConfig(n_nodes=4, n_faulty=0, use_pallas_round=True,
               max_rounds=(1 << 26) - 2)          # largest legal value
-    with pytest.raises(ValueError, match="27 bits"):
+    with pytest.raises(ValueError, match="26 bit-planes"):
         SimConfig(n_nodes=4, n_faulty=0, use_pallas_round=True,
                   max_rounds=(1 << 26) - 1)
     SimConfig(n_nodes=4, n_faulty=0, max_rounds=1 << 26)  # unfused: fine

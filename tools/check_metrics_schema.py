@@ -205,6 +205,26 @@ def check_perf_manifest(manifest: dict,
         if rep["peak_bytes"] != peak:
             errors.append(f"{path}: peak_bytes {rep['peak_bytes']} != "
                           f"arg+out+temp-alias {peak}")
+    fvx = manifest.get("fused_vs_xla")
+    if fvx is not None:
+        # PR 8: the paired fused-vs-XLA block (null = not measured, a
+        # --regimes-subset capture) — schema plus the cross-field facts
+        # the regression gate's acceptance check relies on
+        before = len(errors)
+        _validate(fvx, schema["fused_vs_xla_block"], "$.fused_vs_xla",
+                  errors)
+        if len(errors) == before:
+            ratio = (fvx["unpacked_round_bytes_per_node"]
+                     / fvx["packed_round_bytes_per_node"])
+            if abs(fvx["packed_traffic_ratio"] - ratio) > 0.01:
+                errors.append(
+                    f"$.fused_vs_xla: packed_traffic_ratio "
+                    f"{fvx['packed_traffic_ratio']} != unpacked/packed "
+                    f"bytes {ratio:.4f}")
+            if not fvx["bit_equal"]:
+                errors.append(
+                    "$.fused_vs_xla: bit_equal is false — the fused and "
+                    "XLA legs diverged; the timing pair is meaningless")
     return errors
 
 

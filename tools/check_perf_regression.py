@@ -95,15 +95,32 @@ def main(argv=None) -> int:
     baseline_mod = _load_baseline_module()
     rc = 0
 
+    try:
+        manifest = _load_json(args.manifest)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"unreadable manifest: {e}", file=sys.stderr)
+        return 3
+
+    # the PR-8 acceptance pin — baseline-independent, it gates the
+    # manifest's own fused_vs_xla block: fused must beat XLA on a real
+    # backend; interpret-mode (CPU) captures gate the layout-derived
+    # packed_traffic_ratio >= 4x instead (emulator ratios are excluded)
+    fvx_findings = baseline_mod.check_fused_vs_xla(manifest)
+    for f in fvx_findings:
+        print(f)
+    if any(f.startswith("REGRESSION") for f in fvx_findings):
+        rc = 2
+
     if not os.path.exists(args.baseline):
         print(f"no baseline at {args.baseline} — nothing to gate against"
               f" (run `python -m benor_tpu profile --update-baseline`)",
               file=sys.stderr)
         if args.strict:
-            return 3
+            # a regression the fused_vs_xla gate already detected must
+            # not be downgraded to "incomparable" by the missing baseline
+            return rc or 3
     else:
         try:
-            manifest = _load_json(args.manifest)
             base = _load_json(args.baseline)
         except (OSError, json.JSONDecodeError) as e:
             print(f"unreadable input: {e}", file=sys.stderr)
@@ -136,6 +153,17 @@ def main(argv=None) -> int:
         else:
             print(f"trajectory: no same-platform collapse across "
                   f"{len(paths)} records")
+        # pallas kernel-ratio walk: interpret-mode records (CPU pallas
+        # emulation) are labeled and EXCLUDED — their ratios price the
+        # interpreter, not the kernels (baseline.py explains)
+        pfindings = baseline_mod.check_pallas_speedup_trajectory(paths)
+        for f in pfindings:
+            print(f)
+        if any(f.startswith("REGRESSION") for f in pfindings):
+            rc = max(rc, 2)
+        else:
+            print("pallas trajectory: no real-backend kernel-ratio "
+                  "collapse (interpret-mode records excluded)")
         # the multichip capture series rides the same flag: a missing or
         # zero scaling_efficiency on an ok record is the WORST collapse
         # (mirroring the node_rounds_per_sec=0.0 rule; see
