@@ -77,7 +77,7 @@ _DETAIL_KEYS = ("curve", "pallas_check", "pallas_hist_check",
                 "pallas_equiv_check", "pallas_weak_coin_check",
                 "pallas_round_check", "pallas_demoted",
                 "batched_sweep_check", "flight_recorder", "perfscope",
-                "meshscope", "lint")
+                "meshscope", "serve", "lint")
 
 
 def _split_headline(out: dict) -> tuple[dict, dict]:
@@ -133,6 +133,12 @@ def _split_headline(out: dict) -> tuple[dict, dict]:
         # trip + in-band vs SCALING_BASELINE.json when comparable; the
         # manifest itself lives in the sidecar's meshscope blob
         head["scaling_ok"] = bool(ms.get("ok"))
+    sv = out.get("serve")
+    if isinstance(sv, dict):
+        # ONE compact bool: serve load test schema-valid + zero client
+        # errors + coalescing ratio > 1 + in-band vs SERVE_BASELINE.json
+        # when comparable; the manifest lives in the sidecar's serve blob
+        head["serve_ok"] = bool(sv.get("ok"))
     head["detail_file"] = "BENCH_DETAIL.json"
     return head, detail
 
@@ -1066,6 +1072,17 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
     log(f"bench: meshscope check ok={meshscope_check.get('ok')} "
         f"straggler_max={meshscope_check.get('straggler_max')} "
         f"baseline_comparable={meshscope_check.get('baseline_comparable')}")
+    try:
+        serve_check = _serve_check()
+    except Exception as e:  # noqa: BLE001 — accounting must not kill the run
+        serve_check = {"ok": False,
+                       "error": f"{type(e).__name__}: {e}"}
+    m = serve_check.get("manifest", {})
+    log(f"bench: serve check ok={serve_check.get('ok')} "
+        f"clients={m.get('clients')} "
+        f"jobs_per_launch={m.get('jobs_per_launch')} "
+        f"p99_ms={(m.get('latency_ms') or {}).get('p99')} "
+        f"baseline_comparable={serve_check.get('baseline_comparable')}")
 
     total_trials = trials * len(regimes)
     log(f"bench: sweep elapsed {elapsed:.2f}s for {total_trials} trials; "
@@ -1120,6 +1137,7 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
         "flight_recorder": recorder_check,
         "perfscope": perfscope_check,
         "meshscope": meshscope_check,
+        "serve": serve_check,
         "pallas_demoted": demoted,
     }
 
@@ -1327,6 +1345,58 @@ def _meshscope_check() -> dict:
     blob["baseline_comparable"] = comparable
     blob["regressions"] = regressions
     blob["ok"] = (not schema_errors and straggler_max < STRAGGLER_TRIP
+                  and not regressions)
+    return blob
+
+
+def _serve_check() -> dict:
+    """The serving acceptance (benor_tpu/serve): drive the load
+    generator's concurrent SSE clients against an in-process request
+    plane — BENCH_SERVE_CLIENTS concurrent clients, default 1000, the
+    acceptance scale — emit the pinned-schema serve manifest into the
+    sidecar blob, and reduce it to the ``serve_ok`` headline bool:
+    manifest schema-valid (tools/serve_manifest_schema.json, loaded by
+    file path), zero client errors, jobs-per-launch coalescing ratio
+    above 1 (the number serving exists to produce), and in-band vs the
+    committed SERVE_BASELINE.json when comparable (a smaller smoke run
+    vs the 1000-client baseline is honestly reported incomparable, not
+    silently passed)."""
+    import importlib.util
+
+    from benor_tpu.serve import IncomparableServe, compare_serve, run_load
+
+    clients = int(os.environ.get("BENCH_SERVE_CLIENTS", 1000))
+    manifest = run_load(clients=clients)
+    spec = importlib.util.spec_from_file_location(
+        "_check_metrics_schema",
+        os.path.join(HERE, "tools", "check_metrics_schema.py"))
+    cms = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cms)
+    schema_errors = cms.check_serve_manifest(manifest)
+    blob = {
+        "manifest": manifest,
+        "schema_errors": schema_errors,
+        "clients": clients,
+    }
+    regressions = []
+    comparable = None
+    baseline_path = os.path.join(HERE, "SERVE_BASELINE.json")
+    if os.path.exists(baseline_path):
+        try:
+            with open(baseline_path) as fh:
+                base = json.load(fh)
+            regressions = [f.to_dict()
+                           for f in compare_serve(manifest, base)]
+            comparable = True
+        except (IncomparableServe, ValueError) as e:
+            comparable = False
+            blob["baseline_note"] = f"{e}"
+    else:
+        blob["baseline_note"] = "no committed SERVE_BASELINE.json"
+    blob["baseline_comparable"] = comparable
+    blob["regressions"] = regressions
+    blob["ok"] = (not schema_errors and manifest["errors"] == 0
+                  and manifest["jobs_per_launch"] > 1.0
                   and not regressions)
     return blob
 

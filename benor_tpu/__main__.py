@@ -29,6 +29,22 @@ Subcommands:
                                                      decided fraction,
                                                      ETA); no backend
                                                      touched
+  serve  [--port 8400] [--max-batch-jobs 32]         the async multi-
+                                                     tenant request
+                                                     plane (benor_tpu/
+                                                     serve): HTTP+SSE
+                                                     job API over the
+                                                     warm batched
+                                                     executor pool
+  load   [--clients 1000] [--url http://...]         drive concurrent
+         [--profile-out serve.json]                  SSE clients against
+                                                     the serve plane ->
+                                                     pinned-schema serve
+                                                     manifest + baseline
+                                                     gate (SERVE_
+                                                     BASELINE.json);
+                                                     exit 2 on
+                                                     regression
   preset NAME                                        a BASELINE.json config
   lint   [--format json|text] [--root DIR]           benorlint static
                                                      analysis over the
@@ -629,6 +645,83 @@ def _scale(args) -> int:
     return 0
 
 
+def _serve(args) -> int:
+    """The benor-serve request plane (benor_tpu/serve/server.py): accept
+    concurrent simulate/sweep/trajectory/audit jobs over HTTP, coalesce
+    them into continuous batches on the warm AOT executor pool, stream
+    round-history/witness rows back as server-sent events.  Runs until
+    interrupted."""
+    from .serve import run_server
+    return run_server(host=args.host, port=args.port,
+                      max_batch_jobs=args.max_batch_jobs)
+
+
+def _load(args) -> int:
+    """Load-test the serve plane (benor_tpu/serve/loadgen.py): drive
+    --clients concurrent SSE clients (against --url, or an in-process
+    server when omitted), print the pinned-schema serve manifest
+    (p50/p99 latency, saturation throughput, jobs-per-launch
+    coalescing) and gate it against the committed SERVE_BASELINE.json
+    (serve/gate.py): exit 2 on a serving regression, 0 otherwise."""
+    from .serve import IncomparableServe, compare_serve, run_load
+
+    job = None
+    if args.job:
+        job = json.loads(args.job)
+    manifest = run_load(url=args.url, clients=args.clients, job=job,
+                        timeout=args.timeout, ramp_s=args.ramp,
+                        max_batch_jobs=args.max_batch_jobs)
+    fb = " [cpu fallback]" if FELL_BACK else ""
+    if args.format == "json":
+        print(json.dumps(manifest, indent=1))
+    else:
+        lat = manifest["latency_ms"]
+        print(f"benor-serve load: {manifest['platform']} "
+              f"({manifest['device_kind']}), {manifest['clients']} "
+              f"concurrent clients{fb}")
+        print(f"  jobs {manifest['jobs_completed']}"
+              f"/{manifest['jobs_submitted']} "
+              f"(errors {manifest['errors']}) in "
+              f"{manifest['duration_s']:.2f}s = "
+              f"{manifest['throughput_jobs_per_sec']:.1f} jobs/s")
+        print(f"  latency p50={lat['p50']:.0f}ms p99={lat['p99']:.0f}ms; "
+              f"coalescing {manifest['jobs_per_launch']:.1f} "
+              f"jobs/launch over {manifest['launches']} launches")
+    if args.profile_out:
+        with open(args.profile_out, "w") as fh:
+            json.dump(manifest, fh, indent=1)
+        print(f"wrote serve manifest to {args.profile_out}",
+              file=sys.stderr)
+    _export_metrics(args.metrics_out)
+
+    baseline_path = args.baseline or os.path.join(_repo_root(),
+                                                  "SERVE_BASELINE.json")
+    if args.update_baseline:
+        with open(baseline_path, "w") as fh:
+            json.dump(manifest, fh, indent=1)
+        print(f"re-baselined {baseline_path}", file=sys.stderr)
+        return 0
+    if not os.path.exists(baseline_path):
+        print(f"no baseline at {baseline_path} — capture-only run "
+              f"(--update-baseline to create one)", file=sys.stderr)
+        return 0
+    try:
+        with open(baseline_path) as fh:
+            base = json.load(fh)
+        findings = compare_serve(manifest, base,
+                                 timing_band=args.timing_band)
+    except (IncomparableServe, ValueError) as e:
+        print(f"baseline {baseline_path} not comparable: {e}",
+              file=sys.stderr)
+        return 0
+    for f in findings:
+        print(f"REGRESSION: {f.message}", file=sys.stderr)
+    if findings:
+        return 2
+    print(f"serve gate: in-band vs {baseline_path}", file=sys.stderr)
+    return 0
+
+
 def _watch(args) -> int:
     """Tail a running sweep's heartbeat file (meshscope's live progress
     plane): print each new heartbeat record — rounds/sec, decided
@@ -910,6 +1003,61 @@ def main(argv=None) -> int:
                          "instead of gating against it")
     _add_obs_args(sc, record=False)
 
+    sv = sub.add_parser("serve",
+                        help="the async multi-tenant request plane: "
+                             "HTTP+SSE job API coalescing concurrent "
+                             "client jobs onto the warm batched "
+                             "executor pool (benor_tpu/serve)")
+    sv.add_argument("--host", default="127.0.0.1")
+    sv.add_argument("--port", type=int, default=8400,
+                    help="listen port (default 8400; 0 = ephemeral)")
+    sv.add_argument("--max-batch-jobs", type=int, default=None,
+                    help="coalescing ceiling: jobs per executable "
+                         "launch (default serve.MAX_BATCH_JOBS, "
+                         "rounded up to a power of two)")
+
+    ld = sub.add_parser("load",
+                        help="load-test the serve plane: concurrent "
+                             "SSE clients -> pinned-schema serve "
+                             "manifest + baseline gate "
+                             "(SERVE_BASELINE.json); exit 2 on "
+                             "regression")
+    ld.add_argument("--clients", type=int, default=1000,
+                    help="concurrent clients (default 1000 — the "
+                         "acceptance scale)")
+    ld.add_argument("--url", default=None,
+                    help="target a running `benor_tpu serve` instance "
+                         "(default: spin an in-process server on an "
+                         "ephemeral port for the run)")
+    ld.add_argument("--job", default=None,
+                    help="JSON JobSpec each client submits (default: "
+                         "serve.loadgen.DEFAULT_JOB, a dyn-bucket "
+                         "simulate; clients get distinct seeds)")
+    ld.add_argument("--timeout", type=float, default=120.0,
+                    help="per-client completion deadline in seconds")
+    ld.add_argument("--ramp", type=float, default=0.0,
+                    help="spread connection setup across this many "
+                         "seconds (0 = thundering herd)")
+    ld.add_argument("--max-batch-jobs", type=int, default=None,
+                    help="coalescing ceiling of the in-process server "
+                         "(ignored with --url)")
+    ld.add_argument("--format", choices=("text", "json"), default="text",
+                    help="stdout format; json = the pinned-schema "
+                         "manifest (tools/serve_manifest_schema.json)")
+    ld.add_argument("--profile-out", metavar="PATH",
+                    help="write the serve manifest to this JSON file")
+    ld.add_argument("--baseline", metavar="PATH", default=None,
+                    help="baseline manifest to gate against (default: "
+                         "the committed SERVE_BASELINE.json)")
+    ld.add_argument("--update-baseline", action="store_true",
+                    help="write this capture as the new baseline "
+                         "instead of gating against it")
+    ld.add_argument("--timing-band", type=float, default=None,
+                    help="also gate the machine-sensitive throughput/"
+                         "p99 numbers at this ratio band (off by "
+                         "default; see serve/gate.py)")
+    _add_obs_args(ld, record=False)
+
     w = sub.add_parser("watch",
                        help="tail a running sweep's heartbeat file "
                             "(live rounds/sec, decided fraction, ETA); "
@@ -945,8 +1093,8 @@ def main(argv=None) -> int:
     # bare `python -m benor_tpu [-n N -f F ...]` == the start.ts demo
     if not argv or argv[0] not in ("demo", "sweep", "coins", "preset",
                                    "results", "trace", "audit", "lint",
-                                   "profile", "scale", "watch",
-                                   "-h", "--help"):
+                                   "profile", "scale", "watch", "serve",
+                                   "load", "-h", "--help"):
         argv = ["demo"] + argv
     args = ap.parse_args(argv)
     if args.cmd == "scale":
@@ -979,7 +1127,7 @@ def main(argv=None) -> int:
             "preset": _preset, "results": _results,
             "trace": _trace, "audit": _audit, "lint": _lint,
             "profile": _profile, "scale": _scale,
-            "watch": _watch}[args.cmd](args)
+            "watch": _watch, "serve": _serve, "load": _load}[args.cmd](args)
 
 
 if __name__ == "__main__":

@@ -132,7 +132,7 @@ class Project:
 @dataclasses.dataclass
 class Rule:
     name: str
-    family: str      # 'tracer' | 'layout' | 'config' | 'perf'
+    family: str      # 'tracer' | 'layout' | 'config' | 'perf' | 'serve'
     doc: str
     check: Callable[["Project"], List[Finding]]
 
@@ -166,7 +166,7 @@ def run_rules(project: Project, names=None
     # rule modules register on import; import them here so a bare
     # ``from .core import run_rules`` is enough to get the full set
     from . import (rules_config, rules_layout, rules_perf,  # noqa: F401
-                   rules_tracer)
+                   rules_serve, rules_tracer)
 
     active: List[Finding] = list(project.errors)
     suppressed: Dict[str, int] = {}

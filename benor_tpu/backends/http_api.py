@@ -40,6 +40,12 @@ Semantics notes:
 This layer exists for wire-level interop (curl, the reference's own test
 utilities pointed at localhost) at demo-scale N; in-process code should use
 the Python facade (api.py) which serves the same dicts without sockets.
+Multi-tenant THROUGHPUT serving is deliberately not this layer's job: the
+port-per-node parity plane runs one network synchronously; concurrent
+client jobs belong on ``benor_tpu/serve`` (``python -m benor_tpu serve``),
+whose request plane coalesces them onto the warm batched executors and
+streams round history over SSE instead of /getState polling (README
+"Serving").
 """
 
 from __future__ import annotations
@@ -137,15 +143,27 @@ class _Handler(BaseHTTPRequestHandler):
                                                  else -1)
         self._send(200, {"rows": rows, "cursor": cursor}, as_json=True)
 
-    def _drain_best_effort(self, cap: int = 1 << 20) -> None:
+    #: Per-request drain budget in bytes (``NodeHttpCluster(drain_cap=...)``
+    #: overrides it cluster-wide): how much of an unknowable-length body
+    #: (chunked / malformed Content-Length) a handler will read before
+    #: replying and closing.  1 MiB default — enough that any real
+    #: client's in-flight bytes drain (avoiding the reply-discarding TCP
+    #: RST), small enough that a hostile endless body cannot hold a
+    #: handler thread.
+    drain_cap: int = 1 << 20
+
+    def _drain_best_effort(self, cap: Optional[int] = None) -> None:
         """Read whatever body bytes are ALREADY in flight before responding:
         replying and closing with unread data pending turns the close into a
         TCP RST that can discard the in-flight response.  Used when the body
         length is unknowable (chunked / malformed Content-Length).  Each
         read is gated on select() readability so a client that has finished
         sending and is awaiting the reply costs at most one 50 ms wait —
-        not a blocking read that stalls until timeout."""
+        not a blocking read that stalls until timeout.  ``cap`` defaults to
+        the class's ``drain_cap`` (a NodeHttpCluster constructor knob)."""
         import select
+        if cap is None:
+            cap = self.drain_cap
         try:
             drained = 0
             while drained < cap:
@@ -275,32 +293,86 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class NodeHttpCluster:
-    """N HTTP listeners (ports base..base+N-1) over one simulated network."""
+    """N HTTP listeners (ports base..base+N-1) over one simulated network.
+
+    Knobs:
+      * ``drain_cap`` — per-request byte budget for draining an
+        unknowable-length POST body before replying (the ``_Handler.
+        drain_cap`` class attribute, see ``_drain_best_effort``);
+        default 1 MiB.
+      * ``addr_retries`` / ``addr_retry_delay_s`` — when a node's port
+        ``base_port + node_id`` is taken (EADDRINUSE — a TIME_WAIT
+        straggler from a previous cluster, or an unrelated process),
+        binding is retried that many times with that delay, and a port
+        that STAYS taken parks the node instead of crashing the whole
+        cluster: the remaining N-1 listeners serve normally and the
+        parked ids are recorded in ``self.parked`` (a parked node is
+        observable via any sibling's /getState — the network itself is
+        whole; only its per-node wire endpoint is missing).  A FULLY
+        taken range still raises (zero listeners would silently hand
+        clients some foreign process's ports), and any other OSError
+        tears down cleanly and raises.
+    """
 
     def __init__(self, network, base_port: int = BASE_NODE_PORT,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", drain_cap: int = 1 << 20,
+                 addr_retries: int = 2,
+                 addr_retry_delay_s: float = 0.05):
+        import errno
+        import time as _time
+
         self.network = network
         self.base_port = base_port
         self.servers: List[ThreadingHTTPServer] = []
         self.threads: List[threading.Thread] = []
+        #: node ids whose port stayed EADDRINUSE after the retries —
+        #: parked, not fatal (see class docstring).
+        self.parked: List[int] = []
         start_lock = threading.Lock()
         n = network.cfg.n_nodes if hasattr(network, "cfg") else network.n
         try:
             for i in range(n):
                 handler = type(f"_Handler{i}", (_Handler,), {
                     "network": network, "node_id": i,
-                    "start_lock": start_lock})
-                srv = ThreadingHTTPServer((host, base_port + i), handler)
+                    "start_lock": start_lock, "drain_cap": drain_cap})
+                srv = None
+                for attempt in range(addr_retries + 1):
+                    try:
+                        srv = ThreadingHTTPServer((host, base_port + i),
+                                                  handler)
+                        break
+                    except OSError as e:
+                        if e.errno != errno.EADDRINUSE:
+                            raise
+                        if attempt < addr_retries:
+                            _time.sleep(addr_retry_delay_s)
+                if srv is None:
+                    self.parked.append(i)
+                    continue
                 t = threading.Thread(target=srv.serve_forever, daemon=True)
                 self.servers.append(srv)
                 self.threads.append(t)
         except OSError:
-            # e.g. EADDRINUSE on port base+k: release 0..k-1 before raising
+            # non-EADDRINUSE failure on port base+k: release the
+            # already-bound listeners before raising
             for srv in self.servers:
                 srv.server_close()
             self.servers.clear()
             self.threads.clear()
             raise
+        if n and not self.servers:
+            # EVERY port taken: almost certainly another cluster (or a
+            # whole foreign service) owns the range — a "cluster" with
+            # zero listeners would let clients talk to that stranger's
+            # ports and read valid-looking state from the WRONG network.
+            # Parking exists to survive one straggler, not to serve
+            # nothing; fail loudly instead.
+            self.parked.clear()
+            raise OSError(
+                f"all {n} ports in [{base_port}, {base_port + n}) are "
+                f"taken — another cluster on this base_port? (parking "
+                f"covers individual EADDRINUSE stragglers, not a fully "
+                f"occupied range)")
 
     def serve(self) -> "NodeHttpCluster":
         """Start the listener threads (idempotent: ``serve_network`` already

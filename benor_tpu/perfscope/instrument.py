@@ -56,6 +56,16 @@ JIT_REGISTRY = (
 #: time of each instrumented module.
 INSTRUMENTED: Dict[str, Any] = {}
 
+#: label -> the LAST AotArtifact ``aot_compile`` produced under that
+#: label.  This is how long-lived executor pools stay introspectable
+#: after the fact: the serve plane's warm bucket executables
+#: (serve/batcher.py, labels ``serve.bucket.<kind>.c<capacity>``)
+#: register here on build, so ``AOT_ARTIFACTS["..."].cost()`` answers
+#: "what does one coalesced launch cost" without re-lowering anything.
+#: Bounded by construction: one entry per distinct label, and labels
+#: are drawn from the same small vocabulary as the stage timers.
+AOT_ARTIFACTS: Dict[str, "AotArtifact"] = {}
+
 
 def instrumented_jit(fun=None, *, label: Optional[str] = None,
                      **jit_kwargs):
@@ -164,10 +174,12 @@ def aot_compile(fun, args, *, label: str, **jit_kwargs) -> AotArtifact:
     REGISTRY.timer(f"perfscope.{label}.lower").record(lower_s)
     REGISTRY.timer(f"perfscope.{label}.compile").record(compile_s)
     REGISTRY.counter("perfscope.aot_compiles").inc()
-    return AotArtifact(label=label, compiled=compiled,
-                       trace_lower_s=lower_s, compile_s=compile_s,
-                       backend_compiles=cc.count,
-                       backend_compile_s=cc.seconds)
+    art = AotArtifact(label=label, compiled=compiled,
+                      trace_lower_s=lower_s, compile_s=compile_s,
+                      backend_compiles=cc.count,
+                      backend_compile_s=cc.seconds)
+    AOT_ARTIFACTS[label] = art
+    return art
 
 
 def cost_of(fun, *args, label: str = "cost_of") -> dict:

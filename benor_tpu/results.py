@@ -747,6 +747,7 @@ def generate(out_dir: str = "RESULTS", n_large: int = 1_000_000,
         print("oracle parity: skipped (no g++)", flush=True)
 
     if presets:
+        from .serve.jobs import JobSpec
         for name, cfg in baseline_configs().items():
             if cfg.n_nodes > n_large:      # CPU smoke scaling
                 continue
@@ -754,7 +755,13 @@ def generate(out_dir: str = "RESULTS", n_large: int = 1_000_000,
             pt = run_point(cfg)
             print(f"  mean_k={pt.mean_k:.3f} decided={pt.decided_frac:.3f} "
                   f"{pt.trials_per_sec:.1f} trials/s", flush=True)
-            out[f"preset_{name}"] = pt.to_dict()
+            row = pt.to_dict()
+            # provenance through the request plane: the job document
+            # that replays this row via `POST /v1/jobs` on a running
+            # `python -m benor_tpu serve` — bit-equal by the serve
+            # plane's house rule (tests/test_serve.py)
+            row["serve_replay"] = JobSpec.from_config(cfg).to_dict()
+            out[f"preset_{name}"] = row
 
     with open(os.path.join(out_dir, "results.json"), "w") as fh:
         json.dump(out, fh, indent=1)

@@ -1,0 +1,42 @@
+"""benor-serve: async multi-tenant request plane over warm AOT executors.
+
+The "millions of users" leg of the north star (ROADMAP item 1): treat
+the batched sweep executables the way an inference server treats a
+model.  Five modules:
+
+  jobs.py     the reusable job API — JobSpec -> SimConfig -> bucket ->
+              batch slot -> result slice (the sweep/results entry-point
+              refactor; CLI, bench.py and the HTTP plane all consume it)
+  batcher.py  continuous trial-batching: bucket queues, the warm AOT
+              executor pool (seed-erased sweep buckets, capacity rungs,
+              donated buffers), zero steady-state compiles
+  server.py   the asyncio HTTP+SSE front door (ServeApp); streams
+              flight-recorder round rows and witness rows on the PR 6
+              since_round cursor plane instead of poll-until-done
+  loadgen.py  thousands of concurrent SSE clients -> the pinned-schema
+              ``kind: serve_manifest`` (p50/p99 latency, saturation
+              throughput, jobs-per-launch coalescing)
+  gate.py     STDLIB-ONLY manifest comparator behind
+              tools/check_serve_regression.py and the committed
+              SERVE_BASELINE.json (exit 0 in-band / 2 regression /
+              3 incomparable)
+
+Importing this package is cheap (no jax at import time); the device
+work begins at the first launch on the batcher thread.
+"""
+
+from .batcher import MAX_BATCH_JOBS, Batcher, Job, serve_bucket_key
+from .gate import (COALESCING_BAND, IncomparableServe, ServeFinding,
+                   compare_serve)
+from .jobs import (CONFIG_FIELDS, JOB_KINDS, JobError, JobSpec,
+                   job_inputs, result_dict)
+from .loadgen import DEFAULT_JOB, build_serve_manifest, run_load
+from .server import ServeApp, run_server
+
+__all__ = [
+    "MAX_BATCH_JOBS", "Batcher", "Job", "serve_bucket_key",
+    "COALESCING_BAND", "IncomparableServe", "ServeFinding",
+    "compare_serve", "CONFIG_FIELDS", "JOB_KINDS", "JobError", "JobSpec",
+    "job_inputs", "result_dict", "DEFAULT_JOB", "build_serve_manifest",
+    "run_load", "ServeApp", "run_server",
+]

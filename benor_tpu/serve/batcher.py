@@ -1,0 +1,550 @@
+"""Continuous trial-batching over a warm AOT executor pool.
+
+The serving insight (ROADMAP item 1): the batched sweep engine already
+compiles ONE donated executable per static-shape bucket and vmaps a
+batch of per-point (state, faults, dyn) triples through it — an
+inference server's "model" in all but name.  This module turns that
+executable into exactly that: a **warm executor pool** keyed by the
+job's serve bucket (``sweep.sweep_bucket_key`` with the seed erased —
+the seed is data, never a static; verified by rules_config's base-key
+contract) and a **continuous batcher** that coalesces concurrent client
+jobs into batch slots of the next launch.
+
+Shape discipline: one bucket = one static shape, so jobs that share a
+bucket stack along the leading axis into a ``[B, T, N]`` problem — B
+jobs x T trials each, i.e. one launch carries ``B*T`` trials of
+device work (the "continuous batches over the trial axis").  B is
+rounded up to the next power of two (capacity rungs 1, 2, 4, ...,
+``max_batch_jobs``) and padded by repeating the last job's inputs, so
+the pool holds at most log2(max_batch_jobs)+1 executables per bucket —
+after the warm-up launches, steady-state serving adds **zero** backend
+compiles (tests/test_serve.py pins it via utils/compile_counter).
+
+Bit-equality (the house rule): a job's batch slot runs
+``sim.run_consensus_traced`` with run_point's exact inputs —
+``serve/jobs.job_inputs`` — its own ``jax.random.key(seed)`` and its
+own DynParams lane, then summarizes through ``sweep._summarize_inline``
+and deserializes through ``sweep.point_from_raw``; every piece is the
+same code the batched sweep engine runs, whose bit-identity to the
+per-point oracle tests/test_batched_sweep.py already pins.
+Quorum-specialized configs (pallas kernels, exact tables, dense top-k
+masks — ``sweep.quorum_specialized``) cannot share a dynamic-F lane;
+they get capacity-1 executors (still warm across seeds: the seed rides
+in as a traced scalar), so their coalescing ratio is 1 and their
+results stay on the classic ``run_consensus`` dispatch, pallas fast
+path preserved.
+
+Buffer reuse: the stacked state stack is DONATED to every launch
+(``donate_argnums=(0,)``, the sweep engine's discipline), so the loop
+carry aliases the request buffers instead of doubling the footprint;
+the executor itself is reused across launches, which is where the
+dispatch amortization comes from (``serve.jobs_per_launch``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+import uuid
+import warnings
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..config import SimConfig
+from ..utils.metrics import REGISTRY
+from .jobs import JobError, JobSpec, job_inputs, result_dict
+
+#: Capacity ceiling of one launch (jobs per executable).  Power of two;
+#: the pool compiles at most log2(MAX_BATCH_JOBS)+1 capacity rungs per
+#: bucket.
+MAX_BATCH_JOBS = 32
+
+
+def serve_bucket_key(cfg: SimConfig):
+    """The executor-pool bucket of one job config: the sweep engine's
+    static-shape bucket token with the SEED erased — the seed only ever
+    feeds ``jax.random.key`` at the harness boundary (rules_config.py
+    documents that contract), so jobs that differ only in seed share
+    one warm executable and coalesce into one launch."""
+    from ..sweep import sweep_bucket_key
+    kind, c = sweep_bucket_key(cfg)
+    return (kind, c.replace(seed=0))
+
+
+class Job:
+    """One batch slot: spec + config + the event stream clients follow.
+
+    Events are (type, payload) tuples appended under the job lock;
+    async subscribers (the SSE route) register (loop, asyncio.Event)
+    waker pairs that ``publish`` fires thread-safely, host-side callers
+    block on ``wait``.  ``cancel`` frees the batch slot: a queued job
+    flips to 'cancelled' and the batcher skips it when forming the next
+    batch; an in-flight job finishes on device (the executable cannot
+    be interrupted) but its result is discarded unpublished.
+    """
+
+    _ids = itertools.count(1)
+
+    def __init__(self, spec: JobSpec, cfg: SimConfig):
+        self.spec = spec
+        self.cfg = cfg
+        self.id = f"j{next(self._ids):05d}-{uuid.uuid4().hex[:8]}"
+        self.bucket = serve_bucket_key(cfg)
+        self.state = "queued"     # queued|running|done|error|cancelled
+        self.result: Optional[dict] = None
+        self.error: Optional[dict] = None
+        self.events: List[Tuple[str, dict]] = []
+        self.submitted_t = time.perf_counter()
+        self.started_t: Optional[float] = None
+        self.done_t: Optional[float] = None
+        self.launch_jobs = 0          # batch size of the launch that ran it
+        self._lock = threading.Lock()
+        self._done = threading.Event()
+        self._waiters: List[tuple] = []   # (loop, asyncio.Event)
+
+    # -- event plane ------------------------------------------------------
+    def publish(self, etype: str, payload: dict) -> None:
+        with self._lock:
+            self.events.append((etype, payload))
+            waiters = list(self._waiters)
+        if etype in ("done", "error", "cancelled"):
+            self._done.set()
+        for loop, ev in waiters:
+            try:
+                loop.call_soon_threadsafe(ev.set)
+            except RuntimeError:
+                pass                      # subscriber's loop already closed
+
+    def add_waiter(self, loop, ev) -> None:
+        with self._lock:
+            self._waiters.append((loop, ev))
+
+    def drop_waiter(self, loop, ev) -> None:
+        with self._lock:
+            try:
+                self._waiters.remove((loop, ev))
+            except ValueError:
+                pass
+
+    @property
+    def done(self) -> bool:
+        return self.state in ("done", "error", "cancelled")
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Host-side completion barrier (loadgen's in-process mode and
+        the tests use it; the HTTP plane awaits the event stream)."""
+        return self._done.wait(timeout)
+
+    def cancel(self) -> bool:
+        """Free this job's batch slot (client went away).  True when the
+        job had not yet reached a launch; an in-flight/finished job
+        keeps its state but a disconnected client's result is simply
+        never published to anyone."""
+        with self._lock:
+            if self.state == "queued":
+                self.state = "cancelled"
+                freed = True
+            else:
+                freed = False
+        if freed:
+            self.publish("cancelled", {"job": self.id})
+            REGISTRY.counter("serve.jobs_cancelled").inc()
+        return freed
+
+
+class WarmExecutor:
+    """One compiled capacity rung of one bucket."""
+
+    def __init__(self, artifact, rep_cfg: SimConfig, capacity: int,
+                 kind: str):
+        self.artifact = artifact          # perfscope AotArtifact
+        self.rep_cfg = rep_cfg
+        self.capacity = capacity
+        self.kind = kind                  # 'dyn' | 'static'
+        self.launches = 0
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+class Batcher:
+    """The request queue + executor pool + launch loop.
+
+    ``submit`` validates and enqueues; the worker thread (or an explicit
+    ``step()`` from tests) pops the next non-empty bucket round-robin,
+    forms a batch of up to ``max_batch_jobs`` live jobs, launches the
+    bucket's warm executor at the matching capacity rung and publishes
+    each slot's stream + result.  Round-robin over buckets is the
+    no-starvation guarantee: a job whose bucket mismatches the batch
+    being formed never blocks it and is at most one launch away from
+    its own (tests/test_serve.py pins it).
+    """
+
+    def __init__(self, max_batch_jobs: int = MAX_BATCH_JOBS,
+                 limits: Optional[dict] = None, start: bool = True):
+        if max_batch_jobs < 1:
+            raise ValueError("max_batch_jobs must be >= 1")
+        self.max_batch_jobs = _next_pow2(max_batch_jobs)
+        self.limits = limits
+        self._queues: "OrderedDict[tuple, deque]" = OrderedDict()
+        self._rr: deque = deque()                 # bucket round-robin
+        self._pool: Dict[tuple, WarmExecutor] = {}
+        self._jobs: Dict[str, Job] = {}
+        self._cv = threading.Condition()
+        self._stop = False
+        self.launches = 0
+        self.jobs_completed = 0
+        self.jobs_submitted = 0
+        self.executor_compiles = 0
+        self._thread = None
+        if start:
+            self._thread = threading.Thread(target=self._run, daemon=True,
+                                            name="benor-serve-batcher")
+            self._thread.start()
+
+    # -- intake -----------------------------------------------------------
+    def submit_dict(self, doc) -> List[Job]:
+        """Wire document -> validated, enqueued jobs (sweep kind expands
+        to one job per f value).  Raises JobError — the structured 400."""
+        return self.submit(JobSpec.from_dict(doc, limits=self.limits))
+
+    def submit(self, spec: JobSpec) -> List[Job]:
+        jobs = []
+        for sub in spec.expand():
+            cfg = sub.to_config()         # JobError on invalid combos
+            jobs.append(Job(sub, cfg))
+        with self._cv:
+            for job in jobs:
+                self._jobs[job.id] = job
+                q = self._queues.get(job.bucket)
+                if q is None:
+                    q = deque()
+                    self._queues[job.bucket] = q
+                    self._rr.append(job.bucket)
+                q.append(job)
+                self.jobs_submitted += 1
+            depth = sum(len(q) for q in self._queues.values())
+            self._cv.notify_all()
+        REGISTRY.counter("serve.jobs_submitted").inc(len(jobs))
+        REGISTRY.gauge("serve.queue_depth").set(depth)
+        for job in jobs:
+            job.publish("queued", {"job": job.id,
+                                   "bucket": job.bucket[0]})
+        return jobs
+
+    def get(self, job_id: str) -> Optional[Job]:
+        with self._cv:
+            return self._jobs.get(job_id)
+
+    # -- launch loop ------------------------------------------------------
+    def _pop_batch(self, block: bool, timeout: Optional[float]):
+        """Next (bucket, jobs) round-robin, cancelled slots skipped."""
+        with self._cv:
+            while True:
+                for _ in range(len(self._rr)):
+                    key = self._rr[0]
+                    self._rr.rotate(-1)
+                    q = self._queues[key]
+                    jobs = []
+                    while q and len(jobs) < self.max_batch_jobs:
+                        job = q.popleft()
+                        if job.state == "queued":
+                            jobs.append(job)
+                    if not q:
+                        # drop the empty bucket from the rotation (the
+                        # executor pool keeps its warm executables)
+                        del self._queues[key]
+                        self._rr.remove(key)
+                    if jobs:
+                        return key, jobs
+                if not block or self._stop:
+                    return None, []
+                self._cv.wait(timeout)
+                if self._stop:
+                    return None, []
+
+    def step(self, block: bool = False,
+             timeout: Optional[float] = None) -> int:
+        """Process ONE batch (tests drive this synchronously; the worker
+        thread loops it).  Returns the number of jobs launched."""
+        key, popped = self._pop_batch(block, timeout)
+        if not popped:
+            return 0
+        # claim the slots under each job's lock: a client that cancelled
+        # between the queue pop and here keeps its 'cancelled' state (an
+        # unlocked state write would overwrite it and later publish the
+        # orphan result the cancel contract promises to discard)
+        jobs = []
+        for job in popped:
+            with job._lock:
+                if job.state != "queued":
+                    continue
+                job.state = "running"
+            jobs.append(job)
+        if not jobs:
+            return 0
+        try:
+            self._execute(key, jobs)
+        # benorlint: allow-broad-except — multi-tenant boundary: whatever
+        # killed this batch must reach ITS clients as error events (and
+        # re-raises for the caller); swallowing nothing, routing everything
+        except Exception as e:  # noqa: BLE001
+            for job in jobs:
+                if job.done:
+                    continue    # its result already published — keep it
+                job.state = "error"
+                job.error = {"error": f"{type(e).__name__}: {e}"}
+                job.done_t = time.perf_counter()
+                job.publish("error", job.error)
+            raise
+        return len(jobs)
+
+    def _run(self) -> None:
+        while not self._stop:
+            try:
+                self.step(block=True, timeout=0.5)
+            # benorlint: allow-broad-except — the failed batch's jobs
+            # already carry their error events (step's boundary); the
+            # worker loop must survive to serve every OTHER tenant
+            except Exception:  # noqa: BLE001
+                import traceback
+                traceback.print_exc()
+
+    def close(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # -- the launch itself ------------------------------------------------
+    def _capacity_for(self, key, n_jobs: int) -> int:
+        """The capacity rung a batch of ``n_jobs`` launches at: the
+        SMALLEST already-warm rung that fits, else the next power of
+        two.  Preferring a warm (larger, padded) executable over
+        compiling a tighter one is what keeps a partial tail batch —
+        or any ragged arrival pattern — at zero steady-state compiles:
+        once the top rung is warm, every batch reuses it."""
+        want = min(_next_pow2(n_jobs), self.max_batch_jobs)
+        warm = sorted(c for (k, c) in self._pool if k == key and c >= want)
+        return warm[0] if warm else want
+
+    def _executor(self, key, capacity: int, rep_cfg: SimConfig,
+                  args) -> WarmExecutor:
+        from ..perfscope.instrument import aot_compile
+
+        pool_key = (key, capacity)
+        ex = self._pool.get(pool_key)
+        if ex is not None:
+            return ex
+        kind = key[0]
+        runner = (_make_dyn_runner(rep_cfg, capacity) if kind == "dyn"
+                  else _make_static_runner(rep_cfg))
+        label = f"serve.bucket.{kind}.c{capacity}"
+        with warnings.catch_warnings():
+            # XLA:CPU has no donation support and warns the donated
+            # buffers went unused — the platform gap the sweep engine
+            # documents, not a serve bug
+            warnings.filterwarnings(
+                "ignore", message=".*donated buffers were not usable.*")
+            art = aot_compile(runner, args, label=label,
+                              donate_argnums=(0,))
+        ex = WarmExecutor(art, rep_cfg, capacity, kind)
+        with self._cv:
+            # the batcher thread is the only writer, but readers
+            # (the /v1/stats route on the event loop) snapshot under
+            # the same lock — an unlocked insert would let a dict grown
+            # mid-iteration 500 a stats request
+            self._pool[pool_key] = ex
+            self.executor_compiles += art.backend_compiles
+        REGISTRY.counter("serve.executor_builds").inc()
+        return ex
+
+    def _execute(self, key, jobs: List[Job]) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from ..state import DynParams, NetState, init_state
+        from ..sweep import _stack_tree
+
+        t_start = time.perf_counter()
+        for job in jobs:
+            # state already claimed as 'running' under the job lock in
+            # step() — this is the announcement, not the transition
+            job.started_t = t_start
+            job.publish("running", {"job": job.id, "batch": len(jobs)})
+        # host-side slot prep: run_point's exact inputs, per job
+        cfgs = [j.cfg for j in jobs]
+        prep = [job_inputs(c) for c in cfgs]
+        states = [init_state(c, iv, fl) for c, (iv, fl) in zip(cfgs, prep)]
+        faults = [fl for (_, fl) in prep]
+        kind = key[0]
+        if kind == "dyn":
+            capacity = self._capacity_for(key, len(jobs))
+            pad = capacity - len(jobs)
+            # pad slots repeat the last job's inputs; their result slices
+            # are computed and discarded (a fixed capacity rung is what
+            # keeps steady-state serving at zero new compiles)
+            states = states + [states[-1]] * pad
+            faults_p = faults + [faults[-1]] * pad
+            cfgs_p = cfgs + [cfgs[-1]] * pad
+            args = (_stack_tree(states), _stack_tree(faults_p),
+                    DynParams.stack(cfgs_p),
+                    jnp.asarray([c.seed for c in cfgs_p], jnp.int32))
+            ex = self._executor(key, capacity, cfgs[0], args)
+            with REGISTRY.timer("serve.launch").time():
+                *summ, _fin = ex.artifact.compiled(*args)
+                out = [np.asarray(o) for o in summ]     # fetch = barrier
+            del _fin
+            raws = [[o[i] for o in out] for i in range(len(jobs))]
+        else:
+            # quorum-specialized bucket (pallas kernels / exact tables /
+            # dense top-k masks): capacity-1 launches, warm across seeds
+            ex = None
+            raws = []
+            for job, st, fl, c in zip(jobs, states, faults, cfgs):
+                # donated state must not alias the undonated faults arg
+                # (init_state aliases killed to faults.faulty under the
+                # crash model — the sweep engine's exact workaround)
+                st = NetState(x=st.x, decided=st.decided, k=st.k,
+                              killed=jnp.array(st.killed))
+                args = (st, fl, jnp.int32(c.seed))
+                ex = self._executor(key, 1, c, args)
+                with REGISTRY.timer("serve.launch").time():
+                    *summ, _fin = ex.artifact.compiled(*args)
+                    raws.append([np.asarray(o) for o in summ])
+                del _fin
+                ex.launches += 1
+                self.launches += 1
+        if kind == "dyn":
+            ex.launches += 1
+            self.launches += 1
+        launch_s = time.perf_counter() - t_start
+        REGISTRY.counter("serve.launches").inc(
+            1 if kind == "dyn" else len(jobs))
+
+        # -- result slices, one per batch slot ----------------------------
+        from ..sweep import point_from_raw
+        for job, vals, fl in zip(jobs, raws, faults):
+            point = point_from_raw(job.cfg, vals, launch_s / len(jobs))
+            self._publish_result(job, point, fl, len(jobs))
+        self.jobs_completed += len(jobs)
+        done = self.jobs_completed
+        REGISTRY.counter("serve.jobs_completed").inc(len(jobs))
+        if self.launches:
+            REGISTRY.gauge("serve.jobs_per_launch").set(
+                done / self.launches)
+
+    def _publish_result(self, job: Job, point, faults,
+                        batch_jobs: int) -> None:
+        """Stream the observability rows, then the result — the SSE feed
+        a client receives instead of poll-until-done."""
+        if job.state == "cancelled":
+            return                        # disconnected client: discard
+        if point.round_history is not None:
+            from ..utils.metrics import round_history_rows
+            for row in round_history_rows(point.round_history):
+                job.publish("round", row)
+        audit_blob = None
+        if point.witness is not None:
+            from ..audit import audit_witness, witness_rows, WitnessBundle
+            from ..state import witness_node_ids
+            for row in witness_rows(point.witness,
+                                    job.cfg.witness_trials,
+                                    witness_node_ids(job.cfg)):
+                job.publish("witness", row)
+            bundle = WitnessBundle.from_run(job.cfg, point.witness,
+                                            faults=faults,
+                                            label=f"serve {job.id}")
+            report = audit_witness(bundle)
+            audit_blob = {"ok": report.ok,
+                          "violations": len(report.violations),
+                          "summary": report.summary()}
+            job.publish("audit", audit_blob)
+        res = result_dict(point, job.spec)
+        res["job"] = job.id
+        res["batch_jobs"] = batch_jobs
+        if audit_blob is not None:
+            res["audit"] = audit_blob
+        job.result = res
+        job.launch_jobs = batch_jobs
+        job.state = "done"
+        job.done_t = time.perf_counter()
+        job.publish("result", res)
+        job.publish("done", {"job": job.id})
+
+    # -- stats ------------------------------------------------------------
+    def executors_snapshot(self):
+        """A consistent [(pool_key, WarmExecutor)] snapshot for readers
+        on other threads (the stats route) — taken under the queue lock
+        the pool's writer holds during inserts."""
+        with self._cv:
+            return list(self._pool.items())
+
+    def stats(self) -> dict:
+        with self._cv:
+            depth = sum(len(q) for q in self._queues.values())
+            return {
+                "jobs_submitted": self.jobs_submitted,
+                "jobs_completed": self.jobs_completed,
+                "queue_depth": depth,
+                "launches": self.launches,
+                "jobs_per_launch": (self.jobs_completed / self.launches
+                                    if self.launches else 0.0),
+                "executors": len(self._pool),
+                "executor_compiles": self.executor_compiles,
+                "buckets_live": len(self._queues),
+                "max_batch_jobs": self.max_batch_jobs,
+            }
+
+
+# --------------------------------------------------------------------------
+# Bucket runners — the same compiled bodies the batched sweep engine
+# builds, reshaped around the job axis
+# --------------------------------------------------------------------------
+
+
+def _make_dyn_runner(cfg: SimConfig, capacity: int):
+    """[B]-vmapped dynamic-F runner: each batch slot runs its own
+    (state, faults, dyn, seed) lane through ``run_consensus_traced`` +
+    ``_summarize_inline`` — the sweep engine's bucket executable with
+    the per-point base_key generalized to a traced per-slot seed."""
+    import jax
+
+    from ..sim import run_consensus_traced
+    from ..sweep import _summarize_inline
+
+    def runner(states, faults, dyn, seeds):
+        def one(s, fl, d, seed):
+            bk = jax.random.key(seed)
+            out = run_consensus_traced(cfg, s, fl, bk, d)
+            r, fin = out[0], out[1]
+            summ = _summarize_inline(cfg, r, fin, fl)
+            return summ + tuple(out[2:]) + (fin,)
+        return jax.vmap(one)(states, faults, dyn, seeds)
+    return runner
+
+
+def _make_static_runner(cfg: SimConfig):
+    """Capacity-1 runner for quorum-specialized buckets: the classic
+    ``run_consensus`` dispatch (pallas fast path preserved), seed traced
+    so one executable stays warm across clients."""
+    import jax
+
+    from ..sim import run_consensus
+    from ..sweep import _summarize_inline
+
+    def runner(state, faults, seed):
+        bk = jax.random.key(seed)
+        out = run_consensus(cfg, state, faults, bk)
+        r, fin = out[0], out[1]
+        summ = _summarize_inline(cfg, r, fin, faults)
+        return summ + tuple(out[2:]) + (fin,)
+    return runner

@@ -1,0 +1,231 @@
+"""The reusable job API: JobSpec -> bucket -> batch slot -> result slice.
+
+This module is the refactor ROADMAP item 1 asks for: the sweep/results
+entry points (``sweep.run_point`` / ``run_curve_batched``) split into a
+job-shaped API that the HTTP request plane (serve/server.py), the load
+generator (serve/loadgen.py), the CLI (``python -m benor_tpu serve`` /
+``load``) and bench.py's serve check all consume.  A ``JobSpec`` is the
+wire-level description of one client request; validation turns it into a
+``SimConfig`` plus the run_point-default inputs (per-trial random bits
+seeded by the job's seed, first-F-lanes crash-faulty via
+``sweep.default_crash_faults``) so that a job submitted through the
+serve plane is BIT-IDENTICAL to the same config run through
+``sweep.run_point`` directly — the house rule tests/test_serve.py pins.
+
+Job kinds (the four client verbs of the request plane):
+
+  simulate    one MC batch -> its on-device summary (a SweepPoint dict)
+  sweep       a rounds-vs-f curve; expands into one simulate job per f
+              value (each point is its own batch slot, so points from
+              one client coalesce with other clients' points)
+  trajectory  simulate with the flight recorder armed: the per-round
+              history rows stream back as server-sent events on the
+              ``since_round`` cursor plane (PR 6) instead of
+              poll-until-done
+  audit       simulate with the witness recorder armed at the
+              audit.default_witness_overrides watch set; the Ben-Or
+              invariants are machine-checked host-side
+              (audit.audit_witness) and the verdict rides the result
+
+Validation errors raise ``JobError`` carrying a structured body — the
+server answers them as 400 with that body verbatim, so a client can
+machine-read WHICH field was rejected and why.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..config import SimConfig
+
+#: JobSpec fields forwarded to SimConfig verbatim (everything else is
+#: job-plane metadata).  A pure literal so the README's "what can a job
+#: carry" table and the server's rejection messages cannot drift.
+CONFIG_FIELDS = ("n_nodes", "n_faulty", "trials", "max_rounds", "rule",
+                 "seed", "coin_mode", "coin_eps", "delivery", "scheduler",
+                 "adversary_strength", "fault_model", "path")
+
+#: The four client verbs.
+JOB_KINDS = ("simulate", "sweep", "trajectory", "audit")
+
+#: Per-job ceilings for the DEMO-scale request plane: one over-sized job
+#: would occupy a whole static-shape bucket and starve the coalescing
+#: that makes serving pay (README Serving's cost model).  Operators
+#: running a private instance can lift them via ServeApp(limits=...).
+DEFAULT_LIMITS = {"n_nodes": 1 << 16, "trials": 1 << 12,
+                  "max_rounds": 1 << 10, "f_values": 64}
+
+
+class JobError(ValueError):
+    """A rejected JobSpec: ``body`` is the structured 400 payload."""
+
+    def __init__(self, field: str, reason: str):
+        super().__init__(f"{field}: {reason}")
+        self.body = {"error": "invalid job", "field": field,
+                     "reason": reason}
+
+
+@dataclasses.dataclass(frozen=True)
+class JobSpec:
+    """One client job, as validated from the wire (``from_dict``)."""
+
+    kind: str = "simulate"
+    n_nodes: int = 64
+    n_faulty: int = 0
+    trials: int = 8
+    max_rounds: int = 32
+    rule: str = "reference"
+    seed: int = 0
+    coin_mode: str = "private"
+    coin_eps: float = 0.0
+    delivery: str = "all"
+    scheduler: str = "uniform"
+    adversary_strength: float = 0.0
+    fault_model: str = "crash"
+    path: str = "auto"
+    #: sweep kind only: the curve's f grid (expands to per-point jobs).
+    f_values: Optional[Tuple[int, ...]] = None
+
+    @classmethod
+    def from_dict(cls, doc: Any,
+                  limits: Optional[Dict[str, int]] = None) -> "JobSpec":
+        """Validate a wire document -> JobSpec, raising JobError (the
+        structured 400) on anything malformed rather than letting a bad
+        value poison the batch plane downstream."""
+        # an operator's limits dict MERGES over the defaults: a partial
+        # override ({"n_nodes": 1 << 20}) lifts one cap without
+        # KeyErroring every submit on the ones it didn't mention
+        limits = {**DEFAULT_LIMITS, **(limits or {})}
+        if not isinstance(doc, dict):
+            raise JobError("$", "job body must be a JSON object")
+        unknown = sorted(set(doc) - set(CONFIG_FIELDS)
+                         - {"kind", "f_values"})
+        if unknown:
+            raise JobError(unknown[0],
+                           f"unknown field (accepted: kind, f_values, "
+                           f"{', '.join(CONFIG_FIELDS)})")
+        kind = doc.get("kind", "simulate")
+        if kind not in JOB_KINDS:
+            raise JobError("kind", f"must be one of {list(JOB_KINDS)}")
+        kw: Dict[str, Any] = {"kind": kind}
+        defaults = cls()
+        for f in CONFIG_FIELDS:
+            if f not in doc:
+                continue
+            v = doc[f]
+            want = type(getattr(defaults, f))
+            if want is float and isinstance(v, int) \
+                    and not isinstance(v, bool):
+                v = float(v)
+            if not isinstance(v, want) or isinstance(v, bool):
+                raise JobError(f, f"must be {want.__name__}, got "
+                                  f"{type(v).__name__}")
+            kw[f] = v
+        fv = doc.get("f_values")
+        if kind == "sweep":
+            if not isinstance(fv, list) or not fv or not all(
+                    isinstance(x, int) and not isinstance(x, bool)
+                    for x in fv):
+                raise JobError("f_values", "sweep jobs need a non-empty "
+                                           "list of integer fault counts")
+            if len(fv) > limits["f_values"]:
+                raise JobError("f_values",
+                               f"at most {limits['f_values']} points "
+                               f"per sweep job")
+            kw["f_values"] = tuple(int(x) for x in fv)
+        elif fv is not None:
+            raise JobError("f_values", f"only sweep jobs take an f grid "
+                                       f"(kind={kind!r})")
+        for f in ("n_nodes", "trials", "max_rounds"):
+            v = kw.get(f, getattr(defaults, f))
+            if v < 1:
+                raise JobError(f, "must be >= 1")
+            if v > limits[f]:
+                raise JobError(f, f"demo-scale request plane caps {f} at "
+                                  f"{limits[f]} (see README Serving)")
+        if kw.get("seed", 0) < 0:
+            # run_point's input stream (np.random.default_rng) rejects
+            # negative seeds — surface it at validation, not in a batch
+            raise JobError("seed", "must be >= 0")
+        spec = cls(**kw)
+        spec.to_config()        # surface SimConfig's own rejections as 400s
+        return spec
+
+    @classmethod
+    def from_config(cls, cfg: SimConfig,
+                    kind: str = "simulate") -> "JobSpec":
+        """The serve-plane job document that replays ``cfg`` through the
+        request plane with run_point's default inputs — the provenance
+        hook results.py attaches to its study rows (``serve_replay``).
+        Only the wire-representable fields travel (CONFIG_FIELDS);
+        observability flags are the KIND's business (trajectory/audit),
+        so a record/witness-armed config maps to the matching kind."""
+        if cfg.witness:
+            kind = "audit"
+        elif cfg.record:
+            kind = "trajectory"
+        return cls(kind=kind,
+                   **{f: getattr(cfg, f) for f in CONFIG_FIELDS})
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {f: getattr(self, f) for f in CONFIG_FIELDS}
+        d["kind"] = self.kind
+        if self.f_values is not None:
+            d["f_values"] = list(self.f_values)
+        return d
+
+    def to_config(self) -> SimConfig:
+        """The SimConfig this job runs — observability flags derived from
+        the kind (trajectory arms the flight recorder, audit the witness
+        plane), everything else forwarded verbatim.  SimConfig's own
+        validation errors re-raise as structured JobErrors."""
+        kw = {f: getattr(self, f) for f in CONFIG_FIELDS}
+        if self.kind == "trajectory":
+            kw["record"] = True
+        elif self.kind == "audit":
+            from ..audit import default_witness_overrides
+            kw.update(default_witness_overrides(self.trials, self.n_nodes))
+        try:
+            return SimConfig(**kw)
+        except ValueError as e:
+            raise JobError("config", str(e)) from e
+
+    def expand(self) -> List["JobSpec"]:
+        """The batch-slot decomposition: a sweep job becomes one
+        simulate job per f value (each point coalesces independently);
+        every other kind is already one slot."""
+        if self.kind != "sweep":
+            return [self]
+        return [dataclasses.replace(self, kind="simulate",
+                                    n_faulty=int(f), f_values=None)
+                for f in self.f_values]
+
+
+def job_inputs(cfg: SimConfig):
+    """(initial_values, faults) for one job — EXACTLY run_point's
+    defaults (per-trial random bits from the job seed, first-F-faulty
+    crash mask), shared with the oracle path so serve-vs-direct
+    bit-equality is structural, not coincidental."""
+    from ..sweep import default_crash_faults, random_inputs
+    return (random_inputs(cfg.seed, cfg.trials, cfg.n_nodes),
+            default_crash_faults(cfg))
+
+
+def result_dict(point, spec: JobSpec) -> Dict[str, Any]:
+    """A SweepPoint -> the JSON result payload a client receives.  The
+    big per-round arrays are NOT embedded (trajectory/audit stream them
+    as SSE rows); the summary matches SweepPoint.to_dict's fields."""
+    out = {
+        "kind": spec.kind,
+        "n_nodes": point.n_nodes, "n_faulty": point.n_faulty,
+        "trials": point.trials, "coin_mode": point.coin_mode,
+        "scheduler": point.scheduler,
+        "rounds_executed": point.rounds_executed,
+        "decided_frac": point.decided_frac, "mean_k": point.mean_k,
+        "ones_frac": point.ones_frac,
+        "disagree_frac": point.disagree_frac,
+        "k_hist": point.k_hist.tolist(),
+        "seconds": point.seconds,
+    }
+    return out

@@ -1,0 +1,248 @@
+"""Load generator: thousands of concurrent SSE clients against the
+serve plane, reduced to the pinned-schema ``kind: serve_manifest``.
+
+Each simulated client is one asyncio task holding ONE real TCP
+connection: it POSTs its JobSpec with ``?stream=sse`` and reads the
+server-sent event stream until the ``done`` event, timing
+submit-to-result latency end to end (connection setup included — that
+is what a client experiences).  Clients get distinct seeds, so the
+coalescing they exhibit is the serve plane's own (the seed-erased
+bucket key), not an artifact of identical requests.
+
+The manifest records what the acceptance gate needs: client count,
+p50/p99/mean/max latency, saturation throughput (completed jobs over
+the measurement wall-clock), and the **coalescing efficiency** —
+jobs per executable launch, read from the server's /v1/stats delta —
+plus the scale block that makes two manifests comparable.
+``tools/check_serve_regression.py`` bands it against the committed
+SERVE_BASELINE.json (serve/gate.py owns the rules; stdlib-only so CI
+gates without a backend).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..utils.metrics import REGISTRY
+
+#: The default per-client job: a dyn-bucket config (delivery='all',
+#: crash faults, uniform scheduler — no quorum-specialized shapes), so
+#: concurrent clients coalesce into shared launches.  Small enough that
+#: dispatch, not device math, dominates — the regime a request plane is
+#: actually measured by.
+DEFAULT_JOB = {"kind": "simulate", "n_nodes": 32, "n_faulty": 4,
+               "trials": 8, "max_rounds": 16, "delivery": "all"}
+
+#: Manifest schema version (tools/serve_manifest_schema.json).
+SCHEMA_VERSION = 1
+
+
+def _raise_fd_limit(need: int) -> None:
+    """Best-effort RLIMIT_NOFILE bump: N concurrent clients cost ~2N
+    descriptors (client + server side of each socket)."""
+    try:
+        import resource
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        want = min(hard, max(soft, need))
+        if want > soft:
+            resource.setrlimit(resource.RLIMIT_NOFILE, (want, hard))
+    except (ImportError, ValueError, OSError):
+        pass
+
+
+async def _client(host: str, port: int, body: bytes,
+                  timeout: float) -> Dict:
+    """One client: POST + SSE read to completion -> {latency_s, ok}."""
+    t0 = time.perf_counter()
+    try:
+        reader, writer = await asyncio.open_connection(host, port)
+    except OSError as e:
+        return {"ok": False, "error": f"connect: {e}",
+                "latency_s": time.perf_counter() - t0}
+    ok, err = False, None
+    try:
+        writer.write(
+            b"POST /v1/jobs?stream=sse HTTP/1.1\r\n"
+            b"Host: benor-serve\r\nContent-Type: application/json\r\n"
+            + f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
+        await writer.drain()
+        status = await asyncio.wait_for(reader.readline(), timeout)
+        if b" 200 " not in status:
+            err = f"status {status.decode('latin1').strip()!r}"
+            rest = await asyncio.wait_for(reader.read(2048), timeout)
+            sep = b"\r\n\r\n"
+            if sep in rest:
+                body_txt = rest.split(sep, 1)[1].decode()[:200]
+                err += f": {body_txt}"
+        else:
+            deadline = time.perf_counter() + timeout
+            while True:
+                line = await asyncio.wait_for(
+                    reader.readline(),
+                    max(0.05, deadline - time.perf_counter()))
+                if not line:
+                    err = "connection closed before done event"
+                    break
+                if line.startswith(b"event: done"):
+                    ok = True
+                    break
+                if line.startswith(b"event: error"):
+                    err = "server error event"
+                    break
+    except (asyncio.TimeoutError, ConnectionError,
+            asyncio.IncompleteReadError) as e:
+        err = f"{type(e).__name__}: {e}"
+    finally:
+        try:
+            writer.close()
+        except ConnectionError:
+            pass
+    lat = time.perf_counter() - t0
+    REGISTRY.timer("serve.client_latency").record(lat)
+    return {"ok": ok, "error": err, "latency_s": lat}
+
+
+async def _get_json(host: str, port: int, path: str,
+                    timeout: float = 10.0) -> dict:
+    reader, writer = await asyncio.open_connection(host, port)
+    try:
+        writer.write(f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+        await writer.drain()
+        # read to EOF (the server sends Connection: close): a single
+        # read() returns on the FIRST chunk and a segmented response
+        # would hand json.loads a truncated body
+        raw = b""
+        deadline = time.perf_counter() + timeout
+        while True:
+            chunk = await asyncio.wait_for(
+                reader.read(1 << 16),
+                max(0.05, deadline - time.perf_counter()))
+            if not chunk:
+                break
+            raw += chunk
+    finally:
+        writer.close()
+    return json.loads(raw.split(b"\r\n\r\n", 1)[1])
+
+
+async def _drive(host: str, port: int, clients: int, job: Dict,
+                 timeout: float, ramp_s: float) -> Dict:
+    stats0 = await _get_json(host, port, "/v1/stats")
+    bodies = []
+    for i in range(clients):
+        doc = dict(job)
+        doc["seed"] = int(doc.get("seed", 0)) + i
+        bodies.append(json.dumps(doc).encode())
+    t0 = time.perf_counter()
+
+    async def one(i):
+        if ramp_s:
+            # spread connection setup across the ramp so the OS accept
+            # queue isn't the thing measured; steady-state concurrency
+            # is still `clients` (every client stays connected through
+            # its SSE stream)
+            await asyncio.sleep(ramp_s * i / max(1, clients))
+        return await _client(host, port, bodies[i], timeout)
+
+    results = await asyncio.gather(*(one(i) for i in range(clients)))
+    wall = time.perf_counter() - t0
+    stats1 = await _get_json(host, port, "/v1/stats")
+    return {"results": results, "wall_s": wall,
+            "stats0": stats0, "stats1": stats1}
+
+
+def build_serve_manifest(drive: Dict, clients: int, job: Dict) -> Dict:
+    """Reduce one load run to the pinned-schema manifest document."""
+    import jax
+
+    results = drive["results"]
+    lats_ms = np.asarray([r["latency_s"] for r in results]) * 1e3
+    ok = [r for r in results if r["ok"]]
+    errors = len(results) - len(ok)
+    s0, s1 = drive["stats0"], drive["stats1"]
+    jobs_completed = s1["jobs_completed"] - s0["jobs_completed"]
+    jobs_submitted = s1["jobs_submitted"] - s0["jobs_submitted"]
+    launches = s1["launches"] - s0["launches"]
+    dev = jax.devices()[0]
+    scale = {k: job.get(k, DEFAULT_JOB.get(k)) for k in
+             ("n_nodes", "n_faulty", "trials", "max_rounds", "delivery")}
+    scale["kind"] = job.get("kind", "simulate")
+    return {
+        "kind": "serve_manifest",
+        "schema_version": SCHEMA_VERSION,
+        "platform": jax.default_backend(),
+        "device_kind": getattr(dev, "device_kind", str(dev)),
+        "clients": clients,
+        "jobs_submitted": jobs_submitted,
+        "jobs_completed": jobs_completed,
+        "errors": errors,
+        "duration_s": round(drive["wall_s"], 4),
+        "latency_ms": {
+            "p50": round(float(np.percentile(lats_ms, 50)), 3),
+            "p99": round(float(np.percentile(lats_ms, 99)), 3),
+            "mean": round(float(lats_ms.mean()), 3),
+            "max": round(float(lats_ms.max()), 3),
+        },
+        "throughput_jobs_per_sec": round(
+            jobs_completed / drive["wall_s"], 3) if drive["wall_s"] else 0.0,
+        "launches": launches,
+        "jobs_per_launch": round(jobs_completed / launches, 4)
+        if launches else 0.0,
+        "executor_compiles": s1["executor_compiles"],
+        "scale": scale,
+    }
+
+
+def run_load(url: Optional[str] = None, clients: int = 1000,
+             job: Optional[Dict] = None, timeout: float = 120.0,
+             ramp_s: float = 0.0, max_batch_jobs: Optional[int] = None,
+             warmup: bool = True) -> Dict:
+    """Drive a load test -> the serve manifest dict.
+
+    ``url`` targets a running server (``http://host:port``); None spins
+    an in-process ServeApp on an ephemeral port for the run (the CPU
+    smoke mode bench.py and the CLI default to).  ``warmup`` runs one
+    throwaway client first so executor compiles land outside the
+    measured window — the steady-state the SERVE_BASELINE captures
+    (compile-time observability lives in perfscope, not here).
+    """
+    job = dict(DEFAULT_JOB if job is None else job)
+    _raise_fd_limit(2 * clients + 256)
+    app = None
+    if url is None:
+        from .server import ServeApp
+        app = ServeApp(max_batch_jobs=max_batch_jobs).start()
+        host, port = app.host, app.port
+    else:
+        u = url.split("//", 1)[-1]
+        host, _, p = u.partition(":")
+        port = int(p.split("/")[0] or 80)
+    try:
+        if warmup:
+            # warm the TOP capacity rung before the measured window: one
+            # burst of max_batch_jobs concurrent clients compiles the
+            # executable every later batch reuses (the capacity policy
+            # prefers a warm larger rung over compiling a tighter one),
+            # so the measurement sees steady-state serving — compile
+            # observability is perfscope's job, not the load test's
+            stats = asyncio.run(_get_json(host, port, "/v1/stats"))
+            burst = int(stats.get("max_batch_jobs", 32))
+            wjob = dict(job)
+            wjob["seed"] = int(wjob.get("seed", 0)) + clients + 7
+            asyncio.run(_drive(host, port, burst, wjob, timeout, 0.0))
+        with REGISTRY.timer("serve.load_run").time():
+            drive = asyncio.run(_drive(host, port, clients, job,
+                                       timeout, ramp_s))
+    finally:
+        if app is not None:
+            app.close()
+    manifest = build_serve_manifest(drive, clients, job)
+    REGISTRY.gauge("serve.load_p99_ms").set(manifest["latency_ms"]["p99"])
+    REGISTRY.gauge("serve.load_jobs_per_launch").set(
+        manifest["jobs_per_launch"])
+    return manifest

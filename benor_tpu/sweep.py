@@ -143,6 +143,44 @@ def record_trajectory(cfg: SimConfig, state: NetState, faults: FaultSpec,
     return final, traj
 
 
+def default_crash_faults(cfg: SimConfig) -> FaultSpec:
+    """run_point's default fault policy as a public, reusable function:
+    the first F nodes crash-faulty (which F is statistically irrelevant
+    under the uniform scheduler — lanes are exchangeable).  The single
+    policy the per-point oracle, the batched engine and the serve
+    plane's job API (serve/jobs.py) all share, so "same SimConfig" means
+    the same fault mask on every entry path."""
+    fl = np.zeros(cfg.n_nodes, bool)
+    fl[:cfg.n_faulty] = True
+    return FaultSpec.from_faulty_list(cfg, fl)
+
+
+def point_from_raw(cfg_f: SimConfig, vals, seconds: float) -> SweepPoint:
+    """One SweepPoint from a bucket executable's raw per-point outputs —
+    the (rounds, decided, mean_k, ones, k_hist, disagree[, recorder]
+    [, witness]) tuple `_summarize_inline` lays out.  Factored out of the
+    batched engine's assembly loop so the serve plane's batch slots
+    (serve/jobs.py) deserialize result slices through the IDENTICAL
+    code path (bit-equality depends on sharing it, not re-implementing
+    it)."""
+    r, dec, mk, ones, khist, dis, *rest = vals
+    history = wit = None
+    if cfg_f.record:
+        history = np.asarray(rest.pop(0), np.int32)
+    if cfg_f.witness:
+        wit = np.asarray(rest.pop(0), np.int32)
+    return SweepPoint(
+        n_nodes=cfg_f.n_nodes, n_faulty=cfg_f.n_faulty,
+        trials=cfg_f.trials, coin_mode=cfg_f.coin_mode,
+        scheduler=cfg_f.scheduler, rounds_executed=int(r),
+        decided_frac=float(dec), mean_k=float(mk),
+        k_hist=np.asarray(khist).astype(np.int64),
+        ones_frac=float(ones), seconds=seconds,
+        trials_per_sec=(cfg_f.trials / seconds if seconds > 0
+                        else float("inf")),
+        disagree_frac=float(dis), round_history=history, witness=wit)
+
+
 def random_inputs(seed: int, trials: int, n: int) -> np.ndarray:
     """Per-trial random initial bits — the standard MC input distribution."""
     # benorlint: allow-host-rng — seeded host-side INPUT generation, built
@@ -174,9 +212,9 @@ def run_point(cfg: SimConfig, initial_values=None, faulty_list=None,
         initial_values = random_inputs(cfg.seed, cfg.trials, cfg.n_nodes)
     if faults is None:
         if faulty_list is None:
-            faulty_list = np.zeros(cfg.n_nodes, bool)
-            faulty_list[:cfg.n_faulty] = True
-        faults = FaultSpec.from_faulty_list(cfg, faulty_list)
+            faults = default_crash_faults(cfg)
+        else:
+            faults = FaultSpec.from_faulty_list(cfg, faulty_list)
     state = init_state(cfg, initial_values, faults)
     base_key = jax.random.key(cfg.seed)
 
@@ -359,12 +397,7 @@ def run_curve_batched(base_cfg: SimConfig, f_values: Sequence[int],
     if initial_values is None:
         initial_values = random_inputs(base_cfg.seed, T, N)
 
-    def default_faults(cfg_f: SimConfig) -> FaultSpec:
-        fl = np.zeros(cfg_f.n_nodes, bool)
-        fl[:cfg_f.n_faulty] = True
-        return FaultSpec.from_faulty_list(cfg_f, fl)
-
-    faults_fn = faults_for if faults_for is not None else default_faults
+    faults_fn = faults_for if faults_for is not None else default_crash_faults
 
     # ---- prepare (host side): bucket the points, build + stack inputs ----
     cfgs = [base_cfg.replace(n_faulty=int(f)) for f in f_values]
@@ -485,24 +518,8 @@ def run_curve_batched(base_cfg: SimConfig, f_values: Sequence[int],
 
 
 def _assemble_points(cfgs, raw, secs) -> List[SweepPoint]:
-    points = []
-    for cfg_f, vals, s in zip(cfgs, raw, secs):
-        r, dec, mk, ones, khist, dis, *rest = vals
-        history = wit = None
-        if cfg_f.record:
-            history = np.asarray(rest.pop(0), np.int32)
-        if cfg_f.witness:
-            wit = np.asarray(rest.pop(0), np.int32)
-        points.append(SweepPoint(
-            n_nodes=cfg_f.n_nodes, n_faulty=cfg_f.n_faulty,
-            trials=cfg_f.trials, coin_mode=cfg_f.coin_mode,
-            scheduler=cfg_f.scheduler, rounds_executed=int(r),
-            decided_frac=float(dec), mean_k=float(mk),
-            k_hist=np.asarray(khist).astype(np.int64),
-            ones_frac=float(ones), seconds=s,
-            trials_per_sec=(cfg_f.trials / s if s > 0 else float("inf")),
-            disagree_frac=float(dis), round_history=history, witness=wit))
-    return points
+    return [point_from_raw(cfg_f, vals, s)
+            for cfg_f, vals, s in zip(cfgs, raw, secs)]
 
 
 def rounds_vs_f_batched(base_cfg: SimConfig, f_values: Sequence[int],

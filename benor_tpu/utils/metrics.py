@@ -91,6 +91,18 @@ class Timer:
         yield
         self.record(time.perf_counter() - t0, start=start)
 
+    def percentiles(self, qs=(50, 99)) -> Dict[int, float]:
+        """Span-duration percentiles in SECONDS, from the recorded
+        events — what a latency timer (e.g. the serve plane's
+        ``serve.client_latency``) reduces to for p50/p99 reporting.
+        Empty timer -> an empty dict (no fabricated zeros)."""
+        with _REGISTRY_LOCK:
+            durs = [d for _, d in self.events]
+        if not durs:
+            return {}
+        return {int(q): float(np.percentile(np.asarray(durs), q))
+                for q in qs}
+
 
 _REGISTRY_LOCK = threading.RLock()
 

@@ -144,6 +144,72 @@ def test_post_malformed_content_length_400():
         assert b"Content-Length" in resp
 
 
+def test_taken_port_parks_node_instead_of_crashing():
+    """A port already bound inside the cluster's range must not kill the
+    whole cluster: the colliding node id is PARKED (recorded, no
+    listener) after the bind retries, every other node serves normally,
+    and the parked node's state stays observable via siblings'
+    /getState (NodeHttpCluster docstring contract)."""
+    import socket
+
+    blocker = socket.socket()
+    blocker.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    blocker.bind(("127.0.0.1", BASE + 71))       # node 1's port
+    blocker.listen(1)
+    try:
+        net = launch_network(3, 0, [1, 1, 1], [False] * 3, backend="tpu")
+        with NodeHttpCluster(net, BASE + 70, addr_retries=1,
+                             addr_retry_delay_s=0.01) as cluster:
+            assert cluster.parked == [1]
+            assert len(cluster.servers) == 2
+            assert _get(BASE + 70, "/status") == (200, "live")
+            assert _get(BASE + 72, "/status") == (200, "live")
+            # the parked node still exists in the simulated network
+            code, _ = _get(BASE + 70, "/start")
+            assert code == 200
+            assert json.loads(_get(BASE + 72, "/getState")[1])["decided"] \
+                is not False
+    finally:
+        blocker.close()
+
+
+def test_fully_taken_range_still_raises():
+    """Parking covers stragglers, not a fully occupied range: zero
+    bound listeners means clients would reach a FOREIGN process's
+    ports, so construction must fail loudly."""
+    import socket
+
+    blockers = []
+    try:
+        for i in range(2):
+            s = socket.socket()
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("127.0.0.1", BASE + 80 + i))
+            s.listen(1)
+            blockers.append(s)
+        net = launch_network(2, 0, [1, 1], [False] * 2, backend="tpu")
+        with pytest.raises(OSError, match="all 2 ports"):
+            NodeHttpCluster(net, BASE + 80, addr_retries=0)
+    finally:
+        for s in blockers:
+            s.close()
+
+
+def test_drain_cap_is_a_constructor_knob():
+    """NodeHttpCluster(drain_cap=...) reaches the handler class (the
+    _drain_best_effort budget) instead of the hardwired 1 MiB."""
+    net = launch_network(1, 0, [1], [False], backend="tpu")
+    with NodeHttpCluster(net, BASE + 75, drain_cap=1 << 10) as cluster:
+        handler_cls = cluster.servers[0].RequestHandlerClass
+        assert handler_cls.drain_cap == 1 << 10
+        # the knobbed cluster still serves the malformed-length path
+        resp = _raw_request(
+            BASE + 75,
+            b"POST /message HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Length: abc\r\n\r\nxx")
+        assert b"400" in resp.split(b"\r\n", 1)[0]
+
+
 # --- mid-run observability (cfg.poll_rounds) ---------------------------
 # The reference polls /getState every 200 ms WHILE consensus runs and
 # observes k growing toward the k>10 livelock assertion

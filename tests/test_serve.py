@@ -1,0 +1,529 @@
+"""benor-serve (benor_tpu/serve) — the request plane's tier-1 suite.
+
+Four layers, mirroring the subsystem's contract:
+
+  * THE HOUSE RULE: a job submitted through the serve plane returns
+    results bit-equal to the same SimConfig run through
+    ``sweep.run_point`` directly, and steady-state serving adds ZERO
+    new XLA compiles (pinned via utils/compile_counter — the same
+    discipline as the recorder/witness/heartbeat off-switches).
+  * BATCH PLANE: coalescing (many jobs, fewer launches), round-robin
+    fairness (a bucket-mismatched job never blocks an in-flight
+    batch), cancelled slots freed, capacity-rung reuse.
+  * FAILURE PATHS over real sockets: malformed JobSpec -> 400 with a
+    structured error body, client disconnect mid-SSE frees the batch
+    slot, unknown routes/jobs -> 404.
+  * ARTIFACTS: the serve manifest passes the pinned schema
+    (tools/serve_manifest_schema.json) and the regression gate honours
+    its 0/2/3 exit contract against doctored baselines.
+
+Everything runs at smoke scale (N<=64, T<=8) on CPU; the batcher is
+driven SYNCHRONOUSLY (``Batcher(start=False)`` + ``step()``) wherever
+determinism matters, with the real threaded server used for the
+socket-level tests.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from benor_tpu.config import SimConfig
+from benor_tpu.serve import (Batcher, IncomparableServe, JobError,
+                             JobSpec, ServeApp, compare_serve,
+                             serve_bucket_key)
+from benor_tpu.sweep import run_point
+from benor_tpu.utils.compile_counter import count_backend_compiles
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import check_metrics_schema  # noqa: E402
+import check_serve_regression  # noqa: E402
+
+#: The dyn-bucket smoke spec every batching test coalesces on
+#: (delivery='all' + crash + uniform has no quorum-specialized shapes).
+SPEC = {"kind": "simulate", "n_nodes": 16, "n_faulty": 2, "trials": 4,
+        "max_rounds": 8, "delivery": "all", "seed": 3}
+
+
+def _drain(batcher, deadline_s: float = 30.0) -> int:
+    n = 0
+    t0 = time.time()
+    while time.time() - t0 < deadline_s:
+        got = batcher.step()
+        if not got:
+            break
+        n += got
+    return n
+
+
+# --------------------------------------------------------------------------
+# the house rule: bit-equality + zero steady-state compiles
+# --------------------------------------------------------------------------
+
+
+def test_serve_result_bit_equal_to_run_point():
+    """Jobs with DIFFERENT f and seed coalesce into one launch, and each
+    slot's summary is bit-equal to run_point on the identical config —
+    floats compared with ==, not approx."""
+    b = Batcher(start=False)
+    variants = [dict(SPEC), {**SPEC, "seed": 11, "n_faulty": 1},
+                {**SPEC, "seed": 7, "n_faulty": 5}]
+    jobs = [j for v in variants for j in b.submit_dict(v)]
+    assert len({j.bucket for j in jobs}) == 1      # one shared bucket
+    assert _drain(b) == 3
+    assert b.launches == 1                         # ONE coalesced launch
+    for job, v in zip(jobs, variants):
+        cfg = SimConfig(n_nodes=v["n_nodes"], n_faulty=v["n_faulty"],
+                        trials=v["trials"], max_rounds=v["max_rounds"],
+                        delivery="all", seed=v["seed"])
+        pt = run_point(cfg)
+        r = job.result
+        assert job.state == "done"
+        assert r["rounds_executed"] == pt.rounds_executed
+        assert r["decided_frac"] == pt.decided_frac
+        assert r["mean_k"] == pt.mean_k
+        assert r["ones_frac"] == pt.ones_frac
+        assert r["disagree_frac"] == pt.disagree_frac
+        assert r["k_hist"] == pt.k_hist.tolist()
+
+
+def test_steady_state_serving_adds_zero_compiles():
+    """After the warm-up launch, further same-bucket traffic — including
+    a PARTIAL batch, which must reuse a larger warm rung padded rather
+    than compile a tighter one — runs with 0 backend compiles."""
+    b = Batcher(start=False)
+    for s in range(4):
+        b.submit_dict({**SPEC, "seed": 20 + s})
+    _drain(b)                                      # warm: capacity-4 rung
+    warm_executors = len(b._pool)
+    with count_backend_compiles() as cc:
+        for s in range(4):
+            b.submit_dict({**SPEC, "seed": 30 + s})
+        _drain(b)
+        for s in range(3):                         # partial batch of 3
+            b.submit_dict({**SPEC, "seed": 40 + s})
+        _drain(b)
+    assert cc.count == 0, "steady-state serving must not compile"
+    assert len(b._pool) == warm_executors          # no new rungs either
+    assert b.jobs_completed == 11
+
+
+def test_trajectory_job_streams_round_rows_bit_equal_to_recorder():
+    """kind=trajectory arms the flight recorder; the streamed rows match
+    run_point(record=True)'s recorder rows exactly, cursor semantics
+    included."""
+    from benor_tpu.utils.metrics import round_history_rows
+
+    b = Batcher(start=False)
+    spec = {**SPEC, "kind": "trajectory", "seed": 5}
+    job = b.submit_dict(spec)[0]
+    _drain(b)
+    rows = [p for (t, p) in job.events if t == "round"]
+    cfg = SimConfig(n_nodes=SPEC["n_nodes"], n_faulty=SPEC["n_faulty"],
+                    trials=SPEC["trials"], max_rounds=SPEC["max_rounds"],
+                    delivery="all", seed=5, record=True)
+    want = round_history_rows(run_point(cfg).round_history)
+    assert rows == want
+    assert rows[0]["round"] == 0                   # the /start snapshot
+
+
+def test_audit_job_carries_clean_verdict():
+    b = Batcher(start=False)
+    job = b.submit_dict({**SPEC, "kind": "audit"})[0]
+    _drain(b)
+    assert job.state == "done"
+    assert job.result["audit"]["ok"] is True
+    assert any(t == "witness" for (t, _p) in job.events)
+
+
+def test_sweep_job_expands_to_coalesced_points():
+    """One sweep job = one batch slot per f value, all in one bucket,
+    each point bit-equal to the per-point oracle."""
+    b = Batcher(start=False)
+    jobs = b.submit_dict({"kind": "sweep", "n_nodes": 16, "trials": 4,
+                          "max_rounds": 8, "delivery": "all", "seed": 2,
+                          "f_values": [0, 2, 4]})
+    assert [j.spec.n_faulty for j in jobs] == [0, 2, 4]
+    _drain(b)
+    assert b.launches == 1
+    for job in jobs:
+        cfg = SimConfig(n_nodes=16, n_faulty=job.spec.n_faulty, trials=4,
+                        max_rounds=8, delivery="all", seed=2)
+        assert job.result["mean_k"] == run_point(cfg).mean_k
+
+
+# --------------------------------------------------------------------------
+# batch plane: fairness, cancellation, bucketing
+# --------------------------------------------------------------------------
+
+
+def test_bucket_mismatched_job_never_blocks_in_flight_batch():
+    """A job whose static shape mismatches the queued batch gets its own
+    launch on the next round-robin turn — submitting it must not stall
+    or join the other bucket's executable."""
+    b = Batcher(start=False)
+    a_jobs = [b.submit_dict({**SPEC, "seed": s})[0] for s in (1, 2)]
+    mismatched = b.submit_dict({**SPEC, "n_nodes": 24, "seed": 9})[0]
+    assert mismatched.bucket != a_jobs[0].bucket
+    first = b.step()
+    second = b.step()
+    assert sorted((first, second)) == [1, 2]       # two separate launches
+    assert mismatched.state == "done"
+    assert all(j.state == "done" for j in a_jobs)
+    assert b.launches == 2
+    # and the mismatched result is still oracle-exact
+    cfg = SimConfig(n_nodes=24, n_faulty=2, trials=4, max_rounds=8,
+                    delivery="all", seed=9)
+    assert mismatched.result["mean_k"] == run_point(cfg).mean_k
+
+
+def test_cancelled_job_frees_its_batch_slot():
+    b = Batcher(start=False)
+    keep = b.submit_dict({**SPEC, "seed": 1})[0]
+    gone = b.submit_dict({**SPEC, "seed": 2})[0]
+    assert gone.cancel() is True
+    assert gone.state == "cancelled"
+    assert b.step() == 1                           # only the live slot ran
+    assert keep.state == "done"
+    assert gone.result is None
+    assert b.jobs_completed == 1
+
+
+def test_jobspec_from_config_round_trips():
+    """results.py's serve_replay provenance hook: from_config -> wire
+    dict -> from_dict -> to_config reproduces the SimConfig exactly."""
+    cfg = SimConfig(n_nodes=16, n_faulty=2, trials=4, max_rounds=8,
+                    delivery="all", seed=3)
+    spec = JobSpec.from_config(cfg)
+    assert spec.to_config() == cfg
+    assert JobSpec.from_dict(spec.to_dict()).to_config() == cfg
+    assert JobSpec.from_config(cfg.replace(record=True)).kind \
+        == "trajectory"
+
+
+def test_seed_is_erased_from_the_bucket_key():
+    cfg_a = SimConfig(n_nodes=16, n_faulty=2, trials=4, delivery="all",
+                      seed=1)
+    cfg_b = cfg_a.replace(seed=999)
+    assert serve_bucket_key(cfg_a) == serve_bucket_key(cfg_b)
+    assert serve_bucket_key(cfg_a) != serve_bucket_key(
+        cfg_a.replace(trials=8))
+
+
+def test_quorum_specialized_config_gets_static_bucket():
+    """A dense-path quorum config is quorum-specialized: capacity-1
+    static bucket, classic dispatch, still oracle-exact and warm across
+    seeds."""
+    b = Batcher(start=False)
+    spec = {"kind": "simulate", "n_nodes": 16, "n_faulty": 3, "trials": 4,
+            "max_rounds": 8, "delivery": "quorum", "seed": 4}
+    j1 = b.submit_dict(spec)[0]
+    assert j1.bucket[0] == "static"
+    _drain(b)
+    cfg = SimConfig(n_nodes=16, n_faulty=3, trials=4, max_rounds=8,
+                    delivery="quorum", seed=4)
+    assert j1.result["mean_k"] == run_point(cfg).mean_k
+    with count_backend_compiles() as cc:
+        j2 = b.submit_dict({**spec, "seed": 77})[0]
+        _drain(b)
+    assert cc.count == 0                           # warm across seeds
+    assert j2.state == "done"
+
+
+# --------------------------------------------------------------------------
+# JobSpec validation -> structured 400s
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("doc,field", [
+    ([1, 2], "$"),
+    ({"kind": "nope"}, "kind"),
+    ({"n_nodes": "ten"}, "n_nodes"),
+    ({"n_nodes": True}, "n_nodes"),
+    ({"trials": 0}, "trials"),
+    ({"n_nodes": 1 << 20}, "n_nodes"),
+    ({"seed": -1}, "seed"),
+    ({"bogus_knob": 1}, "bogus_knob"),
+    ({"kind": "sweep"}, "f_values"),
+    ({"kind": "sweep", "f_values": [1, "x"]}, "f_values"),
+    ({"kind": "simulate", "f_values": [1]}, "f_values"),
+    ({"n_nodes": 8, "n_faulty": 9}, "config"),
+    ({"delivery": "all", "scheduler": "adversarial"}, "config"),
+])
+def test_jobspec_validation_is_structured(doc, field):
+    with pytest.raises(JobError) as ei:
+        JobSpec.from_dict(doc)
+    assert ei.value.body["error"] == "invalid job"
+    assert ei.value.body["field"] == field
+    assert ei.value.body["reason"]
+
+
+# --------------------------------------------------------------------------
+# the wire: real sockets against a live ServeApp
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def app():
+    with ServeApp(max_batch_jobs=8) as a:
+        yield a
+
+
+def _request(app, payload: bytes, read_until=None,
+             timeout: float = 60.0) -> bytes:
+    s = socket.create_connection((app.host, app.port), timeout=timeout)
+    try:
+        s.sendall(payload)
+        chunks = b""
+        while True:
+            b = s.recv(65536)
+            if not b:
+                break
+            chunks += b
+            if read_until and read_until in chunks:
+                break
+    finally:
+        s.close()
+    return chunks
+
+
+def _post(app, doc, stream: bool = False, query: str = "",
+          read_until=None) -> bytes:
+    body = json.dumps(doc).encode()
+    q = ("?stream=sse" if stream else "") + query
+    return _request(
+        app,
+        f"POST /v1/jobs{q} HTTP/1.1\r\nHost: x\r\n"
+        f"Content-Length: {len(body)}\r\n\r\n".encode() + body,
+        read_until=read_until)
+
+
+def _status_and_json(resp: bytes):
+    head, _, body = resp.partition(b"\r\n\r\n")
+    return int(head.split()[1]), json.loads(body)
+
+
+def test_http_malformed_jobspec_is_a_structured_400(app):
+    code, body = _status_and_json(_post(app, {"kind": "bogus"}))
+    assert code == 400
+    assert body["error"] == "invalid job" and body["field"] == "kind"
+    # non-JSON body: same structured shape
+    raw = b"not json"
+    code, body = _status_and_json(_request(
+        app, b"POST /v1/jobs HTTP/1.1\r\nHost: x\r\n"
+             b"Content-Length: %d\r\n\r\n" % len(raw) + raw))
+    assert code == 400 and body["field"] == "$"
+
+
+def test_http_submit_stream_and_poll(app):
+    resp = _post(app, {**SPEC, "seed": 50}, stream=True,
+                 read_until=b"event: done")
+    assert resp.startswith(b"HTTP/1.1 200")
+    assert b"text/event-stream" in resp
+    assert b"event: result" in resp
+    # the result event carries the summary payload
+    for line in resp.split(b"\n"):
+        if line.startswith(b"data: ") and b"rounds_executed" in line:
+            payload = json.loads(line[len(b"data: "):])
+            break
+    else:
+        raise AssertionError("no result payload in stream")
+    cfg = SimConfig(n_nodes=16, n_faulty=2, trials=4, max_rounds=8,
+                    delivery="all", seed=50)
+    assert payload["mean_k"] == run_point(cfg).mean_k
+    # 202 + poll path
+    code, body = _status_and_json(_post(app, {**SPEC, "seed": 51}))
+    assert code == 202 and len(body["jobs"]) == 1
+    job_id = body["jobs"][0]
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        code, snap = _status_and_json(_request(
+            app, f"GET /v1/jobs/{job_id} HTTP/1.1\r\nHost: x"
+                 f"\r\n\r\n".encode()))
+        if snap["state"] == "done":
+            break
+        time.sleep(0.05)
+    assert snap["result"]["job"] == job_id
+
+
+def test_http_sse_since_round_cursor(app):
+    """?since_round=N filters round rows at/below the cursor — the
+    /getRoundHistory contract, pushed over SSE."""
+    full = _post(app, {**SPEC, "kind": "trajectory", "seed": 52},
+                 stream=True, read_until=b"event: done")
+    rounds_full = [int(line.split(b": ")[1]) for line in full.split(b"\n")
+                   if line.startswith(b"id: ")]
+    assert rounds_full and rounds_full[0] == 0
+    resumed = _post(app, {**SPEC, "kind": "trajectory", "seed": 52},
+                    stream=True, query="&since_round=0",
+                    read_until=b"event: done")
+    rounds_res = [int(line.split(b": ")[1]) for line in resumed.split(b"\n")
+                  if line.startswith(b"id: ")]
+    assert rounds_res == [r for r in rounds_full if r > 0]
+
+
+def test_http_client_disconnect_mid_sse_frees_the_slot(app):
+    """Open the SSE stream, read the headers, slam the connection before
+    the batch runs: the job must end cancelled (slot freed), and the
+    plane must keep serving other clients."""
+    before = app.batcher.jobs_submitted
+    doc = json.dumps({**SPEC, "seed": 60,
+                      "max_rounds": 8}).encode()
+    s = socket.create_connection((app.host, app.port), timeout=30)
+    s.sendall(f"POST /v1/jobs?stream=sse HTTP/1.1\r\nHost: x\r\n"
+              f"Content-Length: {len(doc)}\r\n\r\n".encode() + doc)
+    # wait for the queued event so the job exists server-side
+    buf = b""
+    while b"event: queued" not in buf:
+        buf += s.recv(4096)
+    job_id = json.loads(
+        [ln for ln in buf.split(b"\n") if ln.startswith(b"data: ")][-1]
+        [len(b"data: "):])["job"]
+    s.close()                                      # the disconnect
+    job = app.batcher.get(job_id)
+    deadline = time.time() + 30
+    while time.time() < deadline and not job.done:
+        time.sleep(0.02)
+    assert job.state in ("cancelled", "done")
+    if job.state == "done":
+        # raced the batcher: the launch had already claimed the slot —
+        # legal, but the orphan result must not leak to anyone
+        assert job.result is not None
+    assert app.batcher.jobs_submitted == before + 1
+    # the plane still serves
+    code, _ = _status_and_json(_request(
+        app, b"GET /healthz HTTP/1.1\r\nHost: x\r\n\r\n"))
+    assert code == 200
+
+
+def test_http_unknown_routes_and_stats(app):
+    code, _ = _status_and_json(_request(
+        app, b"GET /nope HTTP/1.1\r\nHost: x\r\n\r\n"))
+    assert code == 404
+    code, _ = _status_and_json(_request(
+        app, b"GET /v1/jobs/nope HTTP/1.1\r\nHost: x\r\n\r\n"))
+    assert code == 404
+    code, stats = _status_and_json(_request(
+        app, b"GET /v1/stats HTTP/1.1\r\nHost: x\r\n\r\n"))
+    assert code == 200
+    assert stats["jobs_completed"] >= 1
+    assert any(d["label"].startswith("serve.bucket.")
+               for d in stats["executors_detail"])
+
+
+# --------------------------------------------------------------------------
+# artifacts: manifest schema + gate exit codes
+# --------------------------------------------------------------------------
+
+
+def _manifest(**over) -> dict:
+    m = {"kind": "serve_manifest", "schema_version": 1, "platform": "cpu",
+         "device_kind": "cpu", "clients": 100, "jobs_submitted": 100,
+         "jobs_completed": 100, "errors": 0, "duration_s": 1.5,
+         "latency_ms": {"p50": 40.0, "p99": 90.0, "mean": 45.0,
+                        "max": 95.0},
+         "throughput_jobs_per_sec": 66.6, "launches": 5,
+         "jobs_per_launch": 20.0, "executor_compiles": 2,
+         "scale": {"n_nodes": 32, "n_faulty": 4, "trials": 8,
+                   "max_rounds": 16, "delivery": "all",
+                   "kind": "simulate"}}
+    m.update(over)
+    return m
+
+
+def test_serve_manifest_schema_and_cross_fields():
+    assert check_metrics_schema.check_serve_manifest(_manifest()) == []
+    errs = check_metrics_schema.check_serve_manifest(
+        _manifest(jobs_per_launch=3.0))
+    assert any("jobs_completed/launches" in e for e in errs)
+    errs = check_metrics_schema.check_serve_manifest(_manifest(
+        latency_ms={"p50": 99.0, "p99": 50.0, "mean": 60.0, "max": 99.0}))
+    assert any("percentiles out of order" in e for e in errs)
+    errs = check_metrics_schema.check_serve_manifest(
+        _manifest(kind="scaling_manifest"))
+    assert errs                                    # wrong kind rejected
+
+
+def test_committed_baseline_is_schema_valid():
+    with open(os.path.join(REPO, "SERVE_BASELINE.json")) as fh:
+        base = json.load(fh)
+    assert check_metrics_schema.check_serve_manifest(base) == []
+    assert base["clients"] >= 1000                 # the acceptance scale
+    assert base["jobs_per_launch"] > 1.0
+    assert base["errors"] == 0
+
+
+def test_gate_rules_and_exit_codes(tmp_path):
+    base = _manifest()
+    # in-band
+    assert compare_serve(_manifest(), base) == []
+    # coalescing collapse = the worst finding
+    fs = compare_serve(_manifest(jobs_per_launch=1.0,
+                                 launches=100), base)
+    assert any("per-job dispatch" in f.message for f in fs)
+    # band regression
+    fs = compare_serve(_manifest(jobs_per_launch=10.0,
+                                 launches=10), base)
+    assert any("jobs_per_launch" == f.metric for f in fs)
+    # client errors always gate
+    fs = compare_serve(_manifest(errors=3, jobs_completed=97,
+                                 jobs_per_launch=19.4), base)
+    assert {f.metric for f in fs} >= {"errors", "jobs_completed"}
+    # timing only under an explicit band
+    slow = _manifest(throughput_jobs_per_sec=1.0,
+                     latency_ms={"p50": 4000.0, "p99": 9000.0,
+                                 "mean": 4500.0, "max": 9500.0})
+    assert compare_serve(slow, base) == []
+    assert compare_serve(slow, base, timing_band=0.5)
+    # incomparable: platform / scale / fewer clients
+    for bad in (_manifest(platform="tpu"),
+                _manifest(scale={**_manifest()["scale"], "n_nodes": 64}),
+                _manifest(clients=10)):
+        with pytest.raises(IncomparableServe):
+            compare_serve(bad, base)
+    # the CLI contract end to end: 0 / 2 / 3
+    mp, bp = str(tmp_path / "m.json"), str(tmp_path / "b.json")
+    with open(bp, "w") as fh:
+        json.dump(base, fh)
+    with open(mp, "w") as fh:
+        json.dump(_manifest(), fh)
+    assert check_serve_regression.main([mp, bp]) == 0
+    with open(mp, "w") as fh:
+        json.dump(_manifest(jobs_per_launch=1.0, launches=100), fh)
+    assert check_serve_regression.main([mp, bp]) == 2
+    with open(mp, "w") as fh:
+        json.dump(_manifest(platform="tpu"), fh)
+    assert check_serve_regression.main([mp, bp]) == 3
+    missing = str(tmp_path / "nope.json")
+    assert check_serve_regression.main([mp, missing]) == 0
+    assert check_serve_regression.main([mp, missing, "--strict"]) == 3
+
+
+def test_committed_baseline_gates_itself():
+    """The committed SERVE_BASELINE.json must be in-band against itself
+    through the real CLI — the exact command the acceptance runs."""
+    path = os.path.join(REPO, "SERVE_BASELINE.json")
+    assert check_serve_regression.main([path, path]) == 0
+
+
+@pytest.mark.slow
+def test_loadgen_smoke_end_to_end():
+    """A small real load run: concurrent SSE clients against an
+    in-process server -> schema-valid manifest, zero errors, coalescing
+    above 1 (the acceptance shape at smoke scale)."""
+    from benor_tpu.serve import run_load
+
+    m = run_load(clients=40, timeout=90,
+                 job={**SPEC, "n_nodes": 32, "n_faulty": 4, "trials": 8,
+                      "max_rounds": 16})
+    assert check_metrics_schema.check_serve_manifest(m) == []
+    assert m["errors"] == 0
+    assert m["jobs_completed"] == 40
+    assert m["jobs_per_launch"] > 1.0

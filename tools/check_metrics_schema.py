@@ -35,7 +35,11 @@ scaling manifest (``python -m benor_tpu scale`` /
 ``SCALING_BASELINE.json``, tagged ``kind: scaling_manifest`` —
 validated by ``check_scaling_manifest`` against
 ``tools/scaling_manifest_schema.json`` plus the efficiency/mesh-shape
-cross-field pins).
+cross-field pins), or serve manifest (``python -m benor_tpu load`` /
+``SERVE_BASELINE.json``, tagged ``kind: serve_manifest`` — validated
+by ``check_serve_manifest`` against
+``tools/serve_manifest_schema.json`` plus the coalescing-ratio and
+latency-ordering cross-field pins).
 """
 
 from __future__ import annotations
@@ -292,6 +296,52 @@ def check_scaling_manifest(manifest: dict,
     return errors
 
 
+SERVE_SCHEMA_PATH = os.path.join(HERE, "serve_manifest_schema.json")
+
+
+def check_serve_manifest(manifest: dict,
+                         schema_path: str = SERVE_SCHEMA_PATH
+                         ) -> List[str]:
+    """Validate a serve manifest (`python -m benor_tpu load`,
+    SERVE_BASELINE.json, bench.py's serve sidecar blob) against
+    tools/serve_manifest_schema.json; returns the error list (empty =
+    ok).
+
+    Beyond the schema, pins the cross-field facts the serve gate
+    relies on: jobs_per_launch must equal jobs_completed / launches
+    (a drifted coalescing ratio would silently skew the gate's whole
+    verdict), completed + errors must account for every client, and
+    the latency percentiles must be ordered (p50 <= p99 <= max)."""
+    errors: List[str] = []
+    with open(schema_path) as fh:
+        schema = json.load(fh)
+    _validate(manifest, schema, "$", errors)
+    if errors:
+        return errors
+    launches = manifest["launches"]
+    if launches:
+        want = manifest["jobs_completed"] / launches
+        got = manifest["jobs_per_launch"]
+        if abs(got - want) > max(1e-3, 1e-3 * want):
+            errors.append(f"$.jobs_per_launch: {got} != "
+                          f"jobs_completed/launches ({want:.4f})")
+    elif manifest["jobs_per_launch"]:
+        errors.append("$.jobs_per_launch: nonzero with zero launches")
+    if manifest["jobs_completed"] > manifest["jobs_submitted"]:
+        errors.append(f"$.jobs_completed: {manifest['jobs_completed']} "
+                      f"exceeds jobs_submitted "
+                      f"{manifest['jobs_submitted']}")
+    lat = manifest["latency_ms"]
+    if not (lat["p50"] <= lat["p99"] <= lat["max"]):
+        errors.append(f"$.latency_ms: percentiles out of order "
+                      f"(p50={lat['p50']}, p99={lat['p99']}, "
+                      f"max={lat['max']})")
+    if manifest["clients"] < 1:
+        errors.append("$.clients: a load manifest needs at least one "
+                      "client")
+    return errors
+
+
 WITNESS_SCHEMA_PATH = os.path.join(HERE, "witness_bundle_schema.json")
 
 
@@ -372,6 +422,14 @@ def main(argv=None) -> int:
         for e in errors:
             print(f"FAIL {e}", file=sys.stderr)
         print(f"{os.path.basename(path)}: scaling manifest "
+              f"{'OK' if not errors else 'INVALID'}")
+        return 1 if errors else 0
+    if detail.get("kind") == "serve_manifest":
+        # a serve-plane load manifest (load CLI / SERVE_BASELINE.json)
+        errors = check_serve_manifest(detail)
+        for e in errors:
+            print(f"FAIL {e}", file=sys.stderr)
+        print(f"{os.path.basename(path)}: serve manifest "
               f"{'OK' if not errors else 'INVALID'}")
         return 1 if errors else 0
     if detail.get("kind") == "perf_manifest":
