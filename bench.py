@@ -1082,6 +1082,8 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
         f"clients={m.get('clients')} "
         f"jobs_per_launch={m.get('jobs_per_launch')} "
         f"p99_ms={(m.get('latency_ms') or {}).get('p99')} "
+        f"attribution_coverage="
+        f"{(m.get('attribution') or {}).get('coverage')} "
         f"baseline_comparable={serve_check.get('baseline_comparable')}")
 
     total_trials = trials * len(regimes)
@@ -1357,10 +1359,12 @@ def _serve_check() -> dict:
     sidecar blob, and reduce it to the ``serve_ok`` headline bool:
     manifest schema-valid (tools/serve_manifest_schema.json, loaded by
     file path), zero client errors, jobs-per-launch coalescing ratio
-    above 1 (the number serving exists to produce), and in-band vs the
-    committed SERVE_BASELINE.json when comparable (a smaller smoke run
-    vs the 1000-client baseline is honestly reported incomparable, not
-    silently passed)."""
+    above 1 (the number serving exists to produce), servescope's
+    stage-latency attribution complete (the v2 manifest's stage means
+    telescope to the client mean within gate.ATTRIBUTION_BAND), and
+    in-band vs the committed SERVE_BASELINE.json when comparable (a
+    smaller smoke run vs the 1000-client baseline is honestly reported
+    incomparable, not silently passed)."""
     import importlib.util
 
     from benor_tpu.serve import IncomparableServe, compare_serve, run_load
@@ -1397,6 +1401,7 @@ def _serve_check() -> dict:
     blob["regressions"] = regressions
     blob["ok"] = (not schema_errors and manifest["errors"] == 0
                   and manifest["jobs_per_launch"] > 1.0
+                  and bool(manifest.get("attribution", {}).get("ok"))
                   and not regressions)
     return blob
 

@@ -30,18 +30,22 @@ Subcommands:
                                                      ETA); no backend
                                                      touched
   serve  [--port 8400] [--max-batch-jobs 32]         the async multi-
-                                                     tenant request
+         [--trace-out trace.json]                    tenant request
                                                      plane (benor_tpu/
                                                      serve): HTTP+SSE
                                                      job API over the
                                                      warm batched
-                                                     executor pool
+                                                     executor pool;
+                                                     --trace-out arms
+                                                     servescope spans
   load   [--clients 1000] [--url http://...]         drive concurrent
          [--profile-out serve.json]                  SSE clients against
-                                                     the serve plane ->
+         [--trace-out trace.json]                    the serve plane ->
                                                      pinned-schema serve
-                                                     manifest + baseline
-                                                     gate (SERVE_
+                                                     manifest (v2: per-
+                                                     stage p50/p99 +
+                                                     attribution) +
+                                                     baseline gate (SERVE_
                                                      BASELINE.json);
                                                      exit 2 on
                                                      regression
@@ -653,7 +657,8 @@ def _serve(args) -> int:
     interrupted."""
     from .serve import run_server
     return run_server(host=args.host, port=args.port,
-                      max_batch_jobs=args.max_batch_jobs)
+                      max_batch_jobs=args.max_batch_jobs,
+                      trace_out=args.trace_out)
 
 
 def _load(args) -> int:
@@ -668,14 +673,23 @@ def _load(args) -> int:
     job = None
     if args.job:
         job = json.loads(args.job)
+    if args.trace_out:
+        from .utils.metrics import SPANS
+        SPANS.enable()
     manifest = run_load(url=args.url, clients=args.clients, job=job,
                         timeout=args.timeout, ramp_s=args.ramp,
                         max_batch_jobs=args.max_batch_jobs)
+    if args.trace_out:
+        from .utils.metrics import export_chrome_trace
+        n = export_chrome_trace(args.trace_out, spans=True)
+        print(f"wrote {n} trace events to {args.trace_out} "
+              f"(open in ui.perfetto.dev)", file=sys.stderr)
     fb = " [cpu fallback]" if FELL_BACK else ""
     if args.format == "json":
         print(json.dumps(manifest, indent=1))
     else:
         lat = manifest["latency_ms"]
+        attr = manifest["attribution"]
         print(f"benor-serve load: {manifest['platform']} "
               f"({manifest['device_kind']}), {manifest['clients']} "
               f"concurrent clients{fb}")
@@ -687,6 +701,15 @@ def _load(args) -> int:
         print(f"  latency p50={lat['p50']:.0f}ms p99={lat['p99']:.0f}ms; "
               f"coalescing {manifest['jobs_per_launch']:.1f} "
               f"jobs/launch over {manifest['launches']} launches")
+        stages = manifest["stages"]
+        print("  stages p99 (ms): "
+              + " ".join(f"{s}={stages[s]['p99']:.0f}"
+                         for s in ("queue_wait", "batch_assemble",
+                                   "launch", "stream_out")))
+        print(f"  attribution: {attr['stage_mean_sum_ms']:.0f}ms of "
+              f"{attr['client_mean_ms']:.0f}ms client mean attributed "
+              f"(coverage {attr['coverage']:.2f}, "
+              f"{'ok' if attr['ok'] else 'INCOMPLETE'})")
     if args.profile_out:
         with open(args.profile_out, "w") as fh:
             json.dump(manifest, fh, indent=1)
@@ -1015,6 +1038,10 @@ def main(argv=None) -> int:
                     help="coalescing ceiling: jobs per executable "
                          "launch (default serve.MAX_BATCH_JOBS, "
                          "rounded up to a power of two)")
+    sv.add_argument("--trace-out", metavar="PATH", default=None,
+                    help="arm servescope span tracing and write the "
+                         "Perfetto trace (request/batch/job stage "
+                         "spans, flow-linked) here on shutdown")
 
     ld = sub.add_parser("load",
                         help="load-test the serve plane: concurrent "
@@ -1056,6 +1083,10 @@ def main(argv=None) -> int:
                     help="also gate the machine-sensitive throughput/"
                          "p99 numbers at this ratio band (off by "
                          "default; see serve/gate.py)")
+    ld.add_argument("--trace-out", metavar="PATH", default=None,
+                    help="arm servescope span tracing for the run and "
+                         "write the Perfetto trace (request/batch/job "
+                         "stage spans, flow-linked) here")
     _add_obs_args(ld, record=False)
 
     w = sub.add_parser("watch",

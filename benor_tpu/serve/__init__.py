@@ -21,22 +21,34 @@ model.  Five modules:
               SERVE_BASELINE.json (exit 0 in-band / 2 regression /
               3 incomparable)
 
+servescope (PR 11) threads through all five: every job carries the
+nine-stamp stage timeline (jobs.STAGE_STAMPS), the batcher and the
+HTTP front door emit batch/job/request spans into
+``utils.metrics.SPANS`` when tracing is armed, the server answers
+``/v1/jobs/<id>/timing``, and the v2 manifest carries per-stage
+p50/p99 blocks plus the attribution-completeness cross-check that
+``gate.py`` and the committed baseline now enforce.
+
 Importing this package is cheap (no jax at import time); the device
 work begins at the first launch on the batcher thread.
 """
 
-from .batcher import MAX_BATCH_JOBS, Batcher, Job, serve_bucket_key
-from .gate import (COALESCING_BAND, IncomparableServe, ServeFinding,
-                   compare_serve)
-from .jobs import (CONFIG_FIELDS, JOB_KINDS, JobError, JobSpec,
-                   job_inputs, result_dict)
+from .batcher import (MAX_BATCH_JOBS, Batcher, Job, emit_job_spans,
+                      serve_bucket_key)
+from .gate import (ATTRIBUTION_BAND, COALESCING_BAND, STAGE_P99_BANDS,
+                   IncomparableServe, ServeFinding, compare_serve)
+from .jobs import (CONFIG_FIELDS, JOB_KINDS, STAGE_NAMES, STAGE_STAMPS,
+                   STAGES, JobError, JobSpec, job_inputs, result_dict,
+                   stage_durations, timing_dict)
 from .loadgen import DEFAULT_JOB, build_serve_manifest, run_load
 from .server import ServeApp, run_server
 
 __all__ = [
-    "MAX_BATCH_JOBS", "Batcher", "Job", "serve_bucket_key",
-    "COALESCING_BAND", "IncomparableServe", "ServeFinding",
-    "compare_serve", "CONFIG_FIELDS", "JOB_KINDS", "JobError", "JobSpec",
-    "job_inputs", "result_dict", "DEFAULT_JOB", "build_serve_manifest",
-    "run_load", "ServeApp", "run_server",
+    "MAX_BATCH_JOBS", "Batcher", "Job", "emit_job_spans",
+    "serve_bucket_key", "ATTRIBUTION_BAND", "COALESCING_BAND",
+    "STAGE_P99_BANDS", "IncomparableServe", "ServeFinding",
+    "compare_serve", "CONFIG_FIELDS", "JOB_KINDS", "STAGE_NAMES",
+    "STAGE_STAMPS", "STAGES", "JobError", "JobSpec", "job_inputs",
+    "result_dict", "stage_durations", "timing_dict", "DEFAULT_JOB",
+    "build_serve_manifest", "run_load", "ServeApp", "run_server",
 ]

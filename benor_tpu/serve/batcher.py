@@ -54,8 +54,8 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..config import SimConfig
-from ..utils.metrics import REGISTRY
-from .jobs import JobError, JobSpec, job_inputs, result_dict
+from ..utils.metrics import REGISTRY, SPANS, perf_to_epoch
+from .jobs import STAGES, JobError, JobSpec, job_inputs, result_dict
 
 #: Capacity ceiling of one launch (jobs per executable).  Power of two;
 #: the pool compiles at most log2(MAX_BATCH_JOBS)+1 capacity rungs per
@@ -84,6 +84,14 @@ class Job:
     flips to 'cancelled' and the batcher skips it when forming the next
     batch; an in-flight job finishes on device (the executable cannot
     be interrupted) but its result is discarded unpublished.
+
+    ``stamps`` is servescope's timeline: one ``perf_counter`` float per
+    jobs.STAGE_STAMPS transition (the batcher writes accepted through
+    result_sliced and the terminal done; the HTTP plane refines
+    first_sse/done on the stream leg).  Stamps are taken UNCONDITIONALLY
+    — nine floats per job — so the ``/v1/jobs/<id>/timing`` route and
+    the load manifest's stage block never depend on tracing being armed;
+    the SPANS plane only *renders* them when enabled.
     """
 
     _ids = itertools.count(1)
@@ -97,13 +105,28 @@ class Job:
         self.result: Optional[dict] = None
         self.error: Optional[dict] = None
         self.events: List[Tuple[str, dict]] = []
-        self.submitted_t = time.perf_counter()
-        self.started_t: Optional[float] = None
-        self.done_t: Optional[float] = None
+        self.stamps: Dict[str, float] = {}
         self.launch_jobs = 0          # batch size of the launch that ran it
         self._lock = threading.Lock()
         self._done = threading.Event()
         self._waiters: List[tuple] = []   # (loop, asyncio.Event)
+        self._flow: Optional[int] = None  # batch->job Perfetto flow id
+        self._spans_emitted = False
+        #: True when an SSE delivery leg owns this job's span emission
+        #: (set BEFORE enqueue, so the publish path cannot race the
+        #: stream's waiter registration and emit spans that lack the
+        #: stream_out stage).
+        self._streamed = False
+
+    def stamp(self, name: str, t: Optional[float] = None,
+              override: bool = False) -> None:
+        """Record a stage transition (first write wins unless
+        ``override`` — the stream leg legitimately re-stamps ``done``
+        when SSE delivery, not result publication, completes)."""
+        with self._lock:
+            if override or name not in self.stamps:
+                self.stamps[name] = (time.perf_counter()
+                                     if t is None else t)
 
     # -- event plane ------------------------------------------------------
     def publish(self, etype: str, payload: dict) -> None:
@@ -203,6 +226,12 @@ class Batcher:
         self.jobs_completed = 0
         self.jobs_submitted = 0
         self.executor_compiles = 0
+        self.batch_errors = 0
+        #: Structured snapshot of the most recent batch failure (the
+        #: worker loop's boundary) — surfaced in /v1/stats so a
+        #: misbehaving tenant's blast radius is observable without
+        #: scraping stderr.  None until something fails.
+        self.last_error: Optional[dict] = None
         self._thread = None
         if start:
             self._thread = threading.Thread(target=self._run, daemon=True,
@@ -210,16 +239,31 @@ class Batcher:
             self._thread.start()
 
     # -- intake -----------------------------------------------------------
-    def submit_dict(self, doc) -> List[Job]:
+    def submit_dict(self, doc, accepted_t: Optional[float] = None,
+                    streamed: bool = False) -> List[Job]:
         """Wire document -> validated, enqueued jobs (sweep kind expands
-        to one job per f value).  Raises JobError — the structured 400."""
-        return self.submit(JobSpec.from_dict(doc, limits=self.limits))
+        to one job per f value).  Raises JobError — the structured 400.
+        ``accepted_t`` back-dates the accepted stamp to when the request
+        plane started handling the request, so the validate stage
+        includes request read + JobSpec validation; ``streamed`` marks
+        the jobs as owned by an SSE delivery leg (span emission waits
+        for the stream — see ``emit_job_spans``)."""
+        t_acc = time.perf_counter() if accepted_t is None else accepted_t
+        return self.submit(JobSpec.from_dict(doc, limits=self.limits),
+                           accepted_t=t_acc, streamed=streamed)
 
-    def submit(self, spec: JobSpec) -> List[Job]:
+    def submit(self, spec: JobSpec,
+               accepted_t: Optional[float] = None,
+               streamed: bool = False) -> List[Job]:
+        t_acc = time.perf_counter() if accepted_t is None else accepted_t
         jobs = []
         for sub in spec.expand():
             cfg = sub.to_config()         # JobError on invalid combos
-            jobs.append(Job(sub, cfg))
+            job = Job(sub, cfg)
+            job._streamed = streamed
+            job.stamp("accepted", t_acc)
+            job.stamp("validated")
+            jobs.append(job)
         with self._cv:
             for job in jobs:
                 self._jobs[job.id] = job
@@ -229,6 +273,7 @@ class Batcher:
                     self._queues[job.bucket] = q
                     self._rr.append(job.bucket)
                 q.append(job)
+                job.stamp("enqueued")
                 self.jobs_submitted += 1
             depth = sum(len(q) for q in self._queues.values())
             self._cv.notify_all()
@@ -263,6 +308,13 @@ class Batcher:
                         del self._queues[key]
                         self._rr.remove(key)
                     if jobs:
+                        # queue depth sampled at DRAIN, not just submit:
+                        # a submit-only gauge can only ever grow within
+                        # a burst and never shows the batcher catching
+                        # up — the drain-side sample is what queue-wait
+                        # attribution correlates with
+                        depth = sum(len(q) for q in self._queues.values())
+                        REGISTRY.gauge("serve.queue_depth").set(depth)
                         return key, jobs
                 if not block or self._stop:
                     return None, []
@@ -282,11 +334,13 @@ class Batcher:
         # unlocked state write would overwrite it and later publish the
         # orphan result the cancel contract promises to discard)
         jobs = []
+        t_claim = time.perf_counter()
         for job in popped:
             with job._lock:
                 if job.state != "queued":
                     continue
                 job.state = "running"
+                job.stamps.setdefault("batch_assigned", t_claim)
             jobs.append(job)
         if not jobs:
             return 0
@@ -301,7 +355,7 @@ class Batcher:
                     continue    # its result already published — keep it
                 job.state = "error"
                 job.error = {"error": f"{type(e).__name__}: {e}"}
-                job.done_t = time.perf_counter()
+                job.stamp("done")
                 job.publish("error", job.error)
             raise
         return len(jobs)
@@ -313,9 +367,17 @@ class Batcher:
             # benorlint: allow-broad-except — the failed batch's jobs
             # already carry their error events (step's boundary); the
             # worker loop must survive to serve every OTHER tenant
-            except Exception:  # noqa: BLE001
+            except Exception as e:  # noqa: BLE001
                 import traceback
-                traceback.print_exc()
+                REGISTRY.counter("serve.batch_errors").inc()
+                snap = {
+                    "error": f"{type(e).__name__}: {e}",
+                    "ts": time.time(),
+                    "traceback": traceback.format_exc(limit=20),
+                }
+                with self._cv:
+                    self.batch_errors += 1
+                    self.last_error = snap
 
     def close(self) -> None:
         with self._cv:
@@ -378,7 +440,6 @@ class Batcher:
         for job in jobs:
             # state already claimed as 'running' under the job lock in
             # step() — this is the announcement, not the transition
-            job.started_t = t_start
             job.publish("running", {"job": job.id, "batch": len(jobs)})
         # host-side slot prep: run_point's exact inputs, per job
         cfgs = [j.cfg for j in jobs]
@@ -399,14 +460,21 @@ class Batcher:
                     DynParams.stack(cfgs_p),
                     jnp.asarray([c.seed for c in cfgs_p], jnp.int32))
             ex = self._executor(key, capacity, cfgs[0], args)
+            t_launch = time.perf_counter()
+            for job in jobs:
+                job.stamp("launch_start", t_launch)
             with REGISTRY.timer("serve.launch").time():
                 *summ, _fin = ex.artifact.compiled(*args)
                 out = [np.asarray(o) for o in summ]     # fetch = barrier
             del _fin
+            t_fetched = time.perf_counter()
+            for job in jobs:
+                job.stamp("launch_end", t_fetched)
             raws = [[o[i] for o in out] for i in range(len(jobs))]
         else:
             # quorum-specialized bucket (pallas kernels / exact tables /
             # dense top-k masks): capacity-1 launches, warm across seeds
+            capacity, pad = 1, 0
             ex = None
             raws = []
             for job, st, fl, c in zip(jobs, states, faults, cfgs):
@@ -417,23 +485,37 @@ class Batcher:
                               killed=jnp.array(st.killed))
                 args = (st, fl, jnp.int32(c.seed))
                 ex = self._executor(key, 1, c, args)
+                job.stamp("launch_start")
                 with REGISTRY.timer("serve.launch").time():
                     *summ, _fin = ex.artifact.compiled(*args)
                     raws.append([np.asarray(o) for o in summ])
                 del _fin
+                job.stamp("launch_end")
                 ex.launches += 1
                 self.launches += 1
         if kind == "dyn":
             ex.launches += 1
             self.launches += 1
         launch_s = time.perf_counter() - t_start
-        REGISTRY.counter("serve.launches").inc(
-            1 if kind == "dyn" else len(jobs))
+        n_launches = 1 if kind == "dyn" else len(jobs)
+        REGISTRY.counter("serve.launches").inc(n_launches)
+        # batch occupancy/pad sampled per batch: how much of the rung
+        # capacity this batch actually used vs repeated pad slots.  The
+        # slot denominator is the DISPATCHED capacity — one padded rung
+        # for dyn, len(jobs) sequential capacity-1 launches for a
+        # quorum-specialized bucket (whose occupancy is 1.0 by
+        # construction, never an impossible >100%)
+        slots = capacity if kind == "dyn" else len(jobs)
+        REGISTRY.gauge("serve.batch_occupancy").set(len(jobs) / slots)
+        REGISTRY.gauge("serve.batch_pad_ratio").set(pad / slots)
+        self._emit_batch_spans(key, jobs, capacity, pad, slots,
+                               n_launches, t_start)
 
         # -- result slices, one per batch slot ----------------------------
         from ..sweep import point_from_raw
         for job, vals, fl in zip(jobs, raws, faults):
             point = point_from_raw(job.cfg, vals, launch_s / len(jobs))
+            job.stamp("result_sliced")
             self._publish_result(job, point, fl, len(jobs))
         self.jobs_completed += len(jobs)
         done = self.jobs_completed
@@ -441,6 +523,40 @@ class Batcher:
         if self.launches:
             REGISTRY.gauge("serve.jobs_per_launch").set(
                 done / self.launches)
+
+    def _emit_batch_spans(self, key, jobs: List[Job], capacity: int,
+                          pad: int, slots: int, n_launches: int,
+                          t_start: float) -> None:
+        """One batch-level span per drained batch (coalesce window, pad
+        ratio, capacity rung, launch count — 1 padded rung for dyn,
+        len(jobs) sequential capacity-1 launches for a
+        quorum-specialized bucket), flow-linked to each job slot it
+        carried — the Perfetto arrow from the launch to the jobs it
+        amortized over.  No-op unless the SPANS plane is enabled."""
+        if not SPANS.enabled:
+            return
+        t_end = time.perf_counter()
+        enq = [j.stamps.get("enqueued") for j in jobs]
+        enq = [t for t in enq if t is not None]
+        # coalesce window: how long the OLDEST slot waited for the batch
+        # to form — the submit-to-launch spread coalescing trades for
+        coalesce_s = (t_start - min(enq)) if enq else 0.0
+        flows = []
+        for job in jobs:
+            job._flow = SPANS.new_flow()
+            flows.append(job._flow)
+        SPANS.add(
+            f"batch {key[0]} c{capacity}",
+            perf_to_epoch(t_start), t_end - t_start,
+            track="serve.batcher", flow_out=flows,
+            args={"jobs": len(jobs), "capacity": capacity, "pad": pad,
+                  "launches": n_launches,
+                  "pad_ratio": round(pad / slots, 4),
+                  "occupancy": round(len(jobs) / slots, 4),
+                  "coalesce_window_s": round(max(0.0, coalesce_s), 6),
+                  "queue_depth_at_drain":
+                      REGISTRY.gauge("serve.queue_depth").value,
+                  "job_ids": [j.id for j in jobs]})
 
     def _publish_result(self, job: Job, point, faults,
                         batch_jobs: int) -> None:
@@ -476,9 +592,15 @@ class Batcher:
         job.result = res
         job.launch_jobs = batch_jobs
         job.state = "done"
-        job.done_t = time.perf_counter()
+        job.stamp("done")
         job.publish("result", res)
         job.publish("done", {"job": job.id})
+        # a job nobody is streaming gets its spans here; a streamed job
+        # (the flag is set BEFORE enqueue, so this cannot race the SSE
+        # leg's waiter registration) waits for server._forward_events
+        # to emit after its last write, stream-out stage attributed
+        if SPANS.enabled and not job._streamed and not job._waiters:
+            emit_job_spans(job)
 
     # -- stats ------------------------------------------------------------
     def executors_snapshot(self):
@@ -502,7 +624,43 @@ class Batcher:
                 "executor_compiles": self.executor_compiles,
                 "buckets_live": len(self._queues),
                 "max_batch_jobs": self.max_batch_jobs,
+                "batch_errors": self.batch_errors,
+                "last_error": self.last_error,
             }
+
+
+def emit_job_spans(job: Job) -> None:
+    """Render one job's stamp timeline as Perfetto spans: a whole-job
+    parent span plus one child span per attributed stage on the job's
+    own track (time containment nests them), the launch stage carrying
+    the batch's flow link so the arrow from ``serve.batcher``'s launch
+    slice lands on this job.  At most once per job; ownership is
+    decided at SUBMIT time (``Job._streamed``) — a streamed job's spans
+    are emitted by the SSE leg after its last write (stream-out stage
+    included, done re-stamped at delivery), everything else by the
+    result-publish path.  No-op with tracing off."""
+    if not SPANS.enabled:
+        return
+    with job._lock:
+        if job._spans_emitted:
+            return
+        job._spans_emitted = True
+        stamps = dict(job.stamps)
+    acc, done = stamps.get("accepted"), stamps.get("done")
+    if acc is None or done is None:
+        return
+    track = f"job {job.id}"
+    parent = SPANS.add(
+        f"{job.spec.kind} {job.id}", perf_to_epoch(acc),
+        done - acc, track=track,
+        args={"bucket": job.bucket[0], "state": job.state,
+              "batch_jobs": job.launch_jobs})
+    for name, a, b in STAGES:
+        if a in stamps and b in stamps:
+            SPANS.add(name, perf_to_epoch(stamps[a]),
+                      max(0.0, stamps[b] - stamps[a]), track=track,
+                      parent_id=parent,
+                      flow_in=job._flow if name == "launch" else None)
 
 
 # --------------------------------------------------------------------------

@@ -22,10 +22,28 @@ What gates by default (structural, machine-insensitive):
                                collapse (the request plane degenerated
                                to per-job dispatch); otherwise it bands
                                at ``COALESCING_BAND`` of baseline.
+  * ``attribution.ok``         servescope's completeness cross-check:
+                               the per-stage means must telescope to
+                               the client mean latency within
+                               ``ATTRIBUTION_BAND``.  A manifest whose
+                               attribution broke is hiding where the
+                               time went — structural, so it gates
+                               unconditionally.
+  * stage p99s                 ``stages.queue_wait.p99`` and
+                               ``stages.launch.p99`` band against the
+                               baseline at ``STAGE_P99_BANDS`` (a
+                               generous ratio, and only when the
+                               regression exceeds
+                               ``MIN_STAGE_DELTA_MS`` — these are the
+                               two stages whose blowups are SERVING
+                               bugs, a starved batcher or a collapsed
+                               executor, rather than machine noise).
 
 Wall-clock metrics (p50/p99 latency, throughput) are carried for trend
 reading and gate only under an explicit ``timing_band`` — shared CI
 machines make them noisy, exactly like the perf gate's stage timings.
+The two default-gated stage p99s trade that caution for coverage via
+the wide band + absolute-delta floor.
 
 Comparability (exit 3, never a confident verdict): kind/schema_version
 mismatch, different platform, different job scale block, or a manifest
@@ -42,8 +60,24 @@ from typing import Dict, List, Optional
 #: coalescing regression.
 COALESCING_BAND = 0.8
 
-#: Schema version this comparator understands.
-SCHEMA_VERSION = 1
+#: How far the stage-mean sum may drift from the client mean latency
+#: before the attribution is considered incomplete (|coverage-1| <=
+#: band).  The slack absorbs what the server legitimately cannot stamp:
+#: connection setup and the wire time outside accepted->done.
+ATTRIBUTION_BAND = 0.25
+
+#: Default stage-p99 ceilings vs baseline: new_p99 regresses when it
+#: exceeds band x baseline AND the delta clears MIN_STAGE_DELTA_MS.
+STAGE_P99_BANDS = {"queue_wait": 2.0, "launch": 2.0}
+
+#: Absolute floor under which a stage-p99 blowup is ignored (2x of
+#: nothing is noise, not a regression).
+MIN_STAGE_DELTA_MS = 50.0
+
+#: Schema version this comparator understands (v2 = stage latencies +
+#: attribution; a v1 manifest predates servescope and cannot be gated
+#: honestly against a v2 baseline).
+SCHEMA_VERSION = 2
 
 
 class IncomparableServe(Exception):
@@ -75,7 +109,8 @@ def _require(manifest: Dict, name: str) -> Dict:
 
 def compare_serve(manifest: Dict, baseline: Dict,
                   coalescing_band: float = COALESCING_BAND,
-                  timing_band: Optional[float] = None
+                  timing_band: Optional[float] = None,
+                  stage_bands: Optional[Dict[str, float]] = None
                   ) -> List[ServeFinding]:
     """New manifest vs baseline -> regression findings (empty = in-band).
 
@@ -125,6 +160,30 @@ def compare_serve(manifest: Dict, baseline: Dict,
             "jobs_per_launch",
             f"coalescing {new_jpl:.3f} < {coalescing_band} x baseline "
             f"{base_jpl:.3f} jobs/launch"))
+    attr = manifest.get("attribution") or {}
+    if not attr.get("ok", False):
+        findings.append(ServeFinding(
+            "attribution",
+            f"stage attribution incomplete: stage means sum to "
+            f"{attr.get('stage_mean_sum_ms')} ms vs client mean "
+            f"{attr.get('client_mean_ms')} ms (coverage "
+            f"{attr.get('coverage')}, band {attr.get('band')}) — a "
+            f"transition went unstamped, the timeline is lying by "
+            f"omission"))
+    for stage, band in (STAGE_P99_BANDS if stage_bands is None
+                        else stage_bands).items():
+        new_p99 = float((manifest.get("stages") or {})
+                        .get(stage, {}).get("p99") or 0.0)
+        base_p99 = float((baseline.get("stages") or {})
+                         .get(stage, {}).get("p99") or 0.0)
+        if (new_p99 > base_p99 * band
+                and new_p99 - base_p99 > MIN_STAGE_DELTA_MS):
+            findings.append(ServeFinding(
+                f"stages.{stage}.p99",
+                f"{stage} p99 {new_p99:.1f} ms > {band} x baseline "
+                f"{base_p99:.1f} ms (delta over the "
+                f"{MIN_STAGE_DELTA_MS:.0f} ms noise floor) — the "
+                f"request plane's {stage} stage regressed"))
     if timing_band is not None:
         thr = float(manifest.get("throughput_jobs_per_sec") or 0.0)
         base_thr = float(baseline.get("throughput_jobs_per_sec") or 0.0)

@@ -49,6 +49,83 @@ CONFIG_FIELDS = ("n_nodes", "n_faulty", "trials", "max_rounds", "rule",
 #: The four client verbs.
 JOB_KINDS = ("simulate", "sweep", "trajectory", "audit")
 
+#: servescope's NINE job stamps, in transition order (README Serving's
+#: stage model).  Every stamp is a host-side ``time.perf_counter()``
+#: float taken at the transition — the batcher owns accepted through
+#: result_sliced and the terminal done; the HTTP front door refines the
+#: stream leg (``first_sse`` = the first result-phase event written to
+#: the client, and it re-stamps ``done`` when the job's whole SSE feed
+#: has been written, so stream-out time is attributed to the job).
+STAGE_STAMPS = ("accepted", "validated", "enqueued", "batch_assigned",
+                "launch_start", "launch_end", "result_sliced",
+                "first_sse", "done")
+
+#: The stage-latency attribution: name -> (from_stamp, to_stamp).
+#: Stages are CONSECUTIVE stamp pairs, so their durations TELESCOPE —
+#: when every stamp is present, the stage sum equals done - accepted
+#: exactly, which is what makes the manifest's attribution
+#: cross-check (stage means vs client mean latency) an honest
+#: completeness test instead of an approximation.  ``first_sse`` is a
+#: sub-milestone INSIDE stream_out (reported by the timing route as
+#: stream_wait/stream_flush when present) so that a polled, never-
+#: streamed job still attributes its full result_sliced -> done time.
+STAGES = (
+    ("validate", "accepted", "validated"),
+    ("enqueue", "validated", "enqueued"),
+    ("queue_wait", "enqueued", "batch_assigned"),
+    ("batch_assemble", "batch_assigned", "launch_start"),
+    ("launch", "launch_start", "launch_end"),
+    ("result_slice", "launch_end", "result_sliced"),
+    ("stream_out", "result_sliced", "done"),
+)
+
+#: Stage names in stage order (the manifest's ``stages`` block keys).
+STAGE_NAMES = tuple(name for name, _, _ in STAGES)
+
+#: stream_out's optional subdivision at the first_sse milestone.
+SUB_STAGES = (
+    ("stream_wait", "result_sliced", "first_sse"),
+    ("stream_flush", "first_sse", "done"),
+)
+
+
+def stage_durations(stamps: Dict[str, float]) -> Dict[str, float]:
+    """Stamps -> per-stage seconds (only stages whose BOTH stamps are
+    present; negatives clamped to zero — a stamp pair that raced, e.g.
+    a server-side done refinement landing before a slow result slice,
+    must never produce negative attribution)."""
+    out: Dict[str, float] = {}
+    for name, a, b in STAGES:
+        if a in stamps and b in stamps:
+            out[name] = max(0.0, stamps[b] - stamps[a])
+    return out
+
+
+def timing_dict(stamps: Dict[str, float]) -> Dict[str, Any]:
+    """The ``/v1/jobs/<id>/timing`` payload: per-stage seconds, the
+    stream sub-stages when the job streamed, each stamp relative to
+    ``accepted`` (absolute perf_counter values are meaningless across
+    processes), and the fully-attributed total.  Values are rounded to
+    6 dp INDEPENDENTLY, so the telescoping identity holds to ~N*0.5e-6
+    in the payload (exact on the raw stamps) — consumers comparing
+    sum-of-stages to total_s must allow that rounding slack."""
+    stages = stage_durations(stamps)
+    subs = {name: max(0.0, stamps[b] - stamps[a])
+            for name, a, b in SUB_STAGES
+            if a in stamps and b in stamps}
+    acc = stamps.get("accepted")
+    rel = {k: round(stamps[k] - acc, 6) for k in STAGE_STAMPS
+           if k in stamps} if acc is not None else {}
+    total = None
+    if acc is not None and "done" in stamps:
+        total = round(stamps["done"] - acc, 6)
+    return {
+        "stages_s": {k: round(v, 6) for k, v in stages.items()},
+        "sub_stages_s": {k: round(v, 6) for k, v in subs.items()},
+        "stamps_rel_s": rel,
+        "total_s": total,
+    }
+
 #: Per-job ceilings for the DEMO-scale request plane: one over-sized job
 #: would occupy a whole static-shape bucket and starve the coalescing
 #: that makes serving pay (README Serving's cost model).  Operators

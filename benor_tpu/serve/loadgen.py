@@ -14,6 +14,16 @@ p50/p99/mean/max latency, saturation throughput (completed jobs over
 the measurement wall-clock), and the **coalescing efficiency** —
 jobs per executable launch, read from the server's /v1/stats delta —
 plus the scale block that makes two manifests comparable.
+
+Schema v2 adds servescope's **stage-latency attribution**: each client
+captures its job id from the SSE ``queued`` event, the driver fetches
+every job's ``/v1/jobs/<id>/timing`` after the measured window, and the
+manifest carries per-stage p50/p99/mean blocks (jobs.STAGE_NAMES) plus
+the ``attribution`` cross-check — the stage MEANS must sum to within
+``gate.ATTRIBUTION_BAND`` of the client-observed mean latency, because
+the stages are consecutive stamp deltas that telescope to the server's
+accepted->done total; a sum that falls short means a transition went
+unstamped and the attribution is lying by omission.
 ``tools/check_serve_regression.py`` bands it against the committed
 SERVE_BASELINE.json (serve/gate.py owns the rules; stdlib-only so CI
 gates without a backend).
@@ -29,6 +39,8 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..utils.metrics import REGISTRY
+from .gate import ATTRIBUTION_BAND
+from .jobs import STAGE_NAMES
 
 #: The default per-client job: a dyn-bucket config (delivery='all',
 #: crash faults, uniform scheduler — no quorum-specialized shapes), so
@@ -38,8 +50,13 @@ from ..utils.metrics import REGISTRY
 DEFAULT_JOB = {"kind": "simulate", "n_nodes": 32, "n_faulty": 4,
                "trials": 8, "max_rounds": 16, "delivery": "all"}
 
-#: Manifest schema version (tools/serve_manifest_schema.json).
-SCHEMA_VERSION = 1
+#: Manifest schema version (tools/serve_manifest_schema.json).  v2:
+#: per-stage latency blocks + the attribution cross-check.
+SCHEMA_VERSION = 2
+
+#: Concurrency ceiling for the post-window timing fetches (one GET per
+#: completed job; bounded so the fetch phase is not its own load test).
+TIMING_FETCH_CONCURRENCY = 128
 
 
 def _raise_fd_limit(need: int) -> None:
@@ -57,14 +74,17 @@ def _raise_fd_limit(need: int) -> None:
 
 async def _client(host: str, port: int, body: bytes,
                   timeout: float) -> Dict:
-    """One client: POST + SSE read to completion -> {latency_s, ok}."""
+    """One client: POST + SSE read to completion -> {latency_s, ok,
+    jobs} — the job ids captured from the stream's ``queued`` events
+    feed the post-window ``/v1/jobs/<id>/timing`` attribution fetch."""
     t0 = time.perf_counter()
     try:
         reader, writer = await asyncio.open_connection(host, port)
     except OSError as e:
-        return {"ok": False, "error": f"connect: {e}",
+        return {"ok": False, "error": f"connect: {e}", "jobs": [],
                 "latency_s": time.perf_counter() - t0}
     ok, err = False, None
+    jobs: List[str] = []
     try:
         writer.write(
             b"POST /v1/jobs?stream=sse HTTP/1.1\r\n"
@@ -81,6 +101,7 @@ async def _client(host: str, port: int, body: bytes,
                 err += f": {body_txt}"
         else:
             deadline = time.perf_counter() + timeout
+            pending = None          # event name awaiting its data line
             while True:
                 line = await asyncio.wait_for(
                     reader.readline(),
@@ -94,6 +115,15 @@ async def _client(host: str, port: int, body: bytes,
                 if line.startswith(b"event: error"):
                     err = "server error event"
                     break
+                if line.startswith(b"event: "):
+                    pending = line[len(b"event: "):].strip()
+                elif line.startswith(b"data: ") and pending == b"queued":
+                    try:
+                        jobs.append(json.loads(line[len(b"data: "):])
+                                    ["job"])
+                    except (ValueError, KeyError):
+                        pass
+                    pending = None
     except (asyncio.TimeoutError, ConnectionError,
             asyncio.IncompleteReadError) as e:
         err = f"{type(e).__name__}: {e}"
@@ -104,7 +134,7 @@ async def _client(host: str, port: int, body: bytes,
             pass
     lat = time.perf_counter() - t0
     REGISTRY.timer("serve.client_latency").record(lat)
-    return {"ok": ok, "error": err, "latency_s": lat}
+    return {"ok": ok, "error": err, "jobs": jobs, "latency_s": lat}
 
 
 async def _get_json(host: str, port: int, path: str,
@@ -152,8 +182,62 @@ async def _drive(host: str, port: int, clients: int, job: Dict,
     results = await asyncio.gather(*(one(i) for i in range(clients)))
     wall = time.perf_counter() - t0
     stats1 = await _get_json(host, port, "/v1/stats")
+    # attribution fetch: every completed job's stage timeline, OUTSIDE
+    # the measured window (the wall clock above is already closed)
+    timings = await _fetch_timings(
+        host, port, [j for r in results for j in r["jobs"]])
     return {"results": results, "wall_s": wall,
-            "stats0": stats0, "stats1": stats1}
+            "stats0": stats0, "stats1": stats1, "timings": timings}
+
+
+async def _fetch_timings(host: str, port: int,
+                         job_ids: List[str]) -> List[Dict]:
+    """GET /v1/jobs/<id>/timing for each id (bounded concurrency);
+    unreachable/errored fetches are dropped, not fabricated."""
+    sem = asyncio.Semaphore(TIMING_FETCH_CONCURRENCY)
+
+    async def one(jid):
+        async with sem:
+            try:
+                return await _get_json(host, port,
+                                       f"/v1/jobs/{jid}/timing")
+            except (OSError, ValueError, asyncio.TimeoutError):
+                return None
+    got = await asyncio.gather(*(one(j) for j in job_ids))
+    return [t for t in got if t is not None]
+
+
+def _stage_blocks(timings: List[Dict], client_mean_ms: float) -> Dict:
+    """Per-stage p50/p99/mean blocks (ms) + the attribution cross-check.
+
+    Only fully-attributed timelines count (every jobs.STAGE_NAMES stage
+    present — an error job's partial timeline would skew the stage
+    population low and break the telescoping identity the cross-check
+    rests on); ``jobs_timed`` records the population honestly."""
+    full = [t for t in timings
+            if all(s in t.get("stages_s", {}) for s in STAGE_NAMES)]
+    stages: Dict[str, Dict[str, float]] = {}
+    mean_sum = 0.0
+    for name in STAGE_NAMES:
+        if full:
+            arr = np.asarray([t["stages_s"][name] for t in full]) * 1e3
+            blk = {"p50": round(float(np.percentile(arr, 50)), 3),
+                   "p99": round(float(np.percentile(arr, 99)), 3),
+                   "mean": round(float(arr.mean()), 3)}
+        else:
+            blk = {"p50": 0.0, "p99": 0.0, "mean": 0.0}
+        stages[name] = blk
+        mean_sum += blk["mean"]
+    coverage = (mean_sum / client_mean_ms) if client_mean_ms > 0 else 0.0
+    attribution = {
+        "jobs_timed": len(full),
+        "stage_mean_sum_ms": round(mean_sum, 3),
+        "client_mean_ms": round(client_mean_ms, 3),
+        "coverage": round(coverage, 4),
+        "band": ATTRIBUTION_BAND,
+        "ok": bool(full) and abs(coverage - 1.0) <= ATTRIBUTION_BAND,
+    }
+    return {"stages": stages, "attribution": attribution}
 
 
 def build_serve_manifest(drive: Dict, clients: int, job: Dict) -> Dict:
@@ -172,6 +256,8 @@ def build_serve_manifest(drive: Dict, clients: int, job: Dict) -> Dict:
     scale = {k: job.get(k, DEFAULT_JOB.get(k)) for k in
              ("n_nodes", "n_faulty", "trials", "max_rounds", "delivery")}
     scale["kind"] = job.get("kind", "simulate")
+    blocks = _stage_blocks(drive.get("timings", []),
+                           float(lats_ms.mean()))
     return {
         "kind": "serve_manifest",
         "schema_version": SCHEMA_VERSION,
@@ -194,6 +280,8 @@ def build_serve_manifest(drive: Dict, clients: int, job: Dict) -> Dict:
         "jobs_per_launch": round(jobs_completed / launches, 4)
         if launches else 0.0,
         "executor_compiles": s1["executor_compiles"],
+        "stages": blocks["stages"],
+        "attribution": blocks["attribution"],
         "scale": scale,
     }
 
@@ -245,4 +333,8 @@ def run_load(url: Optional[str] = None, clients: int = 1000,
     REGISTRY.gauge("serve.load_p99_ms").set(manifest["latency_ms"]["p99"])
     REGISTRY.gauge("serve.load_jobs_per_launch").set(
         manifest["jobs_per_launch"])
+    REGISTRY.gauge("serve.load_queue_wait_p99_ms").set(
+        manifest["stages"]["queue_wait"]["p99"])
+    REGISTRY.gauge("serve.load_attribution_coverage").set(
+        manifest["attribution"]["coverage"])
     return manifest

@@ -24,11 +24,20 @@ Routes (all JSON unless SSE):
          the events URL.  Malformed specs: 400 with the structured
          JobError body (field + reason), never a bare string.
     GET  /v1/jobs/<id>                 job status / result snapshot
+    GET  /v1/jobs/<id>/timing          servescope stage attribution: the
+         job's nine-stamp timeline reduced to per-stage seconds
+         (jobs.STAGES), stream sub-stages, stamps relative to accepted
     GET  /v1/jobs/<id>/events          SSE stream of one job;
          ?since_round=N resumes the round feed past a cursor (rows with
          round <= N are skipped — the HTTP /getRoundHistory contract,
          pushed instead of polled).  Last-Event-ID is honored as the
          same cursor on reconnect.
+
+Every response carries an ``X-Request-Id`` header — the client's own
+(echoed when it is a sane correlation token) or a server-minted one —
+and, when the servescope span plane is armed (``SPANS.enable()``, the
+CLI's ``--trace-out``), each request lands as an ``http``-track span in
+the Perfetto export next to the batcher's batch/job spans.
 
 A client that disconnects mid-stream FREES its batch slot: the read
 side of the connection is watched concurrently with the event
@@ -48,13 +57,16 @@ from __future__ import annotations
 
 import asyncio
 import json
+import re
 import threading
+import time
+import uuid
 from typing import Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlsplit
 
-from ..utils.metrics import REGISTRY
-from .batcher import Batcher, Job
-from .jobs import JobError
+from ..utils.metrics import REGISTRY, SPANS, perf_to_epoch
+from .batcher import Batcher, Job, emit_job_spans
+from .jobs import JobError, timing_dict
 
 #: Request caps: the request plane parses untrusted bytes.
 MAX_HEADERS = 64
@@ -66,12 +78,29 @@ KEEPALIVE_S = 10.0
 
 _JSON = "application/json"
 
+#: A client-supplied X-Request-Id is echoed VERBATIM only when it looks
+#: like a sane correlation token; anything else (header-injection bytes,
+#: unbounded length) is replaced by a server-minted id.
+_REQ_ID_OK = re.compile(r"^[A-Za-z0-9_.:-]{1,64}$")
+
+
+def _request_id(headers: Dict[str, str]) -> str:
+    rid = headers.get("x-request-id", "")
+    if _REQ_ID_OK.match(rid):
+        return rid
+    return f"r-{uuid.uuid4().hex[:16]}"
+
 
 class _BadRequest(Exception):
-    def __init__(self, body: dict, code: int = 400):
+    def __init__(self, body: dict, code: int = 400,
+                 headers: Optional[Dict[str, str]] = None):
         super().__init__(body.get("error", "bad request"))
         self.body = body
         self.code = code
+        #: Whatever request headers were parsed before the rejection —
+        #: lets the error response still echo the client's
+        #: X-Request-Id (the correlation matters MOST on errors).
+        self.headers = headers or {}
 
 
 def _sse_bytes(etype: str, payload, eid=None) -> bytes:
@@ -180,15 +209,18 @@ class ServeApp:
             if h in (b"\r\n", b"\n", b""):
                 break
             if len(headers) >= MAX_HEADERS:
-                raise _BadRequest({"error": "too many headers"})
+                raise _BadRequest({"error": "too many headers"},
+                                  headers=headers)
             k, _, v = h.decode("latin1").partition(":")
             headers[k.strip().lower()] = v.strip()
         try:
             length = int(headers.get("content-length", "0") or 0)
         except ValueError:
-            raise _BadRequest({"error": "malformed Content-Length"})
+            raise _BadRequest({"error": "malformed Content-Length"},
+                              headers=headers)
         if length < 0 or length > MAX_BODY:
-            raise _BadRequest({"error": "body too large"}, code=413)
+            raise _BadRequest({"error": "body too large"}, code=413,
+                              headers=headers)
         body = b""
         if length:
             body = await asyncio.wait_for(reader.readexactly(length),
@@ -198,29 +230,40 @@ class ServeApp:
         return method, url.path, query, headers, body
 
     async def _respond(self, writer, code: int, body: dict,
-                       content_type: str = _JSON) -> None:
+                       content_type: str = _JSON,
+                       req_id: Optional[str] = None) -> None:
         data = json.dumps(body).encode()
         reason = {200: "OK", 202: "Accepted", 400: "Bad Request",
                   404: "Not Found", 405: "Method Not Allowed",
                   413: "Payload Too Large",
                   500: "Internal Server Error"}.get(code, "OK")
+        rid = f"X-Request-Id: {req_id}\r\n" if req_id else ""
         head = (f"HTTP/1.1 {code} {reason}\r\n"
                 f"Content-Type: {content_type}\r\n"
-                f"Content-Length: {len(data)}\r\n"
+                f"Content-Length: {len(data)}\r\n{rid}"
                 f"Connection: close\r\n\r\n")
         writer.write(head.encode() + data)
         await writer.drain()
 
     async def _handle(self, reader, writer) -> None:
         REGISTRY.counter("serve.http_requests").inc()
+        t_req = time.perf_counter()
+        rid: Optional[str] = None
+        method = path = "?"
         try:
             req = await self._read_request(reader)
             if req is None:
                 return
             method, path, query, headers, body = req
+            rid = _request_id(headers)
             await self._route(reader, writer, method, path, query,
-                              headers, body)
+                              headers, body, rid, accepted_t=t_req)
         except _BadRequest as e:
+            if rid is None:
+                # rejected inside _read_request: the exception carries
+                # whatever headers were parsed, so the error response
+                # still echoes the client's correlation id (or mints)
+                rid = _request_id(e.headers)
             try:
                 # drain whatever request bytes are still in flight before
                 # replying and closing: responding with unread data
@@ -229,7 +272,7 @@ class ServeApp:
                 # exact lesson, applied asyncio-side — matters most for
                 # the 413 path, which rejects on the header alone)
                 await _drain_reader(reader)
-                await self._respond(writer, e.code, e.body)
+                await self._respond(writer, e.code, e.body, req_id=rid)
             except ConnectionError:
                 pass
         except (asyncio.TimeoutError, asyncio.IncompleteReadError,
@@ -242,10 +285,15 @@ class ServeApp:
             REGISTRY.counter("serve.http_errors").inc()
             try:
                 await self._respond(
-                    writer, 500, {"error": f"{type(e).__name__}: {e}"})
+                    writer, 500, {"error": f"{type(e).__name__}: {e}"},
+                    req_id=rid or _request_id({}))
             except ConnectionError:
                 pass
         finally:
+            if SPANS.enabled:
+                SPANS.add(f"{method} {path}", perf_to_epoch(t_req),
+                          time.perf_counter() - t_req, track="http",
+                          args={"request_id": rid or "?"})
             try:
                 writer.close()
                 await writer.wait_closed()
@@ -253,18 +301,20 @@ class ServeApp:
                 pass
 
     async def _route(self, reader, writer, method, path, query, headers,
-                     body) -> None:
+                     body, req_id: Optional[str] = None,
+                     accepted_t: Optional[float] = None) -> None:
         if path == "/healthz":
-            await self._respond(writer, 200, {"ok": True})
+            await self._respond(writer, 200, {"ok": True}, req_id=req_id)
             return
         if path == "/v1/stats":
-            await self._respond(writer, 200, self._stats())
+            await self._respond(writer, 200, self._stats(), req_id=req_id)
             return
         if path == "/v1/jobs":
             if method != "POST":
                 raise _BadRequest({"error": "submit jobs with POST"},
                                   code=405)
-            await self._submit(reader, writer, query, headers, body)
+            await self._submit(reader, writer, query, headers, body,
+                               req_id, accepted_t)
             return
         if path.startswith("/v1/jobs/"):
             rest = path[len("/v1/jobs/"):]
@@ -272,18 +322,25 @@ class ServeApp:
             job = self.batcher.get(job_id)
             if job is None:
                 await self._respond(writer, 404,
-                                    {"error": f"no job {job_id!r}"})
+                                    {"error": f"no job {job_id!r}"},
+                                    req_id=req_id)
                 return
             if tail == "events":
                 since = _since_round(query, headers)
-                await self._stream(reader, writer, [job], since)
+                await self._stream(reader, writer, [job], since, req_id)
+            elif tail == "timing":
+                await self._respond(writer, 200, _job_timing(job),
+                                    req_id=req_id)
             elif tail == "":
-                await self._respond(writer, 200, _job_snapshot(job))
+                await self._respond(writer, 200, _job_snapshot(job),
+                                    req_id=req_id)
             else:
                 await self._respond(writer, 404,
-                                    {"error": f"no route {path}"})
+                                    {"error": f"no route {path}"},
+                                    req_id=req_id)
             return
-        await self._respond(writer, 404, {"error": f"no route {path}"})
+        await self._respond(writer, 404, {"error": f"no route {path}"},
+                            req_id=req_id)
 
     def _stats(self) -> dict:
         stats = self.batcher.stats()
@@ -297,62 +354,85 @@ class ServeApp:
         return stats
 
     # -- submit + stream --------------------------------------------------
-    async def _submit(self, reader, writer, query, headers, body) -> None:
+    async def _submit(self, reader, writer, query, headers, body,
+                      req_id: Optional[str] = None,
+                      accepted_t: Optional[float] = None) -> None:
+        # ``accepted`` anchors at HANDLER ENTRY (before the request was
+        # even read off the socket), so the validate stage attributes
+        # the ingress queueing a loaded event loop imposes between
+        # accept and parse — without it, a connect storm's wait is
+        # invisible to the stage sum and the attribution cross-check
+        # rightly fails
+        if accepted_t is None:
+            accepted_t = time.perf_counter()
         try:
             doc = json.loads(body.decode("utf-8")) if body else {}
         except (ValueError, UnicodeDecodeError):
             raise _BadRequest({"error": "invalid job",
                                "field": "$",
                                "reason": "body must be valid JSON"})
-        try:
-            jobs = self.batcher.submit_dict(doc)
-        except JobError as e:
-            raise _BadRequest(e.body)
         stream = (query.get("stream") == "sse"
                   or "text/event-stream" in headers.get("accept", ""))
+        try:
+            jobs = self.batcher.submit_dict(doc, accepted_t=accepted_t,
+                                            streamed=stream)
+        except JobError as e:
+            raise _BadRequest(e.body)
         if not stream:
             await self._respond(writer, 202, {
                 "jobs": [j.id for j in jobs],
                 "bucket": jobs[0].bucket[0],
                 "events": [f"/v1/jobs/{j.id}/events" for j in jobs],
-            })
+            }, req_id=req_id)
             return
         await self._stream(reader, writer, jobs,
-                           _since_round(query, headers))
+                           _since_round(query, headers), req_id)
 
     async def _stream(self, reader, writer, jobs: List[Job],
-                      since_round: Optional[int]) -> None:
+                      since_round: Optional[int],
+                      req_id: Optional[str] = None) -> None:
         """The SSE leg: forward each job's event feed, racing a watcher
         on the connection's read side so a vanished client cancels its
         jobs instead of holding batch slots."""
+        rid = (f"X-Request-Id: {req_id}\r\n" if req_id else "").encode()
         writer.write(b"HTTP/1.1 200 OK\r\n"
                      b"Content-Type: text/event-stream\r\n"
-                     b"Cache-Control: no-store\r\n"
+                     b"Cache-Control: no-store\r\n" + rid +
                      b"Connection: close\r\n\r\n")
-        await writer.drain()
+        # the client gauge pairs with the finally-side decrement, so the
+        # increment must cover EVERY await that can fail (the header
+        # drain included — an increment outside this try leaked a
+        # phantom client forever on a write failure there); the
+        # opened/closed counters are the monotone audit pair the gauge
+        # can be cross-checked against
         REGISTRY.gauge("serve.sse_clients").set(
             REGISTRY.gauge("serve.sse_clients").value + 1)
-        forward = asyncio.ensure_future(
-            self._forward_events(writer, jobs, since_round))
-        watch = asyncio.ensure_future(reader.read(1))
+        REGISTRY.counter("serve.sse_opened").inc()
         try:
-            done, _pending = await asyncio.wait(
-                {forward, watch}, return_when=asyncio.FIRST_COMPLETED)
-            if forward not in done or forward.exception() is not None:
-                # client hung up (or the pipe broke mid-write): free
-                # every batch slot this stream was carrying
-                for job in jobs:
-                    job.cancel()
+            await writer.drain()
+            forward = asyncio.ensure_future(
+                self._forward_events(writer, jobs, since_round))
+            watch = asyncio.ensure_future(reader.read(1))
+            try:
+                done, _pending = await asyncio.wait(
+                    {forward, watch}, return_when=asyncio.FIRST_COMPLETED)
+                if forward not in done or forward.exception() is not None:
+                    # client hung up (or the pipe broke mid-write): free
+                    # every batch slot this stream was carrying
+                    for job in jobs:
+                        job.cancel()
+            finally:
+                for task in (forward, watch):
+                    task.cancel()
+                    try:
+                        await task
+                    except (asyncio.CancelledError, ConnectionError,
+                            asyncio.IncompleteReadError):
+                        pass
         finally:
-            for task in (forward, watch):
-                task.cancel()
-                try:
-                    await task
-                except (asyncio.CancelledError, ConnectionError,
-                        asyncio.IncompleteReadError):
-                    pass
             REGISTRY.gauge("serve.sse_clients").set(
                 max(0.0, REGISTRY.gauge("serve.sse_clients").value - 1))
+            REGISTRY.counter("serve.sse_closed").inc()
 
     async def _forward_events(self, writer, jobs: List[Job],
                               since_round: Optional[int]) -> None:
@@ -370,6 +450,16 @@ class ServeApp:
                     eid = payload.get("round") if etype == "round" else None
                     writer.write(_sse_bytes(etype, payload, eid=eid))
                 await writer.drain()
+                if etype in ("round", "witness", "audit", "result"):
+                    # the first RESULT-PHASE byte on the wire — the
+                    # stream_wait milestone inside stream_out (status
+                    # events like queued/running don't count: they
+                    # precede the result by construction)
+                    job.stamp("first_sse")
+            # this job's stream leg is fully written: re-stamp done so
+            # stream_out covers SSE delivery, then render its spans
+            job.stamp("done", override=True)
+            emit_job_spans(job)
         writer.write(_sse_bytes("done", {"jobs": [j.id for j in jobs]}))
         await writer.drain()
 
@@ -406,6 +496,19 @@ def _job_snapshot(job: Job) -> dict:
             "bucket": job.bucket[0], "result": job.result,
             "error": job.error,
             "events_url": f"/v1/jobs/{job.id}/events"}
+
+
+def _job_timing(job: Job) -> dict:
+    """GET /v1/jobs/<id>/timing: the job's servescope timeline — each
+    stage's attributed seconds, the stream sub-stages when it streamed,
+    stamps relative to accepted, and the launch's batch size (how many
+    slots amortized the launch this job rode)."""
+    with job._lock:
+        stamps = dict(job.stamps)
+    out = {"job": job.id, "state": job.state, "kind": job.spec.kind,
+           "batch_jobs": job.launch_jobs}
+    out.update(timing_dict(stamps))
+    return out
 
 
 async def _job_events(job: Job, since_round: Optional[int]):
@@ -454,10 +557,23 @@ async def _amain(host: str, port: int, max_batch_jobs: Optional[int],
 
 
 def run_server(host: str = "127.0.0.1", port: int = 8400,
-               max_batch_jobs: Optional[int] = None) -> int:
-    """`python -m benor_tpu serve` body: serve until interrupted."""
+               max_batch_jobs: Optional[int] = None,
+               trace_out: Optional[str] = None) -> int:
+    """`python -m benor_tpu serve` body: serve until interrupted.
+    ``trace_out`` arms the servescope span plane for the server's
+    lifetime and writes the Perfetto trace on shutdown."""
+    if trace_out:
+        SPANS.enable()
     try:
         asyncio.run(_amain(host, port, max_batch_jobs))
     except KeyboardInterrupt:
         pass
+    finally:
+        if trace_out:
+            from ..utils.metrics import export_chrome_trace
+            import sys
+            n = export_chrome_trace(trace_out, spans=True)
+            print(f"wrote {n} trace events to {trace_out} "
+                  f"(open in ui.perfetto.dev)", file=sys.stderr,
+                  flush=True)
     return 0

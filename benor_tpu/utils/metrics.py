@@ -24,12 +24,13 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import itertools
 import json
 import os
 import re
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
@@ -165,6 +166,120 @@ class MetricsRegistry:
 
 #: The process-wide registry every instrumented module feeds.
 REGISTRY = MetricsRegistry()
+
+
+# --------------------------------------------------------------------------
+# Spans (servescope): request-scoped tracing for the serve plane
+# --------------------------------------------------------------------------
+
+#: Anchor for converting ``time.perf_counter()`` stamps (the serve
+#: plane's stage clocks — monotonic, comparable across threads) into
+#: wall-clock epoch seconds for the Chrome-trace timeline.  Captured
+#: once at import so every span shares one consistent offset.
+_PERF_EPOCH = time.time() - time.perf_counter()
+
+
+def perf_to_epoch(t_perf: float) -> float:
+    """A ``time.perf_counter()`` stamp -> epoch seconds (trace domain)."""
+    return t_perf + _PERF_EPOCH
+
+
+@dataclasses.dataclass
+class Span:
+    """One traced interval: explicit start/duration (seconds, epoch
+    domain — use :func:`perf_to_epoch` on perf_counter stamps),
+    parent/child structure via ``parent_id`` and Perfetto flow links via
+    ``flow_in``/``flow_out`` (flow ids BEGIN at this span / TERMINATE at
+    this span — how a batch-level span points at the job slots it
+    carried).  ``track`` is the trace row (Chrome-trace ``tid``)."""
+
+    name: str
+    start: float
+    dur_s: float
+    track: str = "host"
+    span_id: int = 0
+    parent_id: Optional[int] = None
+    flow_in: Tuple[int, ...] = ()
+    flow_out: Tuple[int, ...] = ()
+    args: Dict = dataclasses.field(default_factory=dict)
+
+
+def _as_ids(v: Union[None, int, Tuple[int, ...], List[int]]) -> Tuple:
+    if v is None:
+        return ()
+    if isinstance(v, int):
+        return (v,)
+    return tuple(v)
+
+
+class SpanLog:
+    """The process-wide span plane.  DISABLED by default: ``add`` is a
+    no-op returning 0, so instrumented code paths (the serve batcher,
+    the HTTP front door) pay one attribute read when tracing is off —
+    and, because spans only ever consume host-side ``perf_counter``
+    stamps that are taken regardless, tracing on/off is bit-identical
+    in device results AND compile counts (tests/test_servescope.py pins
+    it, the flight-recorder house rule applied to the host plane).
+
+    ``cap`` bounds retained spans so a long-lived server with tracing
+    enabled cannot grow without limit; overflow increments ``dropped``
+    (surfaced in the export) instead of silently evicting."""
+
+    def __init__(self, cap: int = 200_000):
+        self.enabled = False
+        self.cap = cap
+        self.dropped = 0
+        self._spans: List[Span] = []
+        self._ids = itertools.count(1)
+        self._flows = itertools.count(1)
+        self._lock = threading.Lock()
+
+    def enable(self) -> "SpanLog":
+        self.enabled = True
+        return self
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self.dropped = 0
+
+    def new_flow(self) -> int:
+        """A fresh flow id (links an emitting span to consumers)."""
+        return next(self._flows)
+
+    def add(self, name: str, start: float, dur_s: float, *,
+            track: str = "host", parent_id: Optional[int] = None,
+            flow_in=None, flow_out=None,
+            args: Optional[Dict] = None) -> int:
+        """Record one span; returns its span id (0 when disabled)."""
+        if not self.enabled:
+            return 0
+        span = Span(name=name, start=start, dur_s=max(0.0, dur_s),
+                    track=track, span_id=next(self._ids),
+                    parent_id=parent_id, flow_in=_as_ids(flow_in),
+                    flow_out=_as_ids(flow_out), args=dict(args or {}))
+        with self._lock:
+            if len(self._spans) >= self.cap:
+                self.dropped += 1
+                return 0
+            self._spans.append(span)
+        return span.span_id
+
+    def snapshot(self) -> List[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+
+#: The process-wide span log (off until ``SPANS.enable()`` — e.g. the
+#: CLI's ``serve/load --trace-out``).
+SPANS = SpanLog()
 
 
 # --------------------------------------------------------------------------
@@ -348,7 +463,7 @@ def export_prometheus(path: str, registry: MetricsRegistry = None,
 def export_chrome_trace(path: str, registry: MetricsRegistry = None,
                         round_history=None,
                         rounds_label: str = "consensus",
-                        witness=None) -> int:
+                        witness=None, spans=None) -> int:
     """Write a Chrome-trace/Perfetto JSON file; returns the event count.
 
     Timer spans land on pid 0 / tid "host" as complete ("X") events at
@@ -363,11 +478,19 @@ def export_chrome_trace(path: str, registry: MetricsRegistry = None,
     lane's full evidence row (value, decided/killed/coined bits, p/v
     tallies) — the flight recorder's aggregates and the per-node
     forensics line up round for round.  Counters/gauges become metadata
-    counter events.  Open in https://ui.perfetto.dev or
-    chrome://tracing; ``jax.profiler.trace`` captures of the same run
-    sit alongside as separate tracks when loaded together.
+    counter events.  ``spans`` renders a servescope span set (``True``
+    for the process-wide :data:`SPANS` log, or an explicit Span list):
+    each span is a complete event on its own track, parent ids ride in
+    ``args``, and ``flow_out``/``flow_in`` ids become Chrome-trace flow
+    start ("s") / finish ("f") event pairs — Perfetto draws the arrow
+    from a batch launch to every job slot it carried.  Open in
+    https://ui.perfetto.dev or chrome://tracing; ``jax.profiler.trace``
+    captures of the same run sit alongside as separate tracks when
+    loaded together.
     """
     registry = REGISTRY if registry is None else registry
+    if spans is True:
+        spans = SPANS.snapshot()
     events = []
     t0 = None
     snap = registry.snapshot()
@@ -378,6 +501,8 @@ def export_chrome_trace(path: str, registry: MetricsRegistry = None,
     for _, evs in timers:
         for start, _ in evs:
             t0 = start if t0 is None else min(t0, start)
+    for sp in spans or ():
+        t0 = sp.start if t0 is None else min(t0, sp.start)
     t0 = t0 or time.time()
     for name, evs in timers:
         for start, dur in evs:
@@ -421,6 +546,30 @@ def export_chrome_trace(path: str, registry: MetricsRegistry = None,
                 "args": {k: v for k, v in row.items()
                          if k not in ("round", "trial", "node")},
             })
+    for sp in spans or ():
+        ts = (sp.start - t0) * 1e6
+        dur = sp.dur_s * 1e6
+        args = dict(sp.args)
+        args["span_id"] = sp.span_id
+        if sp.parent_id is not None:
+            args["parent_id"] = sp.parent_id
+        events.append({"name": sp.name, "ph": "X", "pid": 0,
+                       "tid": sp.track, "ts": ts, "dur": dur,
+                       "args": args})
+        # flow arrows: an id STARTS ("s") where flow_out names it and
+        # FINISHES ("f", binding enclosing slice) where flow_in does —
+        # the s event anchors at the span start, the f at the span start
+        # too so the arrow lands on the consumer slice's left edge
+        for fid in sp.flow_out:
+            events.append({"name": "flow", "ph": "s", "id": fid,
+                           "pid": 0, "tid": sp.track, "ts": ts})
+        for fid in sp.flow_in:
+            events.append({"name": "flow", "ph": "f", "bp": "e",
+                           "id": fid, "pid": 0, "tid": sp.track,
+                           "ts": ts})
+    if spans is not None and SPANS.dropped:
+        events.append({"name": "spans_dropped", "ph": "C", "pid": 0,
+                       "ts": 0, "args": {"counter": SPANS.dropped}})
     with _EXPORT_LOCK:
         _atomic_write(path, json.dumps({"traceEvents": events,
                                         "displayTimeUnit": "ms"}))

@@ -423,14 +423,32 @@ def test_http_unknown_routes_and_stats(app):
 # --------------------------------------------------------------------------
 
 
+def _stage(p50, p99, mean) -> dict:
+    return {"p50": p50, "p99": p99, "mean": mean}
+
+
 def _manifest(**over) -> dict:
-    m = {"kind": "serve_manifest", "schema_version": 1, "platform": "cpu",
+    stages = {"validate": _stage(1.0, 2.0, 1.0),
+              "enqueue": _stage(0.1, 0.2, 0.1),
+              "queue_wait": _stage(20.0, 40.0, 20.0),
+              "batch_assemble": _stage(5.0, 10.0, 5.0),
+              "launch": _stage(8.0, 15.0, 8.0),
+              "result_slice": _stage(1.0, 2.0, 1.0),
+              "stream_out": _stage(5.0, 10.0, 5.0)}
+    # stage means sum to 40.1 of the 45.0 ms client mean: coverage
+    # 0.8911, inside the 0.25 attribution band
+    attribution = {"jobs_timed": 100, "stage_mean_sum_ms": 40.1,
+                   "client_mean_ms": 45.0,
+                   "coverage": round(40.1 / 45.0, 4),
+                   "band": 0.25, "ok": True}
+    m = {"kind": "serve_manifest", "schema_version": 2, "platform": "cpu",
          "device_kind": "cpu", "clients": 100, "jobs_submitted": 100,
          "jobs_completed": 100, "errors": 0, "duration_s": 1.5,
          "latency_ms": {"p50": 40.0, "p99": 90.0, "mean": 45.0,
                         "max": 95.0},
          "throughput_jobs_per_sec": 66.6, "launches": 5,
          "jobs_per_launch": 20.0, "executor_compiles": 2,
+         "stages": stages, "attribution": attribution,
          "scale": {"n_nodes": 32, "n_faulty": 4, "trials": 8,
                    "max_rounds": 16, "delivery": "all",
                    "kind": "simulate"}}

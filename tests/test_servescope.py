@@ -1,0 +1,519 @@
+"""servescope (PR 11) — span tracing + stage-latency attribution for
+the serve plane.
+
+Five layers:
+
+  * SPAN PLANE: the utils/metrics Span API (explicit stamps, parent/
+    child, flow links), its disabled-by-default contract and the
+    Chrome-trace/Perfetto rendering (flow start/finish pairs resolve,
+    stage spans nest inside their job span in stage order).
+  * THE HOUSE RULE, host edition: tracing off vs on is bit-identical
+    in results AND adds zero backend compiles at steady state — the
+    flight-recorder discipline applied to the host-side span plane.
+  * STAGE MODEL: the nine stamps land on every served job, the stage
+    durations telescope (sum == done - accepted), and the
+    ``/v1/jobs/<id>/timing`` route serves them over real sockets with
+    the X-Request-Id echo.
+  * SATELLITES: the batcher worker loop's structured last-error
+    snapshot + serve.batch_errors counter, the paired sse_opened/
+    sse_closed counters around the client gauge, queue depth sampled
+    at drain.
+  * ARTIFACTS: the v2 manifest's stage/attribution cross-field checks
+    and the regression gate's exit-2 verdict on an injected queue-wait
+    regression (the acceptance fixture).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import socket
+import sys
+import time
+
+import pytest
+
+from benor_tpu.serve import (Batcher, ServeApp, compare_serve,
+                             stage_durations, timing_dict)
+from benor_tpu.serve.jobs import STAGE_NAMES, STAGE_STAMPS, STAGES
+from benor_tpu.sweep import run_point
+from benor_tpu.config import SimConfig
+from benor_tpu.utils.compile_counter import count_backend_compiles
+from benor_tpu.utils.metrics import (REGISTRY, SPANS, SpanLog,
+                                     export_chrome_trace, perf_to_epoch)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import check_metrics_schema  # noqa: E402
+import check_serve_regression  # noqa: E402
+
+SPEC = {"kind": "simulate", "n_nodes": 16, "n_faulty": 2, "trials": 4,
+        "max_rounds": 8, "delivery": "all", "seed": 3}
+
+
+def _drain(batcher, deadline_s: float = 30.0) -> int:
+    n = 0
+    t0 = time.time()
+    while time.time() - t0 < deadline_s:
+        got = batcher.step()
+        if not got:
+            break
+        n += got
+    return n
+
+
+@pytest.fixture
+def spans_off():
+    """Leave the process-wide span log exactly as found (disabled and
+    empty — the default every other test relies on)."""
+    yield
+    SPANS.disable()
+    SPANS.clear()
+
+
+# --------------------------------------------------------------------------
+# span plane: the API itself
+# --------------------------------------------------------------------------
+
+
+def test_spanlog_disabled_is_a_noop():
+    log = SpanLog()
+    assert log.add("x", 0.0, 1.0) == 0
+    assert len(log) == 0
+
+
+def test_spanlog_records_and_caps():
+    log = SpanLog(cap=2).enable()
+    a = log.add("a", 10.0, 1.0, track="t")
+    b = log.add("b", 11.0, 1.0, parent_id=a, flow_in=7, flow_out=(8, 9))
+    assert a and b and b == a + 1
+    assert log.add("c", 12.0, 1.0) == 0          # over cap: dropped
+    assert log.dropped == 1
+    spans = log.snapshot()
+    assert [s.name for s in spans] == ["a", "b"]
+    assert spans[1].parent_id == a
+    assert spans[1].flow_in == (7,) and spans[1].flow_out == (8, 9)
+    log.clear()
+    assert len(log) == 0 and log.dropped == 0
+
+
+def test_spanlog_flow_ids_are_unique():
+    log = SpanLog().enable()
+    ids = [log.new_flow() for _ in range(10)]
+    assert len(set(ids)) == 10
+
+
+def test_chrome_trace_renders_spans_and_flows(tmp_path):
+    from benor_tpu.utils.metrics import Span
+    spans = [
+        Span("parent", 100.0, 2.0, track="demo", span_id=1,
+             flow_out=(5,)),
+        Span("child", 100.5, 0.5, track="demo", span_id=2, parent_id=1,
+             flow_in=(5,)),
+    ]
+    path = str(tmp_path / "trace.json")
+    export_chrome_trace(path, spans=spans)
+    ev = json.load(open(path))["traceEvents"]
+    xs = {e["name"]: e for e in ev if e.get("ph") == "X"}
+    assert xs["child"]["args"]["parent_id"] == 1
+    s_ids = {e["id"] for e in ev if e.get("ph") == "s"}
+    f_ids = {e["id"] for e in ev if e.get("ph") == "f"}
+    assert f_ids == s_ids == {5}
+    # nesting by time containment: child inside parent on the same tid
+    assert xs["child"]["tid"] == xs["parent"]["tid"]
+    assert xs["child"]["ts"] >= xs["parent"]["ts"]
+    assert (xs["child"]["ts"] + xs["child"]["dur"]
+            <= xs["parent"]["ts"] + xs["parent"]["dur"] + 1e-6)
+
+
+# --------------------------------------------------------------------------
+# stage model: stamps + telescoping attribution
+# --------------------------------------------------------------------------
+
+
+def test_stage_durations_telescope_and_clamp():
+    stamps = {name: float(i) for i, name in enumerate(STAGE_STAMPS)}
+    stages = stage_durations(stamps)
+    assert set(stages) == set(STAGE_NAMES)
+    # consecutive-stamp deltas telescope to done - accepted exactly
+    assert sum(stages.values()) == pytest.approx(
+        stamps["done"] - stamps["accepted"])
+    # a raced stamp pair clamps to zero, never negative attribution
+    stamps_bad = dict(stamps)
+    stamps_bad["result_sliced"] = stamps["done"] + 5.0
+    assert stage_durations(stamps_bad)["stream_out"] == 0.0
+    # missing stamps: the stage is absent, not fabricated
+    partial = {"accepted": 0.0, "validated": 1.0}
+    assert stage_durations(partial) == {"validate": 1.0}
+
+
+def test_timing_dict_shape():
+    stamps = {name: float(i) for i, name in enumerate(STAGE_STAMPS)}
+    doc = timing_dict(stamps)
+    assert doc["total_s"] == pytest.approx(8.0)
+    assert doc["stamps_rel_s"]["accepted"] == 0.0
+    assert doc["stamps_rel_s"]["done"] == pytest.approx(8.0)
+    assert doc["sub_stages_s"]["stream_wait"] == pytest.approx(1.0)
+    assert doc["sub_stages_s"]["stream_flush"] == pytest.approx(1.0)
+    # the sub-stages subdivide stream_out exactly
+    assert (doc["sub_stages_s"]["stream_wait"]
+            + doc["sub_stages_s"]["stream_flush"]
+            == pytest.approx(doc["stages_s"]["stream_out"]))
+
+
+def test_batcher_stamps_every_transition():
+    b = Batcher(start=False)
+    job = b.submit_dict(dict(SPEC))[0]
+    _drain(b)
+    # every batcher-owned stamp, in STAGE_STAMPS order (first_sse is
+    # the HTTP stream leg's, absent on a directly-driven batcher)
+    want = [s for s in STAGE_STAMPS if s != "first_sse"]
+    assert [s for s in STAGE_STAMPS if s in job.stamps] == want
+    times = [job.stamps[s] for s in want]
+    assert times == sorted(times)
+    stages = stage_durations(job.stamps)
+    assert sum(stages.values()) == pytest.approx(
+        job.stamps["done"] - job.stamps["accepted"])
+
+
+def test_queue_depth_gauge_sampled_at_drain():
+    b = Batcher(max_batch_jobs=2, start=False)
+    for s in range(3):
+        b.submit_dict({**SPEC, "seed": 70 + s})
+    assert REGISTRY.gauge("serve.queue_depth").value == 3.0
+    b.step()                                    # pops a batch of 2
+    assert REGISTRY.gauge("serve.queue_depth").value == 1.0
+    b.step()
+    assert REGISTRY.gauge("serve.queue_depth").value == 0.0
+
+
+# --------------------------------------------------------------------------
+# the house rule: tracing off is bit-identical + zero new compiles
+# --------------------------------------------------------------------------
+
+
+def test_tracing_off_bit_identical_and_zero_compiles(spans_off):
+    """Steady-state serving with the span plane armed must add ZERO
+    backend compiles and return results bit-equal to the untraced run
+    of the identical spec — the flight-recorder house rule, applied to
+    the host-side tracing layer."""
+    spec = {**SPEC, "seed": 41}
+    b = Batcher(start=False)
+    job_off = b.submit_dict(dict(spec))[0]      # warm + tracing off
+    _drain(b)
+    SPANS.enable()
+    with count_backend_compiles() as cc:
+        job_on = b.submit_dict(dict(spec))[0]
+        _drain(b)
+    SPANS.disable()
+    assert cc.count == 0, "armed tracing must not trigger compiles"
+    assert len(SPANS) > 0, "armed tracing must record spans"
+    r_off = {k: v for k, v in job_off.result.items() if k != "job"}
+    r_on = {k: v for k, v in job_on.result.items() if k != "job"}
+    assert r_off.pop("seconds") >= 0.0 and r_on.pop("seconds") >= 0.0
+    assert r_on == r_off                         # floats ==, not approx
+
+
+def test_batch_and_job_spans_flow_link_and_nest(spans_off):
+    SPANS.enable()
+    b = Batcher(start=False)
+    jobs = [b.submit_dict({**SPEC, "seed": 80 + s})[0] for s in range(3)]
+    _drain(b)
+    spans = SPANS.snapshot()
+    batches = [s for s in spans if s.track == "serve.batcher"]
+    assert len(batches) == 1 and batches[0].args["jobs"] == 3
+    assert batches[0].args["capacity"] == 4      # next pow2 rung
+    assert batches[0].args["pad"] == 1
+    flow_out = set(batches[0].flow_out)
+    assert len(flow_out) == 3
+    flow_in = set()
+    for job in jobs:
+        track = [s for s in spans if s.track == f"job {job.id}"]
+        parent = [s for s in track if s.parent_id is None]
+        assert len(parent) == 1
+        stage_spans = [s for s in track if s.parent_id is not None]
+        assert all(s.parent_id == parent[0].span_id
+                   for s in stage_spans)
+        # nesting matches stage order: starts ascending, inside parent
+        want_order = [n for n, _, _ in STAGES
+                      if n in [s.name for s in stage_spans]]
+        assert [s.name for s in stage_spans] == want_order
+        starts = [s.start for s in stage_spans]
+        assert starts == sorted(starts)
+        p0, p1 = parent[0].start, parent[0].start + parent[0].dur_s
+        for s in stage_spans:
+            assert s.start >= p0 - 1e-6
+            assert s.start + s.dur_s <= p1 + 1e-6
+        launch = [s for s in stage_spans if s.name == "launch"]
+        flow_in |= set(launch[0].flow_in)
+    assert flow_in == flow_out                   # links resolve 1:1
+
+
+def test_perfetto_export_of_serve_spans_resolves_flows(tmp_path,
+                                                       spans_off):
+    SPANS.enable()
+    b = Batcher(start=False)
+    for s in range(2):
+        b.submit_dict({**SPEC, "seed": 90 + s})
+    _drain(b)
+    path = str(tmp_path / "serve_trace.json")
+    export_chrome_trace(path, spans=True)
+    ev = json.load(open(path))["traceEvents"]
+    s_ids = {e["id"] for e in ev if e.get("ph") == "s"}
+    f_ids = {e["id"] for e in ev if e.get("ph") == "f"}
+    assert f_ids and f_ids <= s_ids              # every finish has a start
+    names = {e["name"] for e in ev if e.get("ph") == "X"}
+    assert any(n.startswith("batch dyn") for n in names)
+    assert "launch" in names and "queue_wait" in names
+
+
+# --------------------------------------------------------------------------
+# satellites: batch-error snapshot, sse gauge pairing
+# --------------------------------------------------------------------------
+
+
+def test_batch_error_counter_and_snapshot_in_stats(monkeypatch):
+    """The worker loop's bare print_exc is gone: a failed batch ticks
+    serve.batch_errors, stores a structured last-error snapshot that
+    /v1/stats surfaces, and the loop survives to serve the next job."""
+    before = REGISTRY.counter("serve.batch_errors").value
+    b = Batcher(start=True)
+    try:
+        def boom(key, jobs):
+            raise RuntimeError("injected batch failure")
+        monkeypatch.setattr(b, "_execute", boom)
+        job = b.submit_dict(dict(SPEC))[0]
+        assert job.wait(timeout=30)
+        assert job.state == "error"
+        deadline = time.time() + 10
+        while time.time() < deadline and b.batch_errors < 1:
+            time.sleep(0.02)
+        st = b.stats()
+        assert st["batch_errors"] == 1
+        assert "RuntimeError: injected batch failure" \
+            in st["last_error"]["error"]
+        assert "traceback" in st["last_error"]
+        assert st["last_error"]["ts"] > 0
+        assert REGISTRY.counter("serve.batch_errors").value == before + 1
+        # the loop survived: the next (healthy) job completes
+        monkeypatch.undo()
+        ok_job = b.submit_dict({**SPEC, "seed": 55})[0]
+        assert ok_job.wait(timeout=60) and ok_job.state == "done"
+    finally:
+        b.close()
+
+
+@pytest.fixture(scope="module")
+def app():
+    with ServeApp(max_batch_jobs=8) as a:
+        yield a
+
+
+def _request(app, payload: bytes, read_until=None,
+             timeout: float = 60.0) -> bytes:
+    s = socket.create_connection((app.host, app.port), timeout=timeout)
+    try:
+        s.sendall(payload)
+        chunks = b""
+        while True:
+            b = s.recv(65536)
+            if not b:
+                break
+            chunks += b
+            if read_until and read_until in chunks:
+                break
+    finally:
+        s.close()
+    return chunks
+
+
+def _get(app, path: str, headers: str = "") -> bytes:
+    return _request(app, f"GET {path} HTTP/1.1\r\nHost: x\r\n"
+                         f"{headers}\r\n".encode())
+
+
+def _status_and_json(resp: bytes):
+    head, _, body = resp.partition(b"\r\n\r\n")
+    return int(head.split()[1]), json.loads(body)
+
+
+def test_sse_gauge_pairs_and_returns_to_rest(app):
+    g0 = REGISTRY.gauge("serve.sse_clients").value
+    opened0 = REGISTRY.counter("serve.sse_opened").value
+    closed0 = REGISTRY.counter("serve.sse_closed").value
+    body = json.dumps({**SPEC, "seed": 61}).encode()
+    resp = _request(
+        app,
+        b"POST /v1/jobs?stream=sse HTTP/1.1\r\nHost: x\r\n"
+        + f"Content-Length: {len(body)}\r\n\r\n".encode() + body,
+        read_until=b"event: done")
+    assert b"event: result" in resp
+    deadline = time.time() + 10
+    while time.time() < deadline and \
+            REGISTRY.counter("serve.sse_closed").value < closed0 + 1:
+        time.sleep(0.02)
+    assert REGISTRY.counter("serve.sse_opened").value == opened0 + 1
+    assert REGISTRY.counter("serve.sse_closed").value == closed0 + 1
+    assert REGISTRY.gauge("serve.sse_clients").value == g0
+    # the paired counters audit the gauge: opened - closed == in-flight
+    assert (REGISTRY.counter("serve.sse_opened").value
+            - REGISTRY.counter("serve.sse_closed").value) == g0
+
+
+def test_stats_surfaces_batch_error_fields(app):
+    code, stats = _status_and_json(_get(app, "/v1/stats"))
+    assert code == 200
+    assert "batch_errors" in stats and "last_error" in stats
+
+
+def test_request_id_echo_and_minting(app):
+    resp = _get(app, "/healthz", headers="X-Request-Id: my.id-42\r\n")
+    assert b"X-Request-Id: my.id-42" in resp
+    resp = _get(app, "/healthz",
+                headers="X-Request-Id: bad id with spaces\r\n")
+    head = resp.partition(b"\r\n\r\n")[0]
+    assert b"X-Request-Id: r-" in head           # minted, not echoed
+    resp = _get(app, "/healthz")
+    assert b"X-Request-Id: r-" in resp
+    # a rejection raised INSIDE request parsing (413 on the header
+    # alone) still carries the client's correlation id — errors are
+    # where correlation matters most
+    resp = _request(
+        app, b"POST /v1/jobs HTTP/1.1\r\nHost: x\r\n"
+             b"X-Request-Id: too-big-7\r\n"
+             b"Content-Length: 99999999\r\n\r\n")
+    head = resp.partition(b"\r\n\r\n")[0]
+    assert head.startswith(b"HTTP/1.1 413")
+    assert b"X-Request-Id: too-big-7" in head
+
+
+def test_http_timing_route_over_sockets(app):
+    code, sub = _status_and_json(_request(
+        app, b"POST /v1/jobs HTTP/1.1\r\nHost: x\r\n"
+             b"Content-Length: %d\r\n\r\n"
+             % len(json.dumps({**SPEC, "seed": 62}).encode())
+             + json.dumps({**SPEC, "seed": 62}).encode()))
+    assert code == 202
+    job_id = sub["jobs"][0]
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        code, snap = _status_and_json(_get(app, f"/v1/jobs/{job_id}"))
+        if snap["state"] == "done":
+            break
+        time.sleep(0.05)
+    code, doc = _status_and_json(_get(app, f"/v1/jobs/{job_id}/timing"))
+    assert code == 200
+    assert doc["job"] == job_id and doc["state"] == "done"
+    assert set(doc["stages_s"]) == set(STAGE_NAMES) - {"stream_out"} \
+        or set(doc["stages_s"]) == set(STAGE_NAMES)
+    # the payload rounds each stage to 6 dp independently: allow the
+    # documented N*0.5e-6 rounding slack on the telescoping identity
+    assert doc["total_s"] >= sum(doc["stages_s"].values()) - 5e-6
+    assert doc["stamps_rel_s"]["accepted"] == 0.0
+    # oracle cross-check: the timing route's job is still bit-equal
+    cfg = SimConfig(n_nodes=16, n_faulty=2, trials=4, max_rounds=8,
+                    delivery="all", seed=62)
+    assert snap["result"]["mean_k"] == run_point(cfg).mean_k
+    code, _ = _status_and_json(_get(app, "/v1/jobs/nope/timing"))
+    assert code == 404
+
+
+# --------------------------------------------------------------------------
+# artifacts: v2 schema cross-fields + the injected-regression gate
+# --------------------------------------------------------------------------
+
+
+def _baseline() -> dict:
+    with open(os.path.join(REPO, "SERVE_BASELINE.json")) as fh:
+        return json.load(fh)
+
+
+def test_v2_schema_rejects_v1_and_broken_stage_blocks(tmp_path):
+    base = _baseline()
+    v1 = copy.deepcopy(base)
+    v1["schema_version"] = 1
+    assert any("schema_version" in e
+               for e in check_metrics_schema.check_serve_manifest(v1))
+    bad = copy.deepcopy(base)
+    bad["stages"]["queue_wait"]["p50"] = \
+        bad["stages"]["queue_wait"]["p99"] + 1.0
+    assert any("percentiles out of order" in e
+               for e in check_metrics_schema.check_serve_manifest(bad))
+    missing = copy.deepcopy(base)
+    del missing["stages"]["launch"]
+    assert any("launch" in e
+               for e in check_metrics_schema.check_serve_manifest(missing))
+
+
+def test_attribution_cross_fields_are_pinned():
+    base = _baseline()
+    # a drifted sum
+    bad = copy.deepcopy(base)
+    bad["attribution"]["stage_mean_sum_ms"] += 100.0
+    errs = check_metrics_schema.check_serve_manifest(bad)
+    assert any("stage_mean_sum_ms" in e for e in errs)
+    # a hand-edited ok over a broken coverage
+    lie = copy.deepcopy(base)
+    lie["attribution"]["coverage"] = 0.2
+    lie["attribution"]["client_mean_ms"] = \
+        lie["attribution"]["stage_mean_sum_ms"] / 0.2
+    lie["latency_ms"]["mean"] = lie["attribution"]["client_mean_ms"]
+    errs = check_metrics_schema.check_serve_manifest(lie)
+    assert any("$.attribution.ok" in e for e in errs)
+
+
+def test_gate_exits_2_on_injected_queue_wait_regression(tmp_path):
+    """The acceptance fixture: a manifest whose queue-wait p99 blew past
+    the stage band must exit 2 through the real CLI; the same fixture
+    passes under a lifted --stage-band, and the committed baseline
+    self-gates at 0."""
+    base = _baseline()
+    bad = copy.deepcopy(base)
+    bad["stages"]["queue_wait"]["p99"] = \
+        round(base["stages"]["queue_wait"]["p99"] * 3.0 + 500.0, 3)
+    mp, bp = str(tmp_path / "m.json"), str(tmp_path / "b.json")
+    with open(bp, "w") as fh:
+        json.dump(base, fh)
+    with open(mp, "w") as fh:
+        json.dump(bad, fh)
+    assert check_serve_regression.main([mp, bp]) == 2
+    findings = compare_serve(bad, base)
+    assert any(f.metric == "stages.queue_wait.p99" for f in findings)
+    # a lifted band clears it (the ratio is ~3.4x < 10x)
+    assert check_serve_regression.main([mp, bp, "--stage-band",
+                                        "10.0"]) == 0
+    # launch p99 gates the same way
+    bad2 = copy.deepcopy(base)
+    bad2["stages"]["launch"]["p99"] = \
+        round(base["stages"]["launch"]["p99"] * 3.0 + 500.0, 3)
+    with open(mp, "w") as fh:
+        json.dump(bad2, fh)
+    assert check_serve_regression.main([mp, bp]) == 2
+    # sub-noise-floor blowups are ignored (2x of ~nothing is noise)
+    tiny = copy.deepcopy(base)
+    tiny["stages"]["launch"]["p99"] = \
+        round(base["stages"]["launch"]["p99"] * 3.0, 3)
+    ok = tiny["stages"]["launch"]["p99"] \
+        - base["stages"]["launch"]["p99"] < 50.0
+    if ok:
+        with open(mp, "w") as fh:
+            json.dump(tiny, fh)
+        assert check_serve_regression.main([mp, bp]) == 0
+
+
+def test_gate_flags_broken_attribution():
+    base = _baseline()
+    bad = copy.deepcopy(base)
+    bad["attribution"]["ok"] = False
+    bad["attribution"]["coverage"] = 0.4
+    findings = compare_serve(bad, base)
+    assert any(f.metric == "attribution" for f in findings)
+
+
+def test_committed_baseline_attribution_is_complete():
+    base = _baseline()
+    assert base["schema_version"] == 2
+    assert base["attribution"]["ok"] is True
+    assert base["attribution"]["jobs_timed"] >= 1000
+    assert set(base["stages"]) == set(STAGE_NAMES)

@@ -310,8 +310,15 @@ def check_serve_manifest(manifest: dict,
     Beyond the schema, pins the cross-field facts the serve gate
     relies on: jobs_per_launch must equal jobs_completed / launches
     (a drifted coalescing ratio would silently skew the gate's whole
-    verdict), completed + errors must account for every client, and
-    the latency percentiles must be ordered (p50 <= p99 <= max)."""
+    verdict), completed + errors must account for every client, the
+    latency percentiles must be ordered (p50 <= p99 <= max), every
+    servescope stage block must carry ordered percentiles (p50 <= p99),
+    and the attribution block must be internally consistent — its
+    stage_mean_sum_ms must equal the sum of the stage means, its
+    coverage must equal stage_mean_sum/client_mean, and its ok verdict
+    must follow from jobs_timed and |coverage - 1| <= band (a
+    hand-edited 'ok: true' over a broken attribution is exactly what
+    this catches)."""
     errors: List[str] = []
     with open(schema_path) as fh:
         schema = json.load(fh)
@@ -339,6 +346,41 @@ def check_serve_manifest(manifest: dict,
     if manifest["clients"] < 1:
         errors.append("$.clients: a load manifest needs at least one "
                       "client")
+    # servescope stage blocks: per-stage shape + ordered percentiles
+    mean_sum = 0.0
+    for stage in sorted(manifest["stages"]):
+        blk = manifest["stages"][stage]
+        bad = [k for k in ("p50", "p99", "mean")
+               if not isinstance(blk.get(k), (int, float))
+               or isinstance(blk.get(k), bool)]
+        if bad:
+            errors.append(f"$.stages.{stage}: missing/non-numeric "
+                          f"{bad}")
+            continue
+        if blk["p50"] > blk["p99"]:
+            errors.append(f"$.stages.{stage}: percentiles out of order "
+                          f"(p50={blk['p50']} > p99={blk['p99']})")
+        mean_sum += blk["mean"]
+    attr = manifest["attribution"]
+    if abs(attr["stage_mean_sum_ms"] - mean_sum) > max(0.01,
+                                                       1e-3 * mean_sum):
+        errors.append(f"$.attribution.stage_mean_sum_ms: "
+                      f"{attr['stage_mean_sum_ms']} != sum of stage "
+                      f"means ({mean_sum:.3f})")
+    if attr["client_mean_ms"] > 0:
+        want_cov = attr["stage_mean_sum_ms"] / attr["client_mean_ms"]
+        if abs(attr["coverage"] - want_cov) > max(1e-3,
+                                                  1e-3 * want_cov):
+            errors.append(f"$.attribution.coverage: {attr['coverage']} "
+                          f"!= stage_mean_sum/client_mean "
+                          f"({want_cov:.4f})")
+    want_ok = (attr["jobs_timed"] > 0
+               and abs(attr["coverage"] - 1.0) <= attr["band"])
+    if bool(attr["ok"]) != want_ok:
+        errors.append(f"$.attribution.ok: {attr['ok']} contradicts "
+                      f"coverage {attr['coverage']} vs band "
+                      f"{attr['band']} (jobs_timed "
+                      f"{attr['jobs_timed']})")
     return errors
 
 

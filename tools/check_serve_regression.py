@@ -6,10 +6,14 @@ against a committed baseline with the coalescing / completion / error
 rules in ``benor_tpu/serve/gate.py`` — jobs-per-launch (the coalescing
 efficiency serving exists to produce) gates at a ratio band with
 "collapsed to per-job dispatch" as the worst finding, any client error
-or leaked batch slot is a regression on its own, and the
-machine-sensitive wall-clock metrics (p50/p99 latency, throughput) are
-carried for trend reading but only gate under an explicit
-``--timing-band``.
+or leaked batch slot is a regression on its own, servescope's
+attribution cross-check (stage means must telescope to the client mean
+latency) gates unconditionally, the queue-wait and launch stage p99s
+band against baseline at ``gate.STAGE_P99_BANDS`` over an absolute
+noise floor (``--stage-band`` overrides the ratio), and the remaining
+machine-sensitive wall-clock metrics (end-to-end p50/p99 latency,
+throughput) are carried for trend reading but only gate under an
+explicit ``--timing-band``.
 
 Exit codes (the CI contract, same convention as
 ``check_perf_regression.py`` / ``check_scaling_regression.py``):
@@ -29,7 +33,8 @@ honest (an import creep there breaks this gate immediately).
 
 Usage:
     python tools/check_serve_regression.py MANIFEST [BASELINE]
-        [--coalescing-band X] [--timing-band X] [--strict]
+        [--coalescing-band X] [--stage-band X] [--timing-band X]
+        [--strict]
 """
 
 from __future__ import annotations
@@ -71,6 +76,10 @@ def main(argv=None) -> int:
     ap.add_argument("--coalescing-band", type=float, default=None,
                     help="floor on new/baseline jobs-per-launch ratio "
                          "(default: gate.COALESCING_BAND)")
+    ap.add_argument("--stage-band", type=float, default=None,
+                    help="override the default stage-p99 ratio band "
+                         "for the default-gated stages (queue_wait, "
+                         "launch; default: gate.STAGE_P99_BANDS)")
     ap.add_argument("--timing-band", type=float, default=None,
                     help="also gate throughput and p99 latency at this "
                          "ratio band (off by default: shared CI "
@@ -98,6 +107,9 @@ def main(argv=None) -> int:
         kw["coalescing_band"] = args.coalescing_band
     if args.timing_band is not None:
         kw["timing_band"] = args.timing_band
+    if args.stage_band is not None:
+        kw["stage_bands"] = {s: args.stage_band
+                             for s in gate.STAGE_P99_BANDS}
     try:
         findings = gate.compare_serve(manifest, base, **kw)
     except gate.IncomparableServe as e:
