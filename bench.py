@@ -13,11 +13,19 @@ On unrecoverable failure the line carries value 0.0 and an "error" field —
 never a bare traceback / non-zero exit (round-1 BENCH_r01.json was rc=1 with
 parsed: null; this file's whole job is to make that impossible).
 
-The stdout line is the COMPACT headline only (~1 KB: metric, gates, one
-speedup number per pallas kernel) because the driver keeps just the last
-2,000 chars of stdout — round 3's ~4 KB line truncated the head fields and
-parsed: null happened anyway.  The full record (per-regime curve + complete
-kernel-check blobs) goes to the `BENCH_DETAIL.json` sidecar and stderr.
+The stdout line is the COMPACT headline only (~1 KB) because the driver
+keeps just the last 2,000 chars of stdout — round 3's ~4 KB line
+truncated the head fields and parsed: null happened anyway.  The full
+record (per-regime curve + complete check blobs) goes to the
+`BENCH_DETAIL.json` sidecar and stderr.  The EXACT key set of both —
+headline and sidecar — is pinned by `tools/bench_detail_schema.json`,
+the single source of truth this docstring deliberately stops
+restating (PRs 8-11 each grew the headline's gate-bool set and an
+enumerated list here silently drifted): ``_DETAIL_KEYS`` below decides
+which blobs leave the stdout line, `_split_headline` derives the
+per-blob headline bools, and `tools/check_metrics_schema.py`
+(tier-1 via tests/test_metrics_schema.py) validates every capture
+against the schema file and recomputes the headline byte budget.
 
 vs_baseline > 1.0 means the full rounds-vs-f sweep finished inside the
 60-second north-star budget (the reference itself publishes no numbers and
@@ -77,13 +85,17 @@ _DETAIL_KEYS = ("curve", "pallas_check", "pallas_hist_check",
                 "pallas_equiv_check", "pallas_weak_coin_check",
                 "pallas_round_check", "pallas_demoted",
                 "batched_sweep_check", "flight_recorder", "perfscope",
-                "meshscope", "serve", "lint")
+                "meshscope", "serve", "topo", "lint")
 
 
 def _split_headline(out: dict) -> tuple[dict, dict]:
     """(headline, detail): headline is the ONE compact stdout line (science
-    gates + a one-number-per-kernel pallas summary, ~1 KB); detail carries
-    the full curve and check blobs for the sidecar file."""
+    gates + a one-number-per-kernel pallas summary + one ``*_ok`` bool
+    per sidecar blob); detail carries the full curve and check blobs for
+    the sidecar file.  The authoritative key inventory for BOTH halves is
+    tools/bench_detail_schema.json — new keys land there first, and
+    check_metrics_schema.check_headline re-runs this very function to
+    enforce the byte budget."""
     detail = {k: out[k] for k in _DETAIL_KEYS if k in out}
     head = {k: v for k, v in out.items() if k not in _DETAIL_KEYS}
     kernels = {}
@@ -139,6 +151,14 @@ def _split_headline(out: dict) -> tuple[dict, dict]:
         # errors + coalescing ratio > 1 + in-band vs SERVE_BASELINE.json
         # when comparable; the manifest lives in the sidecar's serve blob
         head["serve_ok"] = bool(sv.get("ok"))
+    tp = out.get("topo")
+    if isinstance(tp, dict):
+        # ONE compact bool: topology='complete' bit-identical (results +
+        # compile counts) + degree/committee curves ran batched (the
+        # committee sweep in one bucket executable) + the torus point
+        # audited clean under the relaxed neighborhood invariants; the
+        # curves live in the sidecar's topo blob
+        head["topo_ok"] = bool(tp.get("ok"))
     head["detail_file"] = "BENCH_DETAIL.json"
     return head, detail
 
@@ -1085,6 +1105,17 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
         f"attribution_coverage="
         f"{(m.get('attribution') or {}).get('coverage')} "
         f"baseline_comparable={serve_check.get('baseline_comparable')}")
+    try:
+        topo_check = _topo_check(seed)
+    except Exception as e:  # noqa: BLE001 — accounting must not kill the run
+        topo_check = {"ok": False,
+                      "error": f"{type(e).__name__}: {e}"}
+    log(f"bench: topo check ok={topo_check.get('ok')} "
+        f"identity={topo_check.get('complete_identity')} "
+        f"degree_rows={len(topo_check.get('degree_curve', []))} "
+        f"committee_rows={len(topo_check.get('committee_curve', []))} "
+        f"committee_compiles={topo_check.get('committee_compile_count')} "
+        f"audit_ok={topo_check.get('audit_ok')}")
 
     total_trials = trials * len(regimes)
     log(f"bench: sweep elapsed {elapsed:.2f}s for {total_trials} trials; "
@@ -1140,6 +1171,7 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
         "perfscope": perfscope_check,
         "meshscope": meshscope_check,
         "serve": serve_check,
+        "topo": topo_check,
         "pallas_demoted": demoted,
     }
 
@@ -1404,6 +1436,71 @@ def _serve_check() -> dict:
                   and bool(manifest.get("attribution", {}).get("ok"))
                   and not regressions)
     return blob
+
+
+def _topo_check(seed: int) -> dict:
+    """The structured-delivery workloads' embedded proof (PR 12,
+    benor_tpu/topo) at a fixed CPU-safe geometry:
+
+      * identity — ``topology='complete'`` normalizes to the pre-topology
+        config, so the same point re-run under it must be bit-identical
+        in the science fields AND cost zero new backend compiles (the
+        jit cache simply hits);
+      * the rounds-vs-degree curve (ring/torus/random-regular ladder)
+        and the committee-size sweep, both through the batched engine —
+        the committee curve's compile count must be 1 (size rides
+        DynParams: one bucket executable for the whole sweep);
+      * a witnessed torus run audited CLEAN under the relaxed
+        neighborhood invariants (quorum evidence bounded by the d+1
+        neighborhood — benor_tpu/audit.py).
+
+    The blob's cross-field facts (degree/diameter recomputation, row
+    ordering, the recomputed ok verdict) are pinned by
+    check_metrics_schema.check_topo_blob."""
+    from benor_tpu import audit, results
+    from benor_tpu.config import SimConfig
+    from benor_tpu.state import FaultSpec
+    from benor_tpu.sweep import run_point
+    from benor_tpu.utils.compile_counter import count_backend_compiles
+
+    n_topo, trials, max_rounds = 64, 16, 24
+    base = SimConfig(n_nodes=n_topo, n_faulty=8, trials=trials,
+                     max_rounds=max_rounds, seed=seed, delivery="quorum",
+                     scheduler="uniform", path="histogram")
+    pt0 = run_point(base)
+    with count_backend_compiles() as cc:
+        pt1 = run_point(base.replace(topology="complete"))
+    identity = {
+        "bit_equal": bool(
+            pt0.rounds_executed == pt1.rounds_executed
+            and pt0.decided_frac == pt1.decided_frac
+            and pt0.mean_k == pt1.mean_k
+            and pt0.ones_frac == pt1.ones_frac
+            and pt0.disagree_frac == pt1.disagree_frac
+            and (pt0.k_hist == pt1.k_hist).all()),
+        "extra_compiles": cc.count,
+    }
+
+    curves = results.topo_curves(n_topo, trials, seed=seed,
+                                 max_rounds=max_rounds)
+
+    acfg = SimConfig(n_nodes=n_topo, n_faulty=2, topology="torus2d:8x8",
+                     trials=trials, max_rounds=max_rounds, seed=seed,
+                     witness_trials=(0, 1), witness_nodes=8)
+    report, _ = audit.audit_point(
+        acfg, initial_values=np.ones((trials, n_topo), np.int8),
+        faults=FaultSpec.none(trials, n_topo), unanimous=1,
+        label="bench topo torus")
+
+    ok = (identity["bit_equal"] and identity["extra_compiles"] == 0
+          and report.ok and len(curves["degree_curve"]) > 0
+          and len(curves["committee_curve"]) > 0
+          and curves["committee_compile_count"] == 1)
+    return {"ok": bool(ok), "n": n_topo, "trials": trials,
+            "complete_identity": identity, **curves,
+            "audit_ok": bool(report.ok),
+            "audit_checks": sum(report.checks.values()),
+            "audit_violations": len(report.violations)}
 
 
 def _lint_check() -> dict:

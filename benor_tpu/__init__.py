@@ -21,6 +21,10 @@ Layout (SURVEY.md §7):
   audit.py         witness traces + protocol invariant auditor
                    (SimConfig.witness_trials; per-node forensics for
                    every regime — see README "Observability")
+  topo/            adjacency- and committee-structured delivery planes
+                   (SimConfig.topology / committee_*; O(N*d) neighbor
+                   tallies, per-round sampled committees, rounds-vs-
+                   degree curves — see README "Topology & committees")
 """
 
 from .api import (get_nodes_state, launch_network, reached_finality,

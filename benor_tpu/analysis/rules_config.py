@@ -60,6 +60,42 @@ PARITY_ALLOWLIST = {
         "callers (sim.run_consensus_slice, sharded._local_slice) own "
         "the boundary, and the sharded/multihost wrappers plus the "
         "sweep engine all reference the field themselves",
+    # --- structured delivery planes (benor_tpu/topo, PR 12) -------------
+    # The topology/committee dispatch lives INSIDE the shared round
+    # kernel: tally.receiver_counts routes to topo/deliver.py and
+    # models/benor.py to topo/committees.py, both via ShardCtx gathers/
+    # psums keyed on global ids — so the sharded and multihost runners
+    # serve structured configs through the identical benor_round body
+    # with zero regime-specific code (tests/test_topo.py pins the
+    # sharded bit-identity).  The fused pallas kernels implement the
+    # complete graph only and structurally never engage (structured
+    # planes require delivery='all', which every pallas gate in
+    # ops/tally.py rejects; sim.warn_structured_demotes_pallas
+    # announces it).  sweep.py references both fields itself
+    # (quorum_specialized / sweep_bucket_key).
+    ("topology", "ops/pallas_round.py"):
+        "the fused kernels implement the complete graph only; "
+        "tally.pallas_round_active rejects structured configs before "
+        "dispatch and sim.warn_structured_demotes_pallas announces it",
+    ("topology", "parallel/sharded.py"):
+        "the adjacency gather runs inside the shared round kernel "
+        "(tally.receiver_counts -> topo/deliver.py) via "
+        "ctx.all_gather_nodes on global ids — the sharded runner "
+        "needs no topology-specific code (tests/test_topo.py)",
+    ("topology", "parallel/multihost.py"):
+        "multihost delegates the whole loop to sharded._local_slice, "
+        "which reaches the same kernel-level topo dispatch",
+    ("committee_cap", "ops/pallas_round.py"):
+        "same structural demotion as topology: committee delivery "
+        "requires delivery='all', which every pallas gate rejects",
+    ("committee_cap", "parallel/sharded.py"):
+        "committee histograms scatter per shard and psum over the node "
+        "axis inside the shared round kernel (models/benor.py -> "
+        "topo/committees.py); the sharded runner needs no "
+        "committee-specific code",
+    ("committee_cap", "parallel/multihost.py"):
+        "multihost delegates the whole loop to sharded._local_slice, "
+        "which reaches the same kernel-level committee dispatch",
 }
 
 

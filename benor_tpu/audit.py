@@ -41,6 +41,15 @@ implementation (``src/nodes/node.ts``):
                    the complementary evidence: no decide, and under the
                    reference's plurality-adopt quirk (node.ts:106-112) a
                    tied v0 == v1; under 'textbook', v0 <= F and v1 <= F.
+                   RELAXED under the topo delivery plane (PR 12): when
+                   the bundle carries a ``tally_bound`` (an adjacency
+                   topology's d + 1 neighborhood, derived from
+                   cfg.topology by WitnessBundle.from_run), quorum
+                   evidence is judged within the NEIGHBORHOOD — the
+                   decide bar stays count > F, and every witnessed
+                   phase tally must additionally fit the structural
+                   ceiling p0+p1 <= d+1, v0+v1 <= d+1 (a tally no
+                   neighborhood could deliver is forged evidence).
   killed silence   A killed node stops participating: birth-faulty lanes
                    are dead with null state (node.ts:21-26), /stop kills
                    at any time (node.ts:191-194) — once the witnessed
@@ -104,6 +113,14 @@ class WitnessBundle:
     freeze_decided: bool = True
     faulty: Optional[np.ndarray] = None     # bool [W, k] or None
     unanimous: Optional[int] = None         # 0 | 1 | None
+    #: Structural ceiling on any witnessed tally — the RELAXED quorum-
+    #: evidence bound of the topo delivery plane (ROADMAP item 3): under
+    #: an adjacency topology a receiver tallies at most its d + 1
+    #: neighborhood, so any p0+p1 / v0+v1 beyond that is forged
+    #: evidence the complete-graph checks could never see.  None (every
+    #: pre-topology bundle) disables the bound — the global quorum
+    #: bound stays implied by the decide-bar checks, exactly as before.
+    tally_bound: Optional[int] = None
     label: str = ""
 
     @classmethod
@@ -116,7 +133,11 @@ class WitnessBundle:
         ('byzantine'/'equivocate'): a fail-stop lane ('crash',
         'crash_at_round') follows the protocol until it dies, so its
         decisions MUST count for agreement/validity.  ``unanimous``
-        asserts globally-unanimous inputs."""
+        asserts globally-unanimous inputs.  Under an adjacency topology
+        (cfg.topology) the bundle carries the d + 1 neighborhood as its
+        ``tally_bound`` — the relaxed quorum-evidence ceiling the
+        auditor enforces instead of the (unrepresentable) global
+        quorum."""
         if not cfg.witness:
             raise ValueError("cfg has no witness armed (witness_trials)")
         trial_ids = np.asarray(cfg.witness_trials, np.int64)
@@ -126,11 +147,15 @@ class WitnessBundle:
                                                       "equivocate"):
             f = np.asarray(faults.faulty)
             faulty = f[np.ix_(trial_ids, node_ids)]
+        bound = None
+        if cfg.topology is not None:
+            from .topo.graphs import parse_topology
+            bound = parse_topology(cfg.topology).degree + 1
         return cls(buffer=np.asarray(buffer), trial_ids=trial_ids,
                    node_ids=node_ids, rule=cfg.rule,
                    n_faulty=cfg.n_faulty, n_nodes=cfg.n_nodes,
                    freeze_decided=cfg.freeze_decided, faulty=faulty,
-                   unanimous=unanimous, label=label)
+                   unanimous=unanimous, tally_bound=bound, label=label)
 
     def to_dict(self) -> Dict:
         return {
@@ -143,6 +168,8 @@ class WitnessBundle:
             "node_ids": [int(n) for n in self.node_ids],
             "unanimous": (None if self.unanimous is None
                           else int(self.unanimous)),
+            "tally_bound": (None if self.tally_bound is None
+                            else int(self.tally_bound)),
             "faulty": (None if self.faulty is None
                        else np.asarray(self.faulty).astype(bool).tolist()),
             "columns": list(WIT_COLUMNS),
@@ -298,6 +325,33 @@ def audit_witness(bundle: WitnessBundle) -> AuditReport:
             v0, v1 = series[:, WIT_V0], series[:, WIT_V1]
 
             first, pre_decided = _first_decide(series)
+
+            # --- neighborhood tally bound (topo delivery plane) ---------
+            # Under an adjacency topology the quorum rule is
+            # NEIGHBORHOOD-relative: a receiver tallies at most its
+            # d + 1 neighborhood, so any witnessed phase tally beyond
+            # bundle.tally_bound is forged evidence — the relaxed
+            # invariant ROADMAP item 3 asks the auditor to learn.
+            # Filed under quorum_evidence: it is the structural half of
+            # the same "was this decide backed by real counts" claim.
+            if bundle.tally_bound is not None:
+                checks["quorum_evidence"] += 1
+                p0, p1 = series[:, WIT_P0], series[:, WIT_P1]
+                over = np.nonzero((p0 + p1 > bundle.tally_bound) |
+                                  (v0 + v1 > bundle.tally_bound))[0]
+                for oi in over:
+                    rd = int(rounds[oi])
+                    violations.append(Violation(
+                        "quorum_evidence", trial, rd, [node],
+                        {"round": rd, "p0": int(p0[oi]), "p1": int(p1[oi]),
+                         "v0": int(v0[oi]), "v1": int(v1[oi]),
+                         "tally_bound": int(bundle.tally_bound)},
+                        f"trial {trial} node {node} tallied more "
+                        f"messages than its d+1={int(bundle.tally_bound)}"
+                        f" neighborhood can deliver at round {rd} "
+                        f"(p0+p1={int(p0[oi] + p1[oi])}, "
+                        f"v0+v1={int(v0[oi] + v1[oi])}) — forged "
+                        "evidence under the topology-relative quorum"))
 
             # --- irrevocability (node.ts:100,103,147-157) ---------------
             checks["irrevocability"] += 1
@@ -513,4 +567,5 @@ def load_bundle(path: str) -> WitnessBundle:
         freeze_decided=doc.get("freeze_decided", True),
         faulty=(None if doc.get("faulty") is None
                 else np.asarray(doc["faulty"], bool)),
-        unanimous=doc.get("unanimous"), label=doc.get("label", ""))
+        unanimous=doc.get("unanimous"),
+        tally_bound=doc.get("tally_bound"), label=doc.get("label", ""))

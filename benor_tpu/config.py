@@ -112,6 +112,42 @@ class SimConfig:
     # Delay added by the 'biased' scheduler to starved-class edges.
     adversary_strength: float = 0.0
 
+    # --- structured delivery planes (benor_tpu/topo) ---------------------
+    # Adjacency-structured delivery: a topology spec string replaces the
+    # implicit complete graph — each receiver tallies exactly its d graph
+    # neighbors plus itself (quirk 6: broadcasts include self), so the
+    # decide rule count > F relativizes to the d+1 neighborhood.  Specs
+    # (grammar in benor_tpu/topo/graphs.py): 'complete' (the identity —
+    # normalized to None here, so selecting it is bit-identical to the
+    # pre-topology path in results AND compile counts), 'ring:<d>',
+    # 'torus2d:<rows>x<cols>', 'expander:<d>',
+    # 'random_regular:<d>[:seed]'.  Requires delivery='all' (structured
+    # delivery IS the deterministic neighbor fan-in; the quorum-subset
+    # schedulers have no meaning on it) and the tpu backend (the
+    # event-loop oracles only implement the complete graph).  The fused
+    # pallas kernels never engage under a topology — delivery='all'
+    # already keeps them off; sim.warn_structured_demotes_pallas
+    # announces the structural demotion once, like the debug demotion.
+    # Cost O(N*d): neighbor indices are closed-form arithmetic or one
+    # static [N, d] table — never a dense N x N adjacency tensor.
+    topology: Optional[str] = None
+    # Committee-structured delivery (per-round sampled committees):
+    # committee_cap > 0 arms it.  Each round, each node participates
+    # with probability min(1, size*count/N) and joins one of
+    # ``committee_count`` committees (fold_in-derived membership, so
+    # runs are bit-reproducible and mesh-shape-identical); it then
+    # tallies only its committee co-members, and non-participants sit
+    # the round out.  ``committee_cap`` is the STATIC shape bound of
+    # the per-committee histogram ([T, cap, 3]); count and size are
+    # DynParams members, so a committee-size/count curve sweeps in one
+    # bucket executable (sweep.run_points_batched).  Same constraints
+    # as topology (delivery='all', tpu backend); 'equivocate' is not
+    # supported (its per-edge adversary machinery is complete-graph /
+    # topology only).  Mutually exclusive with ``topology``.
+    committee_cap: int = 0
+    committee_count: int = 0
+    committee_size: int = 0
+
     # --- compute path ---------------------------------------------------
     # 'dense':     explicit [T, N, N] delivery mask; exact; N <= ~10^4.
     # 'histogram': O(N) global per-class counts + per-lane (multivariate)
@@ -315,6 +351,71 @@ class SimConfig:
                 f"scheduler={self.scheduler!r} has no effect under "
                 "delivery='all'; use delivery='quorum' or "
                 "scheduler='uniform'")
+        if self.topology == "complete":
+            # the identity spec: normalize to None so a 'complete' config
+            # IS the pre-topology config — same hash, same jit cache
+            # entry, bit-identical results and compile counts for free
+            object.__setattr__(self, "topology", None)
+        if self.topology is not None:
+            from .topo.graphs import parse_topology
+            spec = parse_topology(self.topology)   # ValueError if malformed
+            spec.validate(self.n_nodes)
+            if self.delivery != "all":
+                raise ValueError(
+                    "topology replaces the complete graph with a "
+                    "deterministic neighbor fan-in — the quorum-subset "
+                    "delivery model has no meaning on it; use "
+                    "delivery='all'")
+            if self.backend != "tpu":
+                raise ValueError(
+                    "topology runs the device delivery plane "
+                    "(benor_tpu/topo); the event-loop oracles only "
+                    "implement the complete graph — a silent no-op "
+                    "would fake the structured semantics, so use "
+                    "backend='tpu'")
+            if self.committee_cap:
+                raise ValueError(
+                    "topology and committee_cap are mutually exclusive "
+                    "delivery planes; arm one")
+        if self.committee_cap < 0 or self.committee_count < 0 or \
+                self.committee_size < 0:
+            raise ValueError("committee knobs must be >= 0")
+        if self.committee_cap:
+            if not (1 <= self.committee_count <= self.committee_cap):
+                raise ValueError(
+                    "committee_count must be in [1, committee_cap] "
+                    f"(got {self.committee_count} with "
+                    f"cap={self.committee_cap}): the cap is the static "
+                    "per-committee histogram bound the traced count "
+                    "must fit under")
+            if self.committee_cap > self.n_nodes:
+                raise ValueError(
+                    "committee_cap must be <= n_nodes (more committees "
+                    "than nodes cannot all be populated)")
+            if self.committee_size < 1:
+                raise ValueError(
+                    "committee_size must be >= 1 when committee_cap "
+                    "arms committee delivery")
+            if self.delivery != "all":
+                raise ValueError(
+                    "committee delivery samples its own membership — "
+                    "the quorum-subset delivery model has no meaning "
+                    "on it; use delivery='all'")
+            if self.backend != "tpu":
+                raise ValueError(
+                    "committee delivery runs the device delivery plane "
+                    "(benor_tpu/topo); the event-loop oracles only "
+                    "implement the complete graph, so use backend='tpu'")
+            if self.fault_model == "equivocate":
+                raise ValueError(
+                    "fault_model='equivocate' is not supported with "
+                    "committee delivery (per-edge equivocation is "
+                    "complete-graph / topology machinery); use crash, "
+                    "crash_at_round or byzantine")
+        elif self.committee_count or self.committee_size:
+            raise ValueError(
+                "committee_count/committee_size require committee_cap "
+                "(the static histogram bound); set all three or none")
         if self.poll_rounds < 0:
             raise ValueError("poll_rounds must be >= 0")
         if self.heartbeat_rounds < 0:

@@ -74,8 +74,18 @@ def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
     recorder, the witness only REDUCES values the round already computes,
     so witnessed results are bit-identical to unwitnessed ones.
 
+    Structured delivery (benor_tpu/topo): with ``cfg.topology`` set the
+    tallies come from each receiver's d+1 graph neighborhood
+    (tally.receiver_counts dispatches to topo/deliver.py), and with
+    ``cfg.committee_cap`` from this round's sampled committee — whose
+    membership is drawn ONCE below and masks ``active`` so
+    non-participants sit the round out with frozen state.  The decide
+    logic is unchanged either way: count > F, now read against the
+    neighborhood/committee tally.
+
     ``dyn`` (DynParams or None) supplies F and the quorum as TRACED
-    scalars for the batched dynamic-F sweep (sweep.run_curve_batched):
+    scalars for the batched dynamic-F sweep (sweep.run_curve_batched) —
+    plus the committee count/size axes for the topo sweeps:
     with it, one compiled round loop serves every fault count whose
     static shape/mode matches ``cfg`` — the decide thresholds, quorum
     gate, closed-form adversaries and CF samplers all take the traced
@@ -147,24 +157,45 @@ def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
     frozen = state.decided & cfg.freeze_decided
     active = alive & quorum_ok & ~frozen
 
+    # Committee delivery (benor_tpu/topo/committees.py): sample this
+    # round's membership ONCE (both phases tally the same committees) and
+    # sit non-participants out — their state, k included, freezes for the
+    # round, and their broadcast goes silent (the senders mask below).
+    # count/size ride DynParams on the batched path, so a committee
+    # size/count curve shares one executable.
+    member = com_id = None
+    if cfg.committee_cap:
+        from ..topo import committees
+        g = cfg.committee_count if dyn is None else dyn.committee_count
+        csz = cfg.committee_size if dyn is None else dyn.committee_size
+        member, com_id = committees.membership(
+            cfg, base_key, r, ctx.trial_ids(T), ctx.node_ids(N), g, csz)
+        active = active & member
+
     # --- phase 1: "proposal phase" (node.ts:46-82) -----------------------
-    # Dense sharded path: gather the (round-constant) alive mask once for
-    # both phases instead of once per tally.  Equivocators (alive,
-    # per-receiver random/adversarial values) ride the same prefetch.
-    dense_gather = tally.dense_gather_needed(cfg)
-    alive_g = ctx.all_gather_nodes(alive) if dense_gather else None
+    # Dense sharded path AND the topology gather path: gather the
+    # (round-constant) alive mask once for both phases instead of once
+    # per tally.  Equivocators (alive, per-receiver random/adversarial
+    # values) ride the same prefetch.
+    gather_masks = tally.dense_gather_needed(cfg) or \
+        cfg.topology is not None
+    alive_g = ctx.all_gather_nodes(alive) if gather_masks else None
     equiv = faults.faulty if cfg.fault_model == "equivocate" else None
     equiv_g = ctx.all_gather_nodes(equiv) \
-        if (dense_gather and equiv is not None) else None
+        if (gather_masks and equiv is not None) else None
     # global live-equivocator count: round-constant, hoisted so the
     # histogram path keeps its one-psum-per-phase collective budget
     n_equiv = ctx.psum_nodes(
         jnp.sum(equiv & alive, axis=-1, dtype=jnp.int32)) \
         if equiv is not None else None
     sent1 = _sent_values(cfg, state.x, faults)
-    cnt1 = tally.receiver_counts(cfg, base_key, r, rng.PHASE_PROPOSAL,
-                                 sent1, alive, ctx, alive_g,
-                                 equiv, equiv_g, n_equiv, dyn)  # [T, N, 3]
+    if member is not None:
+        cnt1 = committees.committee_counts(cfg, sent1, alive & member,
+                                           com_id, ctx)
+    else:
+        cnt1 = tally.receiver_counts(cfg, base_key, r, rng.PHASE_PROPOSAL,
+                                     sent1, alive, ctx, alive_g,
+                                     equiv, equiv_g, n_equiv, dyn)  # [T, N, 3]
     p0, p1 = cnt1[..., 0], cnt1[..., 1]
     # majority -> value, tie -> "?" (node.ts:63-69)
     x1 = jnp.where(p0 > p1, jnp.int8(VAL0),
@@ -177,9 +208,13 @@ def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
     # starve its peers' quorums).
     vote_val = jnp.where(frozen, state.x, x1)
     sent2 = _sent_values(cfg, vote_val, faults)
-    cnt2 = tally.receiver_counts(cfg, base_key, r, rng.PHASE_VOTE,
-                                 sent2, alive, ctx, alive_g,
-                                 equiv, equiv_g, n_equiv, dyn)
+    if member is not None:
+        cnt2 = committees.committee_counts(cfg, sent2, alive & member,
+                                           com_id, ctx)
+    else:
+        cnt2 = tally.receiver_counts(cfg, base_key, r, rng.PHASE_VOTE,
+                                     sent2, alive, ctx, alive_g,
+                                     equiv, equiv_g, n_equiv, dyn)
     v0, v1 = cnt2[..., 0], cnt2[..., 1]
 
     decide0 = v0 > F                                         # node.ts:99

@@ -35,6 +35,16 @@ def pallas_stream_active(cfg: SimConfig) -> bool:
             and cfg.quorum > sampling.EXACT_TABLE_MAX)
 
 
+def pallas_requested(cfg: SimConfig) -> bool:
+    """True iff the config ASKS for any fused kernel (hist or round) —
+    regardless of whether its regime can serve one.  sim.run_consensus
+    uses this to announce the structural demotion under a structured
+    delivery plane (topology/committees require delivery='all', which
+    every pallas gate below rejects), without the driver re-reading the
+    kernel flags itself."""
+    return cfg.use_pallas_hist or cfg.use_pallas_round
+
+
 def pallas_hist_active(cfg: SimConfig) -> bool:
     """True iff the fused pallas sampler serves this config's histogram
     tallies."""
@@ -167,6 +177,18 @@ def receiver_counts(cfg: SimConfig, base_key: jax.Array, r: jax.Array,
     trial_ids = ctx.trial_ids(T)
     node_ids = ctx.node_ids(N)
     m = cfg.quorum if dyn is None else dyn.quorum
+
+    # Adjacency-structured delivery (benor_tpu/topo): each receiver
+    # tallies exactly its topology neighborhood (d graph neighbors +
+    # itself) — one O(N*d) gather, never a dense N x N mask.  Requires
+    # delivery='all' (config.py enforces it), so no scheduler below ever
+    # composes with it; equivocators get per-edge fair bits inside the
+    # gather, the dense path's exact semantics at sparse cost.
+    if cfg.topology is not None:
+        from ..topo.deliver import neighborhood_counts
+        return neighborhood_counts(cfg, base_key, r, phase, sent, alive,
+                                   ctx, equiv=equiv, alive_g=alive_g,
+                                   equiv_g=equiv_g)
 
     honest = alive if equiv is None else (alive & ~equiv)
     if equiv is not None and n_equiv is None:

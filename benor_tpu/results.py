@@ -673,6 +673,41 @@ def coin_contrast(n: int, trials: int, seed: int = 0,
     return coin_comparison(cfg)
 
 
+def topo_curves(n: int, trials: int, seed: int = 0,
+                max_rounds: int = 32, verbose: bool = False) -> Dict:
+    """The structured-delivery science rows (PR 12, ROADMAP item 3):
+    rounds-to-decide vs degree/diameter over the default
+    ring/torus/random-regular ladder (neighborhood-unanimity decide
+    bar — benor_tpu/topo/curves.unanimity_fault explains why laxer
+    bars flatten the curve) and the committee-size sweep at a fixed
+    committee count — the latter batched as ONE bucket executable
+    (committee size/count ride DynParams), whose compile count rides
+    the return as the coalescing proof bench's ``topo`` blob pins.
+
+    Both curves run through the batched engine
+    (sweep.run_points_batched); rows are json-ready dicts
+    (tools/check_metrics_schema.check_topo_blob recomputes the
+    degree/diameter metadata from the spec strings)."""
+    from .topo.curves import (committee_curve, default_degree_specs,
+                              degree_curve)
+
+    base = SimConfig(n_nodes=n, n_faulty=0, trials=trials,
+                     max_rounds=max_rounds, seed=seed)
+    deg_rows = degree_curve(base, default_degree_specs(n),
+                            verbose=verbose)
+    # The swept sizes stay <= N/committee_count: the participation
+    # probability p = min(1, c*g/N) clips at c = N/g, beyond which
+    # every point draws the IDENTICAL membership — a ladder past the
+    # clip would ship duplicate rows masquerading as distinct sizes
+    # (committees.py documents the saturation).
+    sizes = sorted({max(2, n // 16), max(3, n // 8), max(4, n // 4)})
+    com_rows, cb = committee_curve(base.replace(n_faulty=1), sizes=sizes,
+                                   committee_count=4, verbose=verbose)
+    return {"degree_curve": deg_rows, "committee_curve": com_rows,
+            "committee_compile_count": cb.compile_count,
+            "committee_buckets": cb.n_buckets}
+
+
 def generate(out_dir: str = "RESULTS", n_large: int = 1_000_000,
              trials_large: int = 32, seed: int = 0,
              presets=True) -> Dict[str, object]:

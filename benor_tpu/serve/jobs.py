@@ -42,9 +42,15 @@ from ..config import SimConfig
 #: JobSpec fields forwarded to SimConfig verbatim (everything else is
 #: job-plane metadata).  A pure literal so the README's "what can a job
 #: carry" table and the server's rejection messages cannot drift.
+#: ``topology`` and the committee knobs (PR 12) ride here too, so the
+#: request plane serves the structured-delivery workloads; they are in
+#: ``serve_bucket_key`` by construction (the sweep bucket token keys on
+#: the full config), so mismatched topologies never coalesce into one
+#: launch while committee count/size coalesce as DynParams axes.
 CONFIG_FIELDS = ("n_nodes", "n_faulty", "trials", "max_rounds", "rule",
                  "seed", "coin_mode", "coin_eps", "delivery", "scheduler",
-                 "adversary_strength", "fault_model", "path")
+                 "adversary_strength", "fault_model", "path", "topology",
+                 "committee_cap", "committee_count", "committee_size")
 
 #: The four client verbs.
 JOB_KINDS = ("simulate", "sweep", "trajectory", "audit")
@@ -131,7 +137,11 @@ def timing_dict(stamps: Dict[str, float]) -> Dict[str, Any]:
 #: that makes serving pay (README Serving's cost model).  Operators
 #: running a private instance can lift them via ServeApp(limits=...).
 DEFAULT_LIMITS = {"n_nodes": 1 << 16, "trials": 1 << 12,
-                  "max_rounds": 1 << 10, "f_values": 64}
+                  "max_rounds": 1 << 10, "f_values": 64,
+                  # committee_cap sizes the [T, cap, 3] per-committee
+                  # histogram inside the executable — an uncapped value
+                  # would let one job allocate a trials*cap-scale buffer
+                  "committee_cap": 1 << 10}
 
 
 class JobError(ValueError):
@@ -161,6 +171,13 @@ class JobSpec:
     adversary_strength: float = 0.0
     fault_model: str = "crash"
     path: str = "auto"
+    #: structured delivery (benor_tpu/topo): an adjacency spec string
+    #: ('complete' | 'ring:<d>' | 'torus2d:<r>x<c>' | 'expander:<d>' |
+    #: 'random_regular:<d>[:seed]') or null, and the committee knobs.
+    topology: Optional[str] = None
+    committee_cap: int = 0
+    committee_count: int = 0
+    committee_size: int = 0
     #: sweep kind only: the curve's f grid (expands to per-point jobs).
     f_values: Optional[Tuple[int, ...]] = None
 
@@ -191,6 +208,18 @@ class JobSpec:
             if f not in doc:
                 continue
             v = doc[f]
+            if f == "topology":
+                # Optional[str]: the generic type check below would key
+                # on NoneType.  Spec-string VALIDITY (grammar, degree
+                # bounds, N coverage) is SimConfig's parse at the
+                # to_config() probe — those surface as structured 400s
+                # on the 'config' field.
+                if v is not None and not isinstance(v, str):
+                    raise JobError(
+                        "topology", "must be a topology spec string "
+                                    "(e.g. 'torus2d:8x8') or null")
+                kw[f] = v
+                continue
             want = type(getattr(defaults, f))
             if want is float and isinstance(v, int) \
                     and not isinstance(v, bool):
@@ -221,6 +250,12 @@ class JobSpec:
             if v > limits[f]:
                 raise JobError(f, f"demo-scale request plane caps {f} at "
                                   f"{limits[f]} (see README Serving)")
+        if kw.get("committee_cap", 0) > limits["committee_cap"]:
+            raise JobError(
+                "committee_cap",
+                f"demo-scale request plane caps committee_cap at "
+                f"{limits['committee_cap']} (it sizes the per-committee "
+                f"histogram; see README Serving)")
         if kw.get("seed", 0) < 0:
             # run_point's input stream (np.random.default_rng) rejects
             # negative seeds — surface it at validation, not in a batch

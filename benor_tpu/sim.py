@@ -28,6 +28,49 @@ from .state import (FaultSpec, NetState, init_state, new_recorder,
 #: One warning per process for the debug-demotes-pallas perf cliff.
 _debug_demotion_warned = False
 
+#: One warning per process for the structured-delivery pallas demotion.
+_structured_demotion_warned = False
+
+
+def delivery_plane(cfg: SimConfig) -> str:
+    """Which delivery plane serves this config: 'topology'
+    (adjacency-structured neighbor fan-in, benor_tpu/topo/deliver.py),
+    'committee' (per-round sampled committees,
+    benor_tpu/topo/committees.py) or 'complete' (the paper's implicit
+    all-to-all graph — every pre-PR-12 config).  The driver-level
+    dispatch fact the regimes share: structured planes run the shared
+    round kernel's gather/scatter tallies on the traced XLA loop in
+    every regime; the fused pallas kernels only ever serve 'complete'
+    (see warn_structured_demotes_pallas)."""
+    if cfg.topology is not None:
+        return "topology"
+    if cfg.committee_cap:
+        return "committee"
+    return "complete"
+
+
+def warn_structured_demotes_pallas(cfg: SimConfig) -> None:
+    """A structured delivery plane (cfg.topology / cfg.committee_cap)
+    never engages the fused pallas kernels: structured delivery requires
+    delivery='all', which pallas_round_active / pallas_stream_active
+    already reject, so a use_pallas_round/use_pallas_hist config runs
+    the per-round XLA loop instead.  That demotion is STRUCTURAL (the
+    kernels implement the complete graph only) — but silent flag-
+    swallowing is how perf cliffs hide, so announce it once per
+    process, the debug-demotion policy's sibling."""
+    global _structured_demotion_warned
+    if _structured_demotion_warned:
+        return
+    _structured_demotion_warned = True
+    warnings.warn(
+        "SimConfig(use_pallas_round/use_pallas_hist) has no effect under "
+        f"the {delivery_plane(cfg)!r} delivery plane: the fused kernels "
+        "implement the complete graph only, so this run takes the "
+        "per-round XLA loop (the topo gather/scatter tallies).  Results "
+        "are exactly the structured plane's semantics; only the "
+        "kernel-speed expectation is off.",
+        stacklevel=3)
+
 
 def warn_debug_demotes_pallas(cfg: SimConfig) -> None:
     """cfg.debug silently routes a fused-pallas-eligible config onto the
@@ -150,8 +193,10 @@ def run_consensus(cfg: SimConfig, state: NetState, faults: FaultSpec,
     ``cfg.record`` (the flight recorder) is the observation mechanism
     that does NOT change which code runs.
     """
-    from .ops.tally import pallas_round_active
+    from .ops.tally import pallas_requested, pallas_round_active
 
+    if pallas_requested(cfg) and delivery_plane(cfg) != "complete":
+        warn_structured_demotes_pallas(cfg)
     if pallas_round_active(cfg):
         if cfg.debug:
             warn_debug_demotes_pallas(cfg)
@@ -185,12 +230,19 @@ def run_consensus_traced(cfg: SimConfig, state: NetState, faults: FaultSpec,
     flight recorder appended when cfg.record and the filled witness
     buffer when cfg.witness (recorder first when both).
     """
-    from .ops.tally import pallas_round_active
+    from .ops.tally import pallas_requested, pallas_round_active
 
     if dyn is not None and pallas_round_active(cfg):
         raise ValueError(
             "dynamic-F tracing cannot drive the fused pallas round; "
             "bucket such configs statically (sweep.quorum_specialized)")
+    # structured configs are never quorum-specialized, so the batched
+    # engine (and the serve dyn runner) reach THIS entry point directly
+    # — announce the structural pallas demotion here too, or a
+    # use_pallas_* sweep would silently swallow the flag (the exact
+    # cliff the one-shot path warns about in run_consensus)
+    if pallas_requested(cfg) and delivery_plane(cfg) != "complete":
+        warn_structured_demotes_pallas(cfg)
     state = start_state(cfg, state)
     carry = (jnp.int32(1), state)
     if cfg.record:

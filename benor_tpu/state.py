@@ -70,18 +70,32 @@ class DynParams:
 
     n_faulty: jax.Array  # int32 [] — F, the protocol fault parameter
     quorum: jax.Array    # int32 [] — N - F (node.ts:52,88)
+    # Committee-delivery knobs (benor_tpu/topo/committees.py): the
+    # committee count g and target size c as traced scalars, so a
+    # committee-size/count curve sweeps inside one bucket executable
+    # exactly like the f-axis (the STATIC shape bound stays
+    # cfg.committee_cap).  0/0 whenever committee delivery is off —
+    # the values are only ever read under cfg.committee_cap > 0.
+    committee_count: jax.Array  # int32 []
+    committee_size: jax.Array   # int32 []
 
     @classmethod
     def from_config(cls, cfg: SimConfig) -> "DynParams":
         return cls(n_faulty=jnp.int32(cfg.n_faulty),
-                   quorum=jnp.int32(cfg.quorum))
+                   quorum=jnp.int32(cfg.quorum),
+                   committee_count=jnp.int32(cfg.committee_count),
+                   committee_size=jnp.int32(cfg.committee_size))
 
     @classmethod
     def stack(cls, cfgs) -> "DynParams":
         """[B]-batched params from per-point configs (the vmap input)."""
         f = np.asarray([c.n_faulty for c in cfgs], np.int32)
         m = np.asarray([c.quorum for c in cfgs], np.int32)
-        return cls(n_faulty=jnp.asarray(f), quorum=jnp.asarray(m))
+        g = np.asarray([c.committee_count for c in cfgs], np.int32)
+        s = np.asarray([c.committee_size for c in cfgs], np.int32)
+        return cls(n_faulty=jnp.asarray(f), quorum=jnp.asarray(m),
+                   committee_count=jnp.asarray(g),
+                   committee_size=jnp.asarray(s))
 
 
 @jax.tree_util.register_dataclass

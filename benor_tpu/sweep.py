@@ -306,8 +306,12 @@ def quorum_specialized(cfg: SimConfig) -> bool:
             and cfg.quorum <= sampling.EXACT_TABLE_MAX):
         return True                 # exact shared-CDF table: [T, m+1]
     if (cfg.fault_model == "equivocate" and cfg.delivery == "all"
+            and cfg.topology is None
             and cfg.n_faulty <= sampling.EXACT_TABLE_MAX):
-        return True                 # exact binomial table: [T, F+1]
+        # exact binomial table: [T, F+1].  A topology carries its own
+        # per-edge equivocator bits (benor_tpu/topo/deliver.py) — no
+        # F-shaped table, so topology points stay dyn-compatible.
+        return True
     return False
 
 
@@ -315,10 +319,17 @@ def sweep_bucket_key(cfg: SimConfig):
     """Hashable bucket token: two sweep points share one compiled batched
     executable iff their keys are equal.  Quorum-specialized points key on
     the full config (a bucket of one); everything else keys on the config
-    with the f-axis erased."""
+    with the DYNAMIC axes erased — n_faulty always, and the committee
+    count/size knobs when committee delivery is armed (they ride
+    DynParams; the static committee_cap shape bound stays in the key, as
+    does the topology spec — mismatched adjacency never shares an
+    executable)."""
     if quorum_specialized(cfg):
         return ("static", cfg)
-    return ("dyn", cfg.replace(n_faulty=0))
+    erase = {"n_faulty": 0}
+    if cfg.committee_cap:
+        erase.update(committee_count=1, committee_size=1)
+    return ("dyn", cfg.replace(**erase))
 
 
 @dataclasses.dataclass
@@ -351,10 +362,37 @@ def run_curve_batched(base_cfg: SimConfig, f_values: Sequence[int],
                       verbose: bool = False,
                       heartbeat_path: Optional[str] = None
                       ) -> BatchedCurve:
-    """Run a rounds-vs-f curve with one XLA compile per static-shape bucket.
+    """Run a rounds-vs-f curve with one XLA compile per static-shape
+    bucket — the f-axis front door of ``run_points_batched`` (which
+    batches ANY per-point config list, e.g. the topo committee curves):
+    each f value becomes ``base_cfg.replace(n_faulty=f)`` and the
+    generalized engine does the rest.  Semantics match the per-point
+    loop exactly — same inputs, same random streams, bit-identical
+    per-f summaries (tests/test_batched_sweep.py)."""
+    cfgs = [base_cfg.replace(n_faulty=int(f)) for f in f_values]
+    return run_points_batched(base_cfg, cfgs,
+                              initial_values=initial_values,
+                              faults_for=faults_for, verbose=verbose,
+                              heartbeat_path=heartbeat_path)
+
+
+def run_points_batched(base_cfg: SimConfig, cfgs: Sequence[SimConfig],
+                       initial_values=None, faults_for=None,
+                       verbose: bool = False,
+                       heartbeat_path: Optional[str] = None
+                       ) -> BatchedCurve:
+    """Run a list of per-point configs with one XLA compile per
+    static-shape bucket (sweep_bucket_key groups them).
+
+    The generalization PR 12 extracted from the f-axis engine so the
+    topo workloads batch too: points may differ in ANY DynParams-traced
+    axis (n_faulty, committee_count/committee_size) and share a bucket,
+    or differ statically (topology spec, shapes, modes) and bucket
+    apart.  Every point must share base_cfg's (trials, n_nodes) — the
+    stacked input tensors are built once.
 
     Semantics match the per-point loop exactly — same inputs, same
-    random streams, bit-identical per-f summaries:
+    random streams, bit-identical per-point summaries:
 
       * ``initial_values`` defaults to ``random_inputs(seed, T, N)``
         (run_point's default, shared by every point);
@@ -394,13 +432,19 @@ def run_curve_batched(base_cfg: SimConfig, f_values: Sequence[int],
     from .utils.compile_counter import count_backend_compiles
 
     T, N = base_cfg.trials, base_cfg.n_nodes
+    for cfg_f in cfgs:
+        if (cfg_f.trials, cfg_f.n_nodes) != (T, N):
+            raise ValueError(
+                "run_points_batched points must share base_cfg's "
+                f"(trials, n_nodes)=({T}, {N}); got "
+                f"({cfg_f.trials}, {cfg_f.n_nodes})")
     if initial_values is None:
         initial_values = random_inputs(base_cfg.seed, T, N)
 
     faults_fn = faults_for if faults_for is not None else default_crash_faults
 
     # ---- prepare (host side): bucket the points, build + stack inputs ----
-    cfgs = [base_cfg.replace(n_faulty=int(f)) for f in f_values]
+    cfgs = list(cfgs)
     buckets: Dict = {}
     order: List = []
     for i, cfg_f in enumerate(cfgs):

@@ -1,0 +1,28 @@
+"""benor-topo: adjacency- and committee-structured consensus delivery.
+
+The first delivery plane since PR 1 that changes WHO a receiver tallies
+rather than how fast: ``SimConfig(topology=...)`` replaces the implicit
+complete graph with a declarative sparse spec (ring / 2D torus /
+expander / random-regular — closed-form neighbor indices or one static
+[N, d] table, never a dense N x N adjacency tensor), and
+``SimConfig(committee_cap/count/size)`` replaces it with per-round
+``fold_in``-sampled committees whose size/count sweep as traced
+DynParams.  Both planes run through the shared round kernel
+(models/benor.py) on every regime that reaches it — traced loop,
+batched sweep, sharded mesh — with the quorum rule relativized to the
+neighborhood/committee (count > F within the d + 1 neighborhood) and
+the witness auditor's quorum-evidence bound relaxed to match
+(benor_tpu/audit.py).
+
+Modules: ``graphs`` (spec grammar + metadata + tables, stdlib-loadable
+for the schema checker), ``deliver`` (the O(N*d) gather tally),
+``committees`` (membership + committee histograms), ``curves``
+(rounds-vs-degree / committee-size science rows for bench's ``topo``
+blob).
+"""
+
+from .graphs import (KINDS, TopologySpec, build_neighbor_table,
+                     circulant_offsets, parse_topology)
+
+__all__ = ["KINDS", "TopologySpec", "build_neighbor_table",
+           "circulant_offsets", "parse_topology"]

@@ -1,0 +1,110 @@
+"""Per-round sampled committees: the second structured delivery plane.
+
+Grounds the "Committee Configuration Optimization for Parallel Byzantine
+Consensus" direction (ROADMAP item 3b): instead of every receiver
+tallying the whole network, each round samples committees and every
+participating node tallies ONLY its committee co-members (itself
+included).  Two knobs, BOTH swept as traced ``DynParams`` members so a
+whole committee-size/count curve shares one bucket executable
+(sweep.run_points_batched):
+
+  ``committee_count``  g — how many parallel committees each round draws
+  ``committee_size``   c — the target (expected) members per committee
+
+plus the STATIC ``committee_cap`` >= committee_count: the per-committee
+histogram's shape bound ``[T, cap, 3]``, which is what lets g itself be
+traced (shapes never depend on the swept value).
+
+Membership is ``fold_in``-derived (ops/rng.py's chained counter
+discipline, two dedicated phase tags): per (trial, round, node), a node
+participates with probability min(1, c*g/N) and, when participating,
+joins committee ``floor(u * g)`` — so membership is bit-reproducible
+under a fixed seed, identical across mesh shapes (keys derive from
+GLOBAL ids) and identical between the static and the traced-DynParams
+paths (the arithmetic is float32 in both).  Expected committee size is
+exactly c for c <= N/g; past that the participation probability clips
+at 1 and membership SATURATES (everyone in, expected size N/g
+regardless of c) — curve builders keep swept sizes at or below N/g so
+every point is a distinct workload (results.topo_curves documents the
+ladder).  All draws are independent per round (per-ROUND sampled
+committees — both protocol phases of a round tally the same
+membership).
+
+Non-participants sit the round out: ``models/benor.py`` masks them out
+of ``active`` (their state, including k, is untouched — the same
+freeze discipline decided lanes get), and their broadcast is silent for
+the round.  The decide rule is unchanged ``count(v) > F`` — now read
+against the committee tally, the relaxed quorum rule the auditor
+understands.
+
+Cost: one [T, N] uniform pair for membership, three [T, N] -> [T, cap]
+scatter-adds for the per-committee histograms, one gather back —
+O(N + T * cap) per phase, never anything N x N.  Mesh: committee ids
+key on global node ids and the histogram psums over node shards, the
+exact discipline of the complete-graph histogram path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..config import SimConfig, VAL0, VAL1, VALQ
+from ..ops import rng
+from ..ops.collectives import SINGLE, ShardCtx
+
+#: Dedicated rng phase tags (ops/rng.py uses 0-3 and their +16/+32/+48
+#: offsets; these stay clear of every existing stream).
+PHASE_MEMBER = 8     # participation draw
+PHASE_ASSIGN = 9     # committee-id draw
+
+
+def membership(cfg: SimConfig, base_key: jax.Array, r: jax.Array,
+               trial_ids: jax.Array, node_ids: jax.Array,
+               count, size):
+    """Per-round committee membership -> (member bool [T, N],
+    committee_id int32 [T, N]).
+
+    ``count``/``size`` are g and c — python ints on the static path,
+    traced int32 scalars under DynParams; the arithmetic below is
+    float32 either way, so the two paths draw bit-identical
+    memberships for equal values (the sweep-vs-oracle house rule).
+    Drawn once per ROUND (both phases share it) from two dedicated
+    fold_in streams keyed on global ids."""
+    u_p = rng.grid_uniforms(base_key, r, PHASE_MEMBER, trial_ids,
+                            node_ids)
+    u_g = rng.grid_uniforms(base_key, r, PHASE_ASSIGN, trial_ids,
+                            node_ids)
+    g = jnp.asarray(count, jnp.int32).astype(jnp.float32)
+    c = jnp.asarray(size, jnp.int32).astype(jnp.float32)
+    p = jnp.minimum(jnp.float32(1.0),
+                    (c * g) / jnp.float32(cfg.n_nodes))
+    member = u_p < p
+    cid = jnp.clip(jnp.floor(u_g * g).astype(jnp.int32), 0,
+                   cfg.committee_cap - 1)
+    return member, cid
+
+
+def committee_counts(cfg: SimConfig, sent: jax.Array, senders: jax.Array,
+                     cid: jax.Array, ctx: ShardCtx = SINGLE) -> jax.Array:
+    """Per-receiver class counts over the receiver's committee -> int32
+    [T, N, 3].
+
+    ``senders`` masks the lanes whose broadcast lands this round
+    (alive AND participating — killed lanes and sit-outs go silent);
+    ``cid`` is the per-lane committee id from ``membership``.  Three
+    scatter-adds build the [T, cap, 3] per-committee histogram (psum'd
+    over node shards under a mesh), then every lane gathers its own
+    committee's row.  A non-participant's gathered row is discarded by
+    the round kernel's ``active`` mask."""
+    T, n_loc = sent.shape
+    G = cfg.committee_cap
+    t_idx = jnp.broadcast_to(
+        jnp.arange(T, dtype=jnp.int32)[:, None], (T, n_loc))
+    hists = []
+    for v in (VAL0, VAL1, VALQ):
+        contrib = ((sent == v) & senders).astype(jnp.int32)
+        hists.append(jnp.zeros((T, G), jnp.int32)
+                     .at[t_idx, cid].add(contrib))
+    hist = ctx.psum_nodes(jnp.stack(hists, axis=-1))      # [T, cap, 3]
+    return jnp.take_along_axis(hist, cid[:, :, None], axis=1)

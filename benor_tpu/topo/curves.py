@@ -1,0 +1,162 @@
+"""The topo workload curves: rounds-to-decide vs degree / committee axes.
+
+The science harness of the ``benor_tpu/topo`` delivery plane, built on
+the batched engine's generalized entry point
+(``sweep.run_points_batched``) so the whole committee curve shares ONE
+bucket executable (committee size/count ride DynParams) and every
+topology point batches its own f-axis:
+
+  ``degree_curve``     rounds-to-decide vs degree/diameter over a list
+                       of topology specs (ring / torus2d /
+                       random_regular / expander) — ROADMAP item 3a's
+                       "Unknown Torus" axis.  Each spec is its own
+                       static bucket (adjacency is compiled in);
+                       rows carry the spec's degree/diameter metadata
+                       so the curve plots against either.
+  ``committee_curve``  rounds-to-decide vs committee size (or count) at
+                       a fixed cap — ROADMAP item 3b's committee-
+                       configuration axis.  All points share one
+                       static shape, so the curve is one compile.
+
+Rows are plain dicts (json-ready): the bench's ``topo`` blob embeds
+them and tools/check_metrics_schema.py cross-checks the
+degree/diameter metadata against the spec strings.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from ..config import SimConfig
+from .graphs import parse_topology
+
+#: The default degree-curve spec ladder at N nodes: ring degrees
+#: climbing toward the torus and a random-regular point — the
+#: ring/torus/random-regular mix the acceptance curve asks for.
+def default_degree_specs(n_nodes: int) -> List[str]:
+    import math
+
+    side = int(math.isqrt(n_nodes))
+    specs = ["ring:2", "ring:4", "ring:8"]
+    if side * side == n_nodes and side >= 3:
+        specs.append(f"torus2d:{side}x{side}")
+    specs.append("random_regular:6:1")
+    return specs
+
+
+def unanimity_fault(spec_str: str) -> int:
+    """The degree-curve's default protocol F for one spec: F = d, so
+    deciding needs count > d — a UNANIMOUS d + 1 neighborhood.  The
+    quorum rule relativized to the degree at its strictest useful
+    setting: any laxer bar (e.g. a neighborhood majority) decides in
+    round 1 on random inputs because odd neighborhoods always hold a
+    strict local majority, flattening the curve.  Under unanimity the
+    decide latency is the local-consensus-formation time, which
+    genuinely varies with connectivity (richer neighborhoods mix
+    faster — the rounds-vs-degree signal the curve exists to show).
+
+    'complete' is rejected loudly: the complete graph is the BASELINE
+    the curve is measured against (degree N-1, diameter 1 — no degree
+    axis to sweep); compare against it via sweep.run_point /
+    run_curve_batched on the untopologized config."""
+    spec = parse_topology(spec_str)
+    if spec is None:
+        raise ValueError(
+            "'complete' has no degree axis — it is the baseline, not a "
+            "curve point; run it through sweep.run_point/"
+            "run_curve_batched without a topology instead")
+    return spec.degree
+
+
+def degree_curve(base_cfg: SimConfig, specs: Sequence[str],
+                 n_faulty_for=None, initial_values=None,
+                 verbose: bool = False) -> List[Dict]:
+    """Run one point per topology spec through the batched engine and
+    return json-ready rows sorted by degree (the monotonicity axis the
+    schema checker pins).
+
+    ``n_faulty_for(spec_str) -> F`` defaults to ``unanimity_fault``;
+    inputs default to run_point's per-trial random bits; faults default
+    to FaultSpec.none (zero crashes — F is purely the neighborhood
+    decide bar, the same decoupling the balanced curves use)."""
+    from ..state import FaultSpec
+    from ..sweep import run_points_batched
+
+    for s in specs:
+        if parse_topology(s) is None:
+            raise ValueError(
+                "degree_curve sweeps adjacency specs; 'complete' is "
+                "the baseline, not a curve point (it has no degree "
+                "axis) — measure it via sweep.run_point/"
+                "run_curve_batched on the untopologized config")
+    nf = n_faulty_for if n_faulty_for is not None else unanimity_fault
+    cfgs = [base_cfg.replace(topology=s, n_faulty=int(nf(s)))
+            for s in specs]
+    cb = run_points_batched(
+        base_cfg, cfgs, initial_values=initial_values,
+        faults_for=lambda c: FaultSpec.none(c.trials, c.n_nodes),
+        verbose=verbose)
+    rows = []
+    for cfg_f, spec_str, pt in zip(cfgs, specs, cb.points):
+        spec = parse_topology(spec_str)
+        row = {"spec": spec.spec_string(),
+               **spec.metadata(cfg_f.n_nodes),
+               "n_nodes": cfg_f.n_nodes,
+               "n_faulty": cfg_f.n_faulty,
+               "rounds_executed": pt.rounds_executed,
+               "mean_k": round(pt.mean_k, 4),
+               "decided_frac": round(pt.decided_frac, 4),
+               "ones_frac": round(pt.ones_frac, 4),
+               "disagree_frac": round(pt.disagree_frac, 4)}
+        rows.append(row)
+    rows.sort(key=lambda r: (r["degree"], r["spec"]))
+    return rows
+
+
+def committee_curve(base_cfg: SimConfig,
+                    sizes: Optional[Sequence[int]] = None,
+                    counts: Optional[Sequence[int]] = None,
+                    committee_count: int = 4, committee_size: int = 16,
+                    cap: Optional[int] = None,
+                    verbose: bool = False):
+    """Sweep committee size (or count) -> (rows, BatchedCurve).
+
+    Exactly one of ``sizes`` / ``counts`` names the swept axis; the
+    other knob is held at ``committee_count`` / ``committee_size``.
+    Every point shares the static ``cap`` (default: the largest count
+    in play), so the whole curve is ONE dyn bucket — the returned
+    BatchedCurve's ``compile_count`` is the committee analog of the
+    f-axis compile-amortization proof (bench's topo blob records it)."""
+    from ..state import FaultSpec
+    from ..sweep import run_points_batched
+
+    if (sizes is None) == (counts is None):
+        raise ValueError("sweep exactly one of sizes= / counts=")
+    if counts is not None:
+        g_cap = int(cap if cap is not None else max(counts))
+        cfgs = [base_cfg.replace(committee_cap=g_cap,
+                                 committee_count=int(g),
+                                 committee_size=committee_size)
+                for g in counts]
+    else:
+        g_cap = int(cap if cap is not None else committee_count)
+        cfgs = [base_cfg.replace(committee_cap=g_cap,
+                                 committee_count=committee_count,
+                                 committee_size=int(c))
+                for c in sizes]
+    cb = run_points_batched(
+        base_cfg, cfgs,
+        faults_for=lambda c: FaultSpec.none(c.trials, c.n_nodes),
+        verbose=verbose)
+    rows = []
+    for cfg_f, pt in zip(cfgs, cb.points):
+        rows.append({"committee_size": cfg_f.committee_size,
+                     "committee_count": cfg_f.committee_count,
+                     "committee_cap": cfg_f.committee_cap,
+                     "n_nodes": cfg_f.n_nodes,
+                     "n_faulty": cfg_f.n_faulty,
+                     "rounds_executed": pt.rounds_executed,
+                     "mean_k": round(pt.mean_k, 4),
+                     "decided_frac": round(pt.decided_frac, 4),
+                     "disagree_frac": round(pt.disagree_frac, 4)})
+    return rows, cb

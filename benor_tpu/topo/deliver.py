@@ -1,0 +1,143 @@
+"""Neighborhood tallies: the adjacency-structured delivery plane.
+
+The complete-graph tally (``ops/tally.py``) reduces every live sender
+into one global histogram; here each receiver tallies exactly its
+topology neighborhood — the d senders its ``TopologySpec`` names plus
+ITSELF (reference quirk 6: broadcasts include self) — via one
+``[T, N, d]`` gather per phase, never a dense N x N anything: the
+neighbor indices are closed-form arithmetic on global receiver ids
+(ring / torus / expander) or a static ``[N, d]`` table constant
+(random_regular), so the compiled path costs O(N * d)
+(tests/test_topo.py asserts the shape bound on the jaxpr).
+
+Quorum relativization: the tallied multiset has d + 1 members, so the
+decide rule ``count(v) > F`` (node.ts:99-104 — unchanged code in
+models/benor.py) now reads "count > F within the d + 1 neighborhood";
+configs choose F relative to the degree, and benor_tpu/audit.py's
+relaxed quorum-evidence check bounds every witnessed tally by d + 1
+instead of the global quorum.
+
+Mesh-safe by the same discipline as the dense path: senders are
+all-gathered once per phase (``ctx.all_gather_nodes``), neighbor ids
+derive from GLOBAL receiver ids, and the equivocator edge bits key on
+(trial, global receiver id, neighbor slot), so results are
+bit-identical across mesh shapes.
+
+Fault models: crash / crash_at_round ride the ``alive`` mask (a dead
+neighbor's edge simply goes silent); ``byzantine`` rides the flipped
+``sent`` values; ``equivocate`` draws an independent fair bit per
+delivered (receiver, equivocator) edge — including the equivocator's
+self edge — exactly the per-edge semantics the dense path implements,
+at O(N * d) instead of O(N^2).
+
+The fused pallas kernels never engage under a topology: structured
+delivery requires ``delivery='all'``, which ``tally.pallas_round_active``
+/ ``pallas_stream_active`` already reject — the structural demotion
+``sim.warn_structured_demotes_pallas`` announces (the debug-demotion
+policy's sibling).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..config import SimConfig, VAL0, VAL1, VALQ
+from ..ops import rng
+from ..ops.collectives import SINGLE, ShardCtx
+from .graphs import circulant_offsets, build_neighbor_table, parse_topology
+
+
+def neighbor_ids(cfg: SimConfig, node_ids: jax.Array) -> jax.Array:
+    """Global sender ids each local receiver tallies -> int32
+    [N_local, d].
+
+    ``node_ids`` are this shard's GLOBAL receiver ids (ctx.node_ids), so
+    the same closed forms serve single-device and mesh runs.  Circulant
+    specs (ring / expander) are pure index arithmetic; the torus is
+    divmod arithmetic; random_regular gathers rows of its static table
+    constant."""
+    spec = parse_topology(cfg.topology)
+    n = cfg.n_nodes
+    if spec.kind in ("ring", "expander"):
+        offs = jnp.asarray(circulant_offsets(spec), jnp.int32)
+        return (node_ids[:, None] + offs[None, :]) % n
+    if spec.kind == "torus2d":
+        rows, cols = spec.rows, spec.cols
+        r, c = node_ids // cols, node_ids % cols
+        return jnp.stack([
+            r * cols + (c + 1) % cols,
+            r * cols + (c - 1) % cols,
+            ((r + 1) % rows) * cols + c,
+            ((r - 1) % rows) * cols + c,
+        ], axis=1)
+    # random_regular: the [N, d] table is a pure function of
+    # (graph_seed, N) built once at trace time — a static constant the
+    # executable bakes in; row-gather by global receiver id keeps the
+    # mesh contract
+    tbl = jnp.asarray(build_neighbor_table(spec, n))
+    return tbl[node_ids]
+
+
+def neighborhood_counts(cfg: SimConfig, base_key: jax.Array, r: jax.Array,
+                        phase: int, sent: jax.Array, alive: jax.Array,
+                        ctx: ShardCtx = SINGLE,
+                        equiv: Optional[jax.Array] = None,
+                        alive_g: Optional[jax.Array] = None,
+                        equiv_g: Optional[jax.Array] = None) -> jax.Array:
+    """Per-receiver class counts over the receiver's d + 1 neighborhood
+    -> int32 [T, N_local, 3].
+
+    The topology counterpart of ``tally.receiver_counts`` (which
+    dispatches here when ``cfg.topology`` is set): ``sent``/``alive``/
+    ``equiv`` are this shard's local [T_loc, N_loc] blocks; the sender
+    axis is all-gathered (the dense path's exact pattern) and each
+    local receiver gathers its d neighbor values — O(N * d) total, no
+    N x N tensor at any point.
+
+    ``alive_g``/``equiv_g`` are the ROUND-CONSTANT gathered masks the
+    caller hoists once per round (the dense path's exact prefetch
+    discipline — models/benor.py passes them for both phases); None
+    gathers locally (standalone callers, tests)."""
+    T, n_loc = sent.shape
+    node_ids = ctx.node_ids(n_loc)
+    nbr = neighbor_ids(cfg, node_ids)                     # [N_loc, d]
+    sent_g = ctx.all_gather_nodes(sent)                   # [T, N_glob]
+    if alive_g is None:
+        alive_g = ctx.all_gather_nodes(alive)
+    sv = jnp.take(sent_g, nbr, axis=1)                    # [T, N_loc, d]
+    av = jnp.take(alive_g, nbr, axis=1)
+    if equiv is not None:
+        if equiv_g is None:
+            equiv_g = ctx.all_gather_nodes(equiv)
+        ev = jnp.take(equiv_g, nbr, axis=1)
+        honest = av & ~ev
+        self_honest = alive & ~equiv
+    else:
+        honest = av
+        self_honest = alive
+
+    def class_count(v):
+        neigh = jnp.sum((sv == v) & honest, axis=-1, dtype=jnp.int32)
+        return neigh + ((sent == v) & self_honest).astype(jnp.int32)
+
+    counts = jnp.stack([class_count(v) for v in (VAL0, VAL1, VALQ)],
+                       axis=-1)                           # [T, N_loc, 3]
+
+    if equiv is not None:
+        # per-edge fair bits for delivered equivocator messages — one
+        # bit per (trial, receiver, neighbor slot) with slot d = the
+        # self edge, keyed on GLOBAL receiver ids (mesh-bit-identical);
+        # same stream family as the dense path's edge bits (phase + 32)
+        bits = rng.edge_uniforms(base_key, r, phase + 32,
+                                 ctx.trial_ids(T), node_ids,
+                                 rng.ids(nbr.shape[1] + 1)) < 0.5
+        deliv = jnp.concatenate(
+            [av & ev, (alive & equiv)[:, :, None]], axis=-1)
+        c1 = jnp.sum(deliv & bits, axis=-1, dtype=jnp.int32)
+        c0 = jnp.sum(deliv & ~bits, axis=-1, dtype=jnp.int32)
+        zeros = jnp.zeros_like(c0)
+        counts = counts + jnp.stack([c0, c1, zeros], axis=-1)
+    return counts

@@ -528,6 +528,38 @@ def test_config_parity_heartbeat_field_clean_and_mutation_fails(tmp_path):
                in f.message for f in active)
 
 
+def test_config_parity_topology_fields_clean_and_mutation_fails(tmp_path):
+    """ISSUE 12 satellite: the structured-delivery fields (topology,
+    committee_cap) are consumed by the driver (sim.delivery_plane) and
+    policed across the five regimes — the shipped tree passes (sweep.py
+    references both; pallas_round/sharded/multihost carry reasoned
+    PARITY_ALLOWLIST delegation entries), and removing the reference
+    from ONE regime fails lint."""
+    root = _parity_tree(tmp_path)
+    active, _ = _findings(root, rules=["config-parity"])
+    assert active == []        # clean as shipped (allowlist included)
+
+    # mutation: the sweep engine's bucketing stops seeing the topology
+    # axis — two different adjacency specs would silently share a
+    # compiled executable
+    _edit(root, "sweep.py", "and cfg.topology is None", "", count=1)
+    active, _ = _findings(root, rules=["config-parity"])
+    hits = [f for f in active if "topology" in f.message]
+    assert len(hits) == 1
+    f = hits[0]
+    assert f.rule == "config-parity" and f.path == "sim.py"
+    assert "sweep.py" in f.message
+
+    # committee_cap mutation, independently: erase the committee-knob
+    # bucketing from sweep_bucket_key
+    root2 = _parity_tree(tmp_path.joinpath("second"))
+    _edit(root2, "sweep.py", "if cfg.committee_cap:", "if False:",
+          count=1)
+    active, _ = _findings(root2, rules=["config-parity"])
+    assert any("committee_cap" in f.message and "sweep.py" in f.message
+               for f in active)
+
+
 # --------------------------------------------------------------------------
 # perf observability: raw jits off the perfscope funnel (ISSUE 5)
 # --------------------------------------------------------------------------
@@ -727,8 +759,10 @@ def test_shipped_tree_lints_clean():
     # broad-except is perfscope.instrument.cost_of's best-effort
     # accounting boundary; the fourth through sixth are the serve
     # plane's multi-tenant isolation boundaries — batcher step/run and
-    # the request handler's 500 path)
-    assert rep.suppressed == {"host-sync": 1, "host-rng": 1,
+    # the request handler's 500 path; the second host-rng is the topo
+    # plane's seeded static graph-table construction, a trace-time
+    # constant — topo/graphs.build_neighbor_table)
+    assert rep.suppressed == {"host-sync": 1, "host-rng": 2,
                               "donate-argnums": 3, "broad-except": 6}
     assert rep.files >= 40
 
@@ -744,7 +778,7 @@ def test_report_schema_and_cli_exit_codes(tmp_path):
     with open(Args.out) as fh:
         doc = json.load(fh)
     assert check_metrics_schema.check_lint_report(doc) == []
-    assert doc["ok"] is True and doc["suppressed_total"] == 11
+    assert doc["ok"] is True and doc["suppressed_total"] == 12
 
     # a dirty tree exits 2 through the same entry point
     dirty = _write_pkg(tmp_path, {"gen.py": HOST_RNG_SRC})

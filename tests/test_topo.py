@@ -1,0 +1,613 @@
+"""benor-topo (benor_tpu/topo) — the structured-delivery plane's tests.
+
+The ISSUE 12 acceptance pins, in tier-1:
+
+  * ``topology='complete'`` is the IDENTITY spec: bit-identical to the
+    pre-topology path in results AND compile counts, across the traced,
+    batched and sharded regimes (the spec normalizes to ``None`` at the
+    SimConfig boundary, so the configs hash equal and the jit cache
+    simply hits).
+  * ring/torus neighbor indices match a tiny NumPy oracle; the
+    random-regular table is reproducible, self-loop-free and
+    duplicate-free; NO dense N x N adjacency tensor exists anywhere on
+    the compiled path (asserted on the jaxpr's intermediate shapes).
+  * committee membership is bit-reproducible under a fixed seed and
+    the committee-size sweep runs as ONE bucket executable whose
+    points are bit-identical to the per-point oracle.
+  * a witnessed torus run audits CLEAN under the relaxed neighborhood
+    invariants, and a seeded violation (a tally no d+1 neighborhood
+    could deliver) is pinpointed to its (trial, node, round).
+  * the serve plane accepts/validates the new CONFIG_FIELDS with
+    structured 400s and never coalesces mismatched topologies.
+"""
+
+import copy
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from benor_tpu import audit
+from benor_tpu.config import SimConfig
+from benor_tpu.ops.collectives import SINGLE
+from benor_tpu.state import FaultSpec, init_state
+from benor_tpu.sweep import (run_curve_batched, run_point,
+                             run_points_batched, sweep_bucket_key)
+from benor_tpu.topo import TopologySpec, build_neighbor_table, parse_topology
+from benor_tpu.topo.curves import (committee_curve, degree_curve,
+                                   unanimity_fault)
+from benor_tpu.topo.deliver import neighbor_ids, neighborhood_counts
+from benor_tpu.topo import committees
+from benor_tpu.utils.compile_counter import count_backend_compiles
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import check_metrics_schema  # noqa: E402
+
+
+# --------------------------------------------------------------------------
+# spec grammar + metadata
+# --------------------------------------------------------------------------
+
+
+def test_parse_grammar_and_normalization():
+    assert parse_topology(None) is None
+    assert parse_topology("complete") is None
+    assert parse_topology("ring:4") == TopologySpec("ring", 4)
+    assert parse_topology("torus2d:8x4") == TopologySpec(
+        "torus2d", 4, rows=8, cols=4)
+    assert parse_topology("expander:6") == TopologySpec("expander", 6)
+    assert parse_topology("random_regular:5:9") == TopologySpec(
+        "random_regular", 5, graph_seed=9)
+    # canonical round-trip
+    for s in ("ring:4", "torus2d:8x4", "expander:6", "random_regular:5:9"):
+        assert parse_topology(s).spec_string() == s
+
+
+@pytest.mark.parametrize("bad", [
+    "ring", "ring:x", "ring:3", "torus2d:8", "torus2d:axb",
+    "moebius:4", "random_regular:", "ring:4:5", "torus2d:2x8",
+])
+def test_parse_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        spec = parse_topology(bad)
+        spec.validate(16)
+
+
+def test_config_normalizes_complete_to_none():
+    c0 = SimConfig(n_nodes=16, n_faulty=2, trials=4)
+    c1 = SimConfig(n_nodes=16, n_faulty=2, trials=4, topology="complete")
+    assert c1.topology is None
+    assert c0 == c1 and hash(c0) == hash(c1)
+
+
+def test_config_rejections():
+    with pytest.raises(ValueError, match="delivery='all'"):
+        SimConfig(n_nodes=16, n_faulty=2, topology="ring:2",
+                  delivery="quorum")
+    with pytest.raises(ValueError, match="backend"):
+        SimConfig(n_nodes=16, n_faulty=2, topology="ring:2",
+                  backend="express")
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        SimConfig(n_nodes=16, n_faulty=2, topology="ring:2",
+                  committee_cap=2, committee_count=2, committee_size=4)
+    with pytest.raises(ValueError, match="committee_count"):
+        SimConfig(n_nodes=16, n_faulty=2, committee_cap=2,
+                  committee_count=3, committee_size=4)
+    with pytest.raises(ValueError, match="committee_cap"):
+        SimConfig(n_nodes=16, n_faulty=2, committee_count=2)
+    with pytest.raises(ValueError, match="equivocate"):
+        SimConfig(n_nodes=16, n_faulty=2, committee_cap=2,
+                  committee_count=2, committee_size=4,
+                  fault_model="equivocate")
+    with pytest.raises(ValueError, match="covers"):
+        SimConfig(n_nodes=17, n_faulty=2, topology="torus2d:4x4")
+
+
+def test_expander_aliasing_offsets_rejected():
+    # +-32 mod 64 name the SAME sender: an aliasing pair would silently
+    # double-count that sender's vote in every tally
+    with pytest.raises(ValueError, match="alias"):
+        SimConfig(n_nodes=64, n_faulty=2, topology="expander:12")
+    with pytest.raises(ValueError, match="alias"):
+        parse_topology("expander:8").validate(12)
+    # one power below the wrap is fine, and every row holds d distinct
+    spec = parse_topology("expander:10")
+    spec.validate(64)
+    tbl = build_neighbor_table(spec, 64)
+    for row in tbl:
+        assert len(set(row.tolist())) == 10
+
+
+def test_degree_curve_rejects_complete_as_a_point():
+    base = SimConfig(n_nodes=16, n_faulty=0, trials=2)
+    with pytest.raises(ValueError, match="baseline"):
+        degree_curve(base, ["complete", "ring:2"])
+    with pytest.raises(ValueError, match="baseline"):
+        unanimity_fault("complete")
+
+
+def test_diameter_metadata():
+    assert TopologySpec("ring", 2).diameter(16) == 8        # exact
+    assert TopologySpec("ring", 4).diameter(16) == 4
+    assert TopologySpec("torus2d", 4, rows=4, cols=6).diameter(24) == 5
+    assert TopologySpec("ring", 2).diameter_exact()
+    assert not TopologySpec("expander", 4).diameter_exact()
+    # expander's estimate shrinks as degree grows
+    d4 = TopologySpec("expander", 4).diameter(1024)
+    d8 = TopologySpec("expander", 8).diameter(1024)
+    assert d8 < d4
+
+
+# --------------------------------------------------------------------------
+# neighbor indices vs a tiny NumPy oracle
+# --------------------------------------------------------------------------
+
+
+def _oracle_ring(n, d):
+    out = []
+    for i in range(n):
+        row = []
+        for j in range(1, d // 2 + 1):
+            row += [(i + j) % n, (i - j) % n]
+        out.append(row)
+    return out
+
+
+def test_ring_neighbors_match_oracle():
+    n, d = 12, 4
+    cfg = SimConfig(n_nodes=n, n_faulty=0, topology=f"ring:{d}")
+    got = np.asarray(neighbor_ids(cfg, jnp.arange(n, dtype=jnp.int32)))
+    want = _oracle_ring(n, d)
+    for i in range(n):
+        assert sorted(got[i].tolist()) == sorted(want[i]), i
+
+
+def test_torus_neighbors_match_oracle():
+    rows, cols = 3, 4
+    n = rows * cols
+    cfg = SimConfig(n_nodes=n, n_faulty=0,
+                    topology=f"torus2d:{rows}x{cols}")
+    got = np.asarray(neighbor_ids(cfg, jnp.arange(n, dtype=jnp.int32)))
+    for i in range(n):
+        r, c = divmod(i, cols)
+        want = {r * cols + (c + 1) % cols, r * cols + (c - 1) % cols,
+                ((r + 1) % rows) * cols + c, ((r - 1) % rows) * cols + c}
+        assert set(got[i].tolist()) == want, i
+
+
+def test_random_regular_table_properties():
+    spec = parse_topology("random_regular:5:3")
+    t1 = build_neighbor_table(spec, 64)
+    t2 = build_neighbor_table(spec, 64)
+    np.testing.assert_array_equal(t1, t2)          # reproducible
+    t3 = build_neighbor_table(parse_topology("random_regular:5:4"), 64)
+    assert not np.array_equal(t1, t3)              # seed matters
+    ids = np.arange(64)[:, None]
+    assert (t1 != ids).all()                       # no self-loops
+    for row in t1:                                 # d distinct senders
+        assert len(set(row.tolist())) == 5
+    assert t1.dtype == np.int32 and t1.shape == (64, 5)
+    # past half-density the collision repair stops being geometric — a
+    # cheap-to-validate dense spec would stall the shared batcher at
+    # trace time, so validate() bounds the degree at N//2
+    with pytest.raises(ValueError, match="half-density"):
+        SimConfig(n_nodes=64, n_faulty=0, topology="random_regular:60")
+
+
+def test_no_dense_adjacency_on_compiled_path():
+    """The acceptance shape bound: nothing on the compiled topology
+    tally path materializes an N x N (or larger) intermediate — the
+    whole point of carrying [N, d] indices instead of an adjacency
+    matrix."""
+    n, trials = 4096, 2
+    cfg = SimConfig(n_nodes=n, n_faulty=4, trials=trials,
+                    topology="ring:8")
+    sent = jnp.zeros((trials, n), jnp.int8)
+    alive = jnp.ones((trials, n), bool)
+    key = jax.random.key(0)
+
+    jaxpr = jax.make_jaxpr(
+        lambda s, a, k: neighborhood_counts(
+            cfg, k, jnp.int32(1), 0, s, a, SINGLE))(sent, alive, key)
+    cap = n * n
+    for eqn in jaxpr.jaxpr.eqns:
+        for var in list(eqn.outvars) + list(eqn.invars):
+            aval = getattr(var, "aval", None)
+            if aval is not None and hasattr(aval, "shape"):
+                size = int(np.prod(aval.shape)) if aval.shape else 1
+                assert size < cap, (eqn.primitive, aval.shape)
+
+
+# --------------------------------------------------------------------------
+# the identity spec: bit-identical results AND compile counts
+# --------------------------------------------------------------------------
+
+
+def test_complete_identity_traced():
+    base = SimConfig(n_nodes=32, n_faulty=6, trials=8, delivery="quorum",
+                     scheduler="uniform", path="histogram", seed=5)
+    pt0 = run_point(base)
+    with count_backend_compiles() as cc:
+        pt1 = run_point(base.replace(topology="complete"))
+    assert cc.count == 0                   # the jit cache simply hit
+    assert pt0.rounds_executed == pt1.rounds_executed
+    assert pt0.decided_frac == pt1.decided_frac
+    assert pt0.mean_k == pt1.mean_k
+    assert pt0.ones_frac == pt1.ones_frac
+    np.testing.assert_array_equal(pt0.k_hist, pt1.k_hist)
+
+
+def test_complete_identity_batched():
+    base = SimConfig(n_nodes=32, n_faulty=0, trials=8, delivery="quorum",
+                     scheduler="uniform", path="histogram", seed=5)
+    cb0 = run_curve_batched(base, [0, 4, 8])
+    cb1 = run_curve_batched(base.replace(topology="complete"), [0, 4, 8])
+    assert cb0.compile_count == cb1.compile_count
+    assert cb0.n_buckets == cb1.n_buckets
+    for a, b in zip(cb0.points, cb1.points):
+        assert a.mean_k == b.mean_k and a.decided_frac == b.decided_frac
+        np.testing.assert_array_equal(a.k_hist, b.k_hist)
+
+
+def test_complete_identity_sharded():
+    from benor_tpu.parallel import make_mesh, run_consensus_sharded
+
+    cfg = SimConfig(n_nodes=16, n_faulty=4, trials=8, delivery="quorum",
+                    scheduler="uniform", seed=7,
+                    topology="complete")        # normalizes to None
+    faults = FaultSpec.first_f(cfg)
+    state = init_state(cfg, [i % 2 for i in range(16)], faults)
+    key = jax.random.key(cfg.seed)
+    r1, s1 = run_consensus_sharded(cfg, state, faults, key,
+                                   make_mesh(2, 2))
+    cfg0 = SimConfig(n_nodes=16, n_faulty=4, trials=8, delivery="quorum",
+                     scheduler="uniform", seed=7)
+    from benor_tpu.sim import run_consensus
+    r0, s0 = run_consensus(cfg0, state, faults, key)
+    assert int(r0) == int(r1)
+    np.testing.assert_array_equal(np.asarray(s0.x), np.asarray(s1.x))
+    np.testing.assert_array_equal(np.asarray(s0.decided),
+                                  np.asarray(s1.decided))
+
+
+# --------------------------------------------------------------------------
+# topology runs: sharded bit-identity + batched-vs-oracle
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("spec", ["torus2d:4x4", "ring:4",
+                                  "random_regular:3:2", "expander:4"])
+def test_topology_sharded_bit_identical(spec):
+    from benor_tpu.parallel import make_mesh, run_consensus_sharded
+    from benor_tpu.sim import run_consensus
+
+    cfg = SimConfig(n_nodes=16, n_faulty=3, trials=8, topology=spec,
+                    max_rounds=12, seed=3)
+    faults = FaultSpec.none(8, 16)
+    state = init_state(cfg, [i % 2 for i in range(16)], faults)
+    key = jax.random.key(cfg.seed)
+    r0, s0 = run_consensus(cfg, state, faults, key)
+    r1, s1 = run_consensus_sharded(cfg, state, faults, key,
+                                   make_mesh(2, 2))
+    assert int(r0) == int(r1)
+    np.testing.assert_array_equal(np.asarray(s0.x), np.asarray(s1.x))
+    np.testing.assert_array_equal(np.asarray(s0.decided),
+                                  np.asarray(s1.decided))
+    np.testing.assert_array_equal(np.asarray(s0.k), np.asarray(s1.k))
+
+
+def test_degree_curve_batched_matches_per_point_oracle():
+    base = SimConfig(n_nodes=36, n_faulty=0, trials=8, max_rounds=12,
+                     seed=11)
+    specs = ["ring:2", "torus2d:6x6"]
+    rows = degree_curve(base, specs)
+    assert [r["degree"] for r in rows] == sorted(r["degree"] for r in rows)
+    for spec_str in specs:
+        cfg = base.replace(topology=spec_str,
+                           n_faulty=unanimity_fault(spec_str))
+        pt = run_point(cfg, faults=FaultSpec.none(8, 36))
+        row = next(r for r in rows
+                   if r["spec"] == parse_topology(spec_str).spec_string())
+        assert row["rounds_executed"] == pt.rounds_executed
+        assert row["mean_k"] == round(pt.mean_k, 4)
+        assert row["decided_frac"] == round(pt.decided_frac, 4)
+
+
+def test_topology_recorder_off_on_bit_identical():
+    """The house rule extends to the topo plane: arming the flight
+    recorder must not move a single bit of the results."""
+    cfg = SimConfig(n_nodes=16, n_faulty=3, trials=4,
+                    topology="torus2d:4x4", max_rounds=12, seed=9)
+    pt0 = run_point(cfg, faults=FaultSpec.none(4, 16))
+    pt1 = run_point(cfg.replace(record=True),
+                    faults=FaultSpec.none(4, 16))
+    assert pt0.mean_k == pt1.mean_k
+    assert pt0.decided_frac == pt1.decided_frac
+    np.testing.assert_array_equal(pt0.k_hist, pt1.k_hist)
+    assert pt1.round_history is not None
+
+
+# --------------------------------------------------------------------------
+# committees
+# --------------------------------------------------------------------------
+
+
+def test_committee_membership_reproducible_and_round_varying():
+    cfg = SimConfig(n_nodes=64, n_faulty=0, trials=4, committee_cap=4,
+                    committee_count=4, committee_size=8, seed=2)
+    key = jax.random.key(cfg.seed)
+    tid = jnp.arange(4, dtype=jnp.int32)
+    nid = jnp.arange(64, dtype=jnp.int32)
+    m1, c1 = committees.membership(cfg, key, jnp.int32(1), tid, nid, 4, 8)
+    m2, c2 = committees.membership(cfg, key, jnp.int32(1), tid, nid, 4, 8)
+    np.testing.assert_array_equal(np.asarray(m1), np.asarray(m2))
+    np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
+    m3, c3 = committees.membership(cfg, key, jnp.int32(2), tid, nid, 4, 8)
+    assert not np.array_equal(np.asarray(m1), np.asarray(m3))
+    assert (np.asarray(c1) < 4).all() and (np.asarray(c1) >= 0).all()
+    # expected participation ~ c*g/N = 1/2
+    frac = float(np.asarray(m1).mean())
+    assert 0.3 < frac < 0.7
+
+
+def test_committee_curve_one_bucket_and_matches_oracle():
+    base = SimConfig(n_nodes=64, n_faulty=1, trials=8, max_rounds=24,
+                     seed=4)
+    rows, cb = committee_curve(base, sizes=[4, 8, 16],
+                               committee_count=4)
+    assert cb.n_buckets == 1
+    assert cb.compile_count == 1          # the whole sweep, one compile
+    for row in rows:
+        cfg = base.replace(committee_cap=4, committee_count=4,
+                           committee_size=row["committee_size"])
+        pt = run_point(cfg, faults=FaultSpec.none(8, 64))
+        assert row["rounds_executed"] == pt.rounds_executed
+        assert row["mean_k"] == round(pt.mean_k, 4)
+        assert row["decided_frac"] == round(pt.decided_frac, 4)
+
+
+def test_committee_count_sweep_shares_bucket_key():
+    base = SimConfig(n_nodes=64, n_faulty=1, trials=8, committee_cap=8,
+                     committee_count=2, committee_size=8)
+    keys = {sweep_bucket_key(base.replace(committee_count=g))
+            for g in (2, 4, 8)}
+    assert len(keys) == 1                 # count is a DynParams axis
+    # but the static cap is part of the key: a different histogram
+    # shape may never share an executable
+    other = sweep_bucket_key(base.replace(committee_cap=16))
+    assert other not in keys
+
+
+# --------------------------------------------------------------------------
+# the relaxed auditor
+# --------------------------------------------------------------------------
+
+
+def _torus_bundle():
+    cfg = SimConfig(n_nodes=16, n_faulty=2, topology="torus2d:4x4",
+                    trials=4, max_rounds=12, seed=2,
+                    witness_trials=(0, 1), witness_nodes=8)
+    report, bundle = audit.audit_point(
+        cfg, initial_values=np.ones((4, 16), np.int8),
+        faults=FaultSpec.none(4, 16), unanimous=1, label="torus")
+    return cfg, report, bundle
+
+
+def test_torus_audit_clean_with_neighborhood_bound():
+    _, report, bundle = _torus_bundle()
+    assert report.ok, report.summary()
+    assert bundle.tally_bound == 5        # d + 1 on the 4-neighbor torus
+    assert report.checks["quorum_evidence"] > 0
+
+
+def test_forged_tally_beyond_neighborhood_is_pinpointed():
+    from benor_tpu.state import WIT_V1, WIT_WRITTEN
+
+    _, _, bundle = _torus_bundle()
+    buf = np.array(bundle.buffer)
+    written = np.nonzero(buf[:, 0, 0, WIT_WRITTEN] > 0)[0]
+    rd = int(written[-1])
+    buf[rd, 1, 3, WIT_V1] = 12            # > d+1 = 5: unrealizable
+    forged = audit.WitnessBundle(
+        buffer=buf, trial_ids=bundle.trial_ids,
+        node_ids=bundle.node_ids, rule=bundle.rule,
+        n_faulty=bundle.n_faulty, n_nodes=bundle.n_nodes,
+        tally_bound=bundle.tally_bound)
+    report = audit.audit_witness(forged)
+    assert not report.ok
+    v = next(x for x in report.violations
+             if "neighborhood" in x.message)
+    assert v.invariant == "quorum_evidence"
+    assert v.trial == int(bundle.trial_ids[1])
+    assert v.nodes == [int(bundle.node_ids[3])]
+    assert v.round == rd
+    # the SAME buffer without the bound sails through the classic checks
+    unbounded = audit.WitnessBundle(
+        buffer=buf, trial_ids=bundle.trial_ids,
+        node_ids=bundle.node_ids, rule=bundle.rule,
+        n_faulty=bundle.n_faulty, n_nodes=bundle.n_nodes)
+    assert not any("neighborhood" in x.message
+                   for x in audit.audit_witness(unbounded).violations)
+
+
+def test_bundle_roundtrip_and_schema_with_tally_bound(tmp_path):
+    _, report, bundle = _torus_bundle()
+    path = str(tmp_path / "bundle.json")
+    audit.save_bundle(path, bundle, report)
+    with open(path) as fh:
+        doc = json.load(fh)
+    assert check_metrics_schema.check_witness_bundle(doc) == []
+    back = audit.load_bundle(path)
+    assert back.tally_bound == bundle.tally_bound
+    assert audit.audit_witness(back).ok
+
+
+# --------------------------------------------------------------------------
+# serve integration: CONFIG_FIELDS + structured 400s + bucket keys
+# --------------------------------------------------------------------------
+
+
+def test_serve_jobspec_topology_fields():
+    from benor_tpu.serve.jobs import JobError, JobSpec
+
+    spec = JobSpec.from_dict({"n_nodes": 16, "n_faulty": 2,
+                              "topology": "torus2d:4x4"})
+    cfg = spec.to_config()
+    assert cfg.topology == "torus2d:4x4"
+    spec2 = JobSpec.from_dict({"n_nodes": 64, "n_faulty": 1,
+                               "committee_cap": 4, "committee_count": 4,
+                               "committee_size": 8})
+    assert spec2.to_config().committee_cap == 4
+    # round-trips through the wire form
+    assert JobSpec.from_dict(spec.to_dict()).topology == "torus2d:4x4"
+
+
+def test_serve_jobspec_structured_400s():
+    from benor_tpu.serve.jobs import JobError, JobSpec
+
+    with pytest.raises(JobError) as e:
+        JobSpec.from_dict({"n_nodes": 16, "topology": 4})
+    assert e.value.body["field"] == "topology"
+    with pytest.raises(JobError) as e:
+        JobSpec.from_dict({"n_nodes": 16, "topology": "moebius:4"})
+    assert e.value.body["field"] == "config"
+    with pytest.raises(JobError) as e:
+        JobSpec.from_dict({"n_nodes": 17, "topology": "torus2d:4x4"})
+    assert e.value.body["field"] == "config"
+    with pytest.raises(JobError) as e:
+        JobSpec.from_dict({"n_nodes": 16, "committee_count": 2})
+    assert e.value.body["field"] == "config"
+    with pytest.raises(JobError) as e:
+        JobSpec.from_dict({"n_nodes": 16, "committee_cap": "four"})
+    assert e.value.body["field"] == "committee_cap"
+    with pytest.raises(JobError) as e:
+        JobSpec.from_dict({"n_nodes": 1 << 14, "committee_cap": 1 << 14,
+                           "committee_count": 2, "committee_size": 4})
+    assert e.value.body["field"] == "committee_cap"
+    assert "caps" in e.value.body["reason"]
+
+
+def test_serve_bucket_key_separates_topologies_coalesces_committees():
+    from benor_tpu.serve.batcher import serve_bucket_key
+
+    base = dict(n_nodes=16, n_faulty=2, trials=4)
+    k_none = serve_bucket_key(SimConfig(**base))
+    k_ring = serve_bucket_key(SimConfig(**base, topology="ring:2"))
+    k_torus = serve_bucket_key(SimConfig(**base, topology="torus2d:4x4"))
+    assert len({k_none, k_ring, k_torus}) == 3   # never coalesce
+    # 'complete' IS the complete-graph bucket (the identity spec)
+    assert serve_bucket_key(SimConfig(**base, topology="complete")) \
+        == k_none
+    # committee count/size are DynParams axes: one warm executable
+    cbase = dict(n_nodes=64, n_faulty=1, trials=4, committee_cap=4)
+    ka = serve_bucket_key(SimConfig(**cbase, committee_count=2,
+                                    committee_size=8))
+    kb = serve_bucket_key(SimConfig(**cbase, committee_count=4,
+                                    committee_size=16))
+    assert ka == kb
+
+
+def test_serve_end_to_end_topology_job_bit_equal_run_point():
+    """A topology job through the real batcher equals the oracle —
+    the serve house rule extended to the new workloads."""
+    from benor_tpu.serve.batcher import Batcher
+
+    b = Batcher(start=False)
+    try:
+        jobs = b.submit_dict({"n_nodes": 16, "n_faulty": 3, "trials": 4,
+                              "max_rounds": 12, "seed": 6,
+                              "topology": "torus2d:4x4"})
+        assert b.step() == 1
+        job = jobs[0]
+        assert job.state == "done", job.error
+        pt = run_point(job.cfg)
+        assert job.result["mean_k"] == pt.mean_k
+        assert job.result["decided_frac"] == pt.decided_frac
+        assert job.result["k_hist"] == pt.k_hist.tolist()
+    finally:
+        b.close()
+
+
+# --------------------------------------------------------------------------
+# structural pallas demotion + schema gate
+# --------------------------------------------------------------------------
+
+
+def test_structured_demotion_warns_once():
+    import benor_tpu.sim as sim
+
+    sim._structured_demotion_warned = False
+    cfg = SimConfig(n_nodes=16, n_faulty=2, trials=2,
+                    topology="ring:2", use_pallas_round=True,
+                    use_pallas_hist=True)
+    faults = FaultSpec.none(2, 16)
+    state = init_state(cfg, [i % 2 for i in range(16)], faults)
+    with pytest.warns(UserWarning, match="delivery plane"):
+        sim.run_consensus(cfg, state, faults, jax.random.key(0))
+    # the batched engine reaches run_consensus_traced directly (never
+    # run_consensus) — the announcement must fire there too
+    sim._structured_demotion_warned = False
+    with pytest.warns(UserWarning, match="delivery plane"):
+        run_curve_batched(cfg, [2])
+    sim._structured_demotion_warned = True
+
+
+def test_check_topo_blob_cross_field_pins():
+    blob = {
+        "ok": True,
+        "complete_identity": {"bit_equal": True, "extra_compiles": 0},
+        "degree_curve": [
+            {"spec": "ring:2", "degree": 2, "diameter": 8,
+             "diameter_exact": True, "n_nodes": 16, "n_faulty": 2,
+             "rounds_executed": 3, "mean_k": 2.5, "decided_frac": 1.0},
+            {"spec": "torus2d:4x4", "degree": 4, "diameter": 4,
+             "diameter_exact": True, "n_nodes": 16, "n_faulty": 4,
+             "rounds_executed": 2, "mean_k": 2.0, "decided_frac": 1.0},
+        ],
+        "committee_curve": [
+            {"committee_size": 4, "committee_count": 4,
+             "committee_cap": 4, "n_nodes": 64, "rounds_executed": 4,
+             "mean_k": 3.0, "decided_frac": 1.0},
+        ],
+        "committee_compile_count": 1,
+        "audit_ok": True,
+    }
+    assert check_metrics_schema.check_topo_blob(blob) == []
+    bad = copy.deepcopy(blob)
+    bad["degree_curve"][0]["diameter"] = 99
+    assert any("recomputed" in e
+               for e in check_metrics_schema.check_topo_blob(bad))
+    bad = copy.deepcopy(blob)
+    bad["degree_curve"].reverse()
+    assert any("sorted" in e
+               for e in check_metrics_schema.check_topo_blob(bad))
+    bad = copy.deepcopy(blob)
+    bad["committee_compile_count"] = 2
+    errs = check_metrics_schema.check_topo_blob(bad)
+    assert any("one-bucket" in e for e in errs)
+    bad = copy.deepcopy(blob)
+    bad["audit_ok"] = False                  # ok must follow its parts
+    assert any("contradicts" in e
+               for e in check_metrics_schema.check_topo_blob(bad))
+    bad = copy.deepcopy(blob)
+    bad["committee_curve"][0]["committee_size"] = 32   # 32*4 > 64
+    assert any("clips" in e
+               for e in check_metrics_schema.check_topo_blob(bad))
+    bad = copy.deepcopy(blob)
+    bad["degree_curve"][0]["spec"] = "complete"  # no degree axis
+    assert any("identity" in e
+               for e in check_metrics_schema.check_topo_blob(bad))
+    bad = copy.deepcopy(blob)
+    del bad["complete_identity"]
+    assert check_metrics_schema.check_topo_blob(bad)
+    # the DEGRADED never-fail shape bench emits when _topo_check blew
+    # up is legal (topo_ok=false is the signal, not missing-key noise)
+    assert check_metrics_schema.check_topo_blob(
+        {"ok": False, "error": "RuntimeError: boom"}) == []
+    assert any("ok=true" in e for e in check_metrics_schema.check_topo_blob(
+        {"ok": True, "error": "RuntimeError: boom"}))

@@ -384,6 +384,164 @@ def check_serve_manifest(manifest: dict,
     return errors
 
 
+def _load_topo_graphs():
+    """File-path-load benor_tpu/topo/graphs.py — stdlib-importable by
+    design (numpy only inside the table builder, which this checker
+    never calls), the same no-jax loading trick the perf gate plays
+    with perfscope/baseline.py.  Lets the degree/diameter cross-field
+    checks recompute the spec metadata instead of trusting the blob."""
+    import importlib.util
+
+    path = os.path.join(REPO, "benor_tpu", "topo", "graphs.py")
+    spec = importlib.util.spec_from_file_location("_benor_topo_graphs",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    # the dataclass decorator resolves cls.__module__ through
+    # sys.modules, so the module must be registered before exec
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+#: Fields every degree-curve row must carry — the rounds-vs-degree
+#: monotonicity axes (degree/diameter on x, the decide-latency stats on
+#: y) plus the spec identity the metadata is recomputed from.
+TOPO_DEGREE_ROW_FIELDS = ("spec", "degree", "diameter", "diameter_exact",
+                          "n_nodes", "n_faulty", "rounds_executed",
+                          "mean_k", "decided_frac")
+
+#: Fields every committee-curve row must carry (size/count are the
+#: swept axes; cap is the shared static bound the one-bucket claim
+#: rests on).
+TOPO_COMMITTEE_ROW_FIELDS = ("committee_size", "committee_count",
+                             "committee_cap", "n_nodes",
+                             "rounds_executed", "mean_k", "decided_frac")
+
+
+def check_topo_blob(blob: dict) -> List[str]:
+    """Cross-field checks for bench.py's ``topo`` sidecar blob (the
+    PR 12 structured-delivery workloads).  Beyond key presence, pins
+    the facts the ``topo_ok`` headline rests on:
+
+      * every degree-curve row's degree/diameter/diameter_exact match a
+        recomputation from its spec string (benor_tpu/topo/graphs.py,
+        file-path-loaded — a hand-edited diameter cannot survive);
+      * the degree curve is sorted by degree (the monotonicity axis)
+        and both curves carry their full field sets;
+      * the committee curve shares one committee_cap and its
+        ``committee_compile_count`` is 1 — the whole size sweep really
+        ran as ONE bucket executable (the DynParams coalescing claim);
+      * ``ok`` is recomputed from its parts (identity bit-equality +
+        zero extra compiles + clean audit + non-empty curves) — a
+        hand-edited 'ok: true' is exactly what this catches.
+    """
+    errors: List[str] = []
+    if "error" in blob:
+        # the DEGRADED shape bench's never-fail contract emits when
+        # _topo_check itself blew up ({'ok': False, 'error': ...}) —
+        # legal per the JSON schema, and topo_ok=false is the signal;
+        # demanding the curve keys here would bury it in missing-key
+        # noise.  The one cross-field fact that still holds: a blob
+        # carrying an error may never claim ok.
+        if blob.get("ok"):
+            errors.append("$.topo: carries an 'error' but claims "
+                          "ok=true")
+        return errors
+    for key in ("ok", "complete_identity", "degree_curve",
+                "committee_curve", "committee_compile_count",
+                "audit_ok"):
+        if key not in blob:
+            errors.append(f"$.topo: missing required key {key!r}")
+    if errors:
+        return errors
+    graphs = _load_topo_graphs()
+    rows = blob["degree_curve"]
+    degrees = []
+    for i, row in enumerate(rows):
+        missing = [f for f in TOPO_DEGREE_ROW_FIELDS if f not in row]
+        if missing:
+            errors.append(f"$.topo.degree_curve[{i}]: missing {missing}")
+            continue
+        try:
+            spec = graphs.parse_topology(row["spec"])
+        except ValueError as e:
+            errors.append(f"$.topo.degree_curve[{i}]: unparseable spec "
+                          f"{row['spec']!r}: {e}")
+            continue
+        if spec is None:
+            # parse maps 'complete'/null to None (the identity spec) —
+            # legal as a CONFIG, but a degree curve has no complete-graph
+            # point (no degree axis), so a row claiming one is tampering
+            errors.append(
+                f"$.topo.degree_curve[{i}]: spec {row['spec']!r} is the "
+                "complete-graph identity — it cannot be a degree-curve "
+                "point (topo/curves.py rejects it at build time)")
+            continue
+        try:
+            meta = spec.metadata(int(row["n_nodes"]))
+        except ValueError as e:
+            errors.append(f"$.topo.degree_curve[{i}]: spec "
+                          f"{row['spec']!r} invalid at "
+                          f"n_nodes={row['n_nodes']}: {e}")
+            continue
+        for k in ("degree", "diameter", "diameter_exact"):
+            if row[k] != meta[k]:
+                errors.append(
+                    f"$.topo.degree_curve[{i}]: {k} {row[k]!r} != "
+                    f"recomputed {meta[k]!r} for spec {row['spec']!r}")
+        degrees.append(row["degree"])
+    if degrees != sorted(degrees):
+        errors.append(f"$.topo.degree_curve: rows not sorted by degree "
+                      f"(the monotonicity axis): {degrees}")
+    crows = blob["committee_curve"]
+    caps = set()
+    for i, row in enumerate(crows):
+        missing = [f for f in TOPO_COMMITTEE_ROW_FIELDS if f not in row]
+        if missing:
+            errors.append(
+                f"$.topo.committee_curve[{i}]: missing {missing}")
+            continue
+        caps.add(row["committee_cap"])
+        if not (1 <= row["committee_count"] <= row["committee_cap"]):
+            errors.append(
+                f"$.topo.committee_curve[{i}]: committee_count "
+                f"{row['committee_count']} outside [1, cap="
+                f"{row['committee_cap']}]")
+        if row["committee_size"] * row["committee_count"] > row["n_nodes"]:
+            errors.append(
+                f"$.topo.committee_curve[{i}]: size*count "
+                f"{row['committee_size']}*{row['committee_count']} > "
+                f"N={row['n_nodes']} — the participation probability "
+                "min(1, c*g/N) clips at 1 there, so the point draws the "
+                "same membership as c = N/g (a duplicate row "
+                "masquerading as a distinct size)")
+    if len(caps) > 1:
+        errors.append(f"$.topo.committee_curve: rows span multiple "
+                      f"committee_cap values {sorted(caps)} — they "
+                      "cannot have shared one bucket executable")
+    if crows and blob["committee_compile_count"] != 1:
+        errors.append(
+            f"$.topo.committee_compile_count: "
+            f"{blob['committee_compile_count']} != 1 — the committee "
+            "sweep's one-bucket-executable claim does not hold")
+    ident = blob["complete_identity"]
+    for k in ("bit_equal", "extra_compiles"):
+        if k not in ident:
+            errors.append(f"$.topo.complete_identity: missing {k!r}")
+    if errors:
+        return errors
+    want_ok = (bool(ident["bit_equal"]) and ident["extra_compiles"] == 0
+               and bool(blob["audit_ok"]) and len(rows) > 0
+               and len(crows) > 0 and blob["committee_compile_count"] == 1)
+    if bool(blob["ok"]) != want_ok:
+        errors.append(f"$.topo.ok: {blob['ok']} contradicts its parts "
+                      f"(identity {ident}, audit_ok {blob['audit_ok']}, "
+                      f"{len(rows)}/{len(crows)} curve rows, "
+                      f"committee compiles "
+                      f"{blob['committee_compile_count']})")
+    return errors
+
+
 WITNESS_SCHEMA_PATH = os.path.join(HERE, "witness_bundle_schema.json")
 
 
@@ -491,6 +649,11 @@ def main(argv=None) -> int:
               f"{'OK' if not errors else 'INVALID'}")
         return 1 if errors else 0
     errors = check_schema(detail) + check_headline(detail)
+    if isinstance(detail.get("topo"), dict):
+        # PR 12: the structured-delivery blob's cross-field pins
+        # (degree/diameter recomputation, curve monotonicity fields,
+        # the one-bucket committee claim, the recomputed ok verdict)
+        errors += check_topo_blob(detail["topo"])
     for e in errors:
         print(f"FAIL {e}", file=sys.stderr)
     n = headline_bytes(detail)
