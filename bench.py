@@ -85,7 +85,7 @@ _DETAIL_KEYS = ("curve", "pallas_check", "pallas_hist_check",
                 "pallas_equiv_check", "pallas_weak_coin_check",
                 "pallas_round_check", "pallas_demoted",
                 "batched_sweep_check", "flight_recorder", "perfscope",
-                "meshscope", "serve", "topo", "lint")
+                "meshscope", "serve", "topo", "sweepscope", "lint")
 
 
 def _split_headline(out: dict) -> tuple[dict, dict]:
@@ -151,6 +151,14 @@ def _split_headline(out: dict) -> tuple[dict, dict]:
         # errors + coalescing ratio > 1 + in-band vs SERVE_BASELINE.json
         # when comparable; the manifest lives in the sidecar's serve blob
         head["serve_ok"] = bool(sv.get("ok"))
+    sw = out.get("sweepscope")
+    if isinstance(sw, dict):
+        # ONE compact bool: journal off/on AND resume bit-equal in
+        # results + compile counts, overlap-headroom attribution
+        # present, sweep manifest schema-valid + in-band vs
+        # SWEEP_BASELINE.json when comparable; the manifest lives in
+        # the sidecar's sweepscope blob
+        head["sweep_obs_ok"] = bool(sw.get("ok"))
     tp = out.get("topo")
     if isinstance(tp, dict):
         # ONE compact bool: topology='complete' bit-identical (results +
@@ -1106,6 +1114,19 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
         f"{(m.get('attribution') or {}).get('coverage')} "
         f"baseline_comparable={serve_check.get('baseline_comparable')}")
     try:
+        sweepscope_check = _sweepscope_check()
+    except Exception as e:  # noqa: BLE001 — accounting must not kill the run
+        sweepscope_check = {"ok": False,
+                            "error": f"{type(e).__name__}: {e}"}
+    sm = sweepscope_check.get("manifest", {})
+    log(f"bench: sweepscope check ok={sweepscope_check.get('ok')} "
+        f"buckets={sm.get('n_buckets')} "
+        f"compiles={sm.get('compile_count')} "
+        f"headroom_frac={sm.get('overlap_headroom_frac')} "
+        f"resume_compiles={sweepscope_check.get('resume_compiles')} "
+        f"baseline_comparable="
+        f"{sweepscope_check.get('baseline_comparable')}")
+    try:
         topo_check = _topo_check(seed)
     except Exception as e:  # noqa: BLE001 — accounting must not kill the run
         topo_check = {"ok": False,
@@ -1172,6 +1193,7 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
         "meshscope": meshscope_check,
         "serve": serve_check,
         "topo": topo_check,
+        "sweepscope": sweepscope_check,
         "pallas_demoted": demoted,
     }
 
@@ -1501,6 +1523,100 @@ def _topo_check(seed: int) -> dict:
             "audit_ok": bool(report.ok),
             "audit_checks": sum(report.checks.values()),
             "audit_violations": len(report.violations)}
+
+
+def _sweepscope_check() -> dict:
+    """The batched sweep plane's observability acceptance (PR 13,
+    benor_tpu/sweepscope) at the fixed CPU-safe capture scale the
+    committed SWEEP_BASELINE.json was taken at (two buckets: one dyn
+    CF-regime bucket + one quorum-specialized static bucket):
+
+      * journal OFF vs ON must be bit-identical in the science fields
+        AND backend compile counts (the journal is host-side only);
+      * a resume from the completed journal must reassemble every point
+        bit-identically with ZERO compiles (the preemption-survival
+        contract; the SIGKILL-mid-bucket variant lives in
+        tests/test_sweepscope.py);
+      * the ``kind: sweep_manifest`` document must be schema-valid
+        (tools/sweep_manifest_schema.json, loaded by file path — the
+        checker must not drift from CI's) with the overlap-headroom
+        attribution present;
+      * the same gate CI runs (sweepscope/gate.compare_sweep behind
+        tools/check_sweep_regression.py) must be in-band vs the
+        committed SWEEP_BASELINE.json when comparable (an accelerator
+        capture vs the CPU baseline is honestly reported incomparable,
+        not silently passed).
+    """
+    import importlib.util
+    import tempfile
+
+    from benor_tpu.sweepscope import (IncomparableSweep,
+                                      build_sweep_manifest,
+                                      capture_base_config,
+                                      compare_sweep)
+    from benor_tpu.sweep import run_curve_batched
+
+    # the ONE capture workload definition, shared with the committed
+    # SWEEP_BASELINE.json regeneration (capture_sweep_manifest) so this
+    # gate and CI always price the same sweep
+    base, fs = capture_base_config()
+
+    def science(p):
+        return (p.rounds_executed, p.decided_frac, p.mean_k,
+                p.ones_frac, p.disagree_frac, tuple(p.k_hist.tolist()))
+
+    cb_off = run_curve_batched(base, fs)
+    with tempfile.TemporaryDirectory() as td:
+        jp = os.path.join(td, "sweep_journal.jsonl")
+        cb_on = run_curve_batched(base, fs, journal_path=jp)
+        cb_res = run_curve_batched(base, fs, journal_path=jp,
+                                   resume=True)
+    bit_equal = all(science(a) == science(b)
+                    for a, b in zip(cb_off.points, cb_on.points))
+    compile_parity = cb_off.compile_count == cb_on.compile_count
+    resume_bit_equal = all(science(a) == science(b)
+                           for a, b in zip(cb_off.points, cb_res.points))
+
+    manifest = build_sweep_manifest(cb_off, base)
+    spec = importlib.util.spec_from_file_location(
+        "_check_metrics_schema",
+        os.path.join(HERE, "tools", "check_metrics_schema.py"))
+    cms = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cms)
+    schema_errors = cms.check_sweep_manifest(manifest)
+    headroom_present = isinstance(manifest.get("overlap_headroom_s"),
+                                  (int, float))
+    blob = {
+        "manifest": manifest,
+        "schema_errors": schema_errors,
+        "bit_equal_journal_off_on": bit_equal,
+        "journal_compile_parity": compile_parity,
+        "resume_bit_equal": resume_bit_equal,
+        "resume_compiles": cb_res.compile_count,
+        "resume_buckets_reused": sum(cb_res.bucket_reused),
+        "headroom_present": headroom_present,
+    }
+    regressions = []
+    comparable = None
+    baseline_path = os.path.join(HERE, "SWEEP_BASELINE.json")
+    if os.path.exists(baseline_path):
+        try:
+            with open(baseline_path) as fh:
+                baseline = json.load(fh)
+            regressions = [f.to_dict()
+                           for f in compare_sweep(manifest, baseline)]
+            comparable = True
+        except (IncomparableSweep, ValueError) as e:
+            comparable = False
+            blob["baseline_note"] = f"{e}"
+    else:
+        blob["baseline_note"] = "no committed SWEEP_BASELINE.json"
+    blob["baseline_comparable"] = comparable
+    blob["regressions"] = regressions
+    blob["ok"] = (not schema_errors and bit_equal and compile_parity
+                  and resume_bit_equal and cb_res.compile_count == 0
+                  and headroom_present and not regressions)
+    return blob
 
 
 def _lint_check() -> dict:

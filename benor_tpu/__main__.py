@@ -5,7 +5,16 @@ faulty, all-1 inputs, run consensus, print each node's final state.
 
 Subcommands:
   demo   [--backend tpu|express] [-n N] [-f F] ...   the start.ts demo
-  sweep  --n N --f-values 0,100,...                  rounds-vs-f curve
+  sweep  --n N --f-values 0,100,...                  rounds-vs-f curve;
+         [--batched --journal J --resume]            with --batched the
+         [--trace-out t.json --manifest-out m.json]  sweepscope plane
+                                                     adds the durable
+                                                     resumable bucket
+                                                     journal, Perfetto
+                                                     bucket-lifecycle
+                                                     spans and the
+                                                     kind: sweep_manifest
+                                                     document
   coins  --n N --f F                                 private vs common coin
   trace  --n N --f F --out trace.json                flight-recorder round
                                                      history as a Chrome-
@@ -24,11 +33,15 @@ Subcommands:
                                                      meshscope); exit 2
                                                      on regression
   watch  PATH [--poll 0.2] [--timeout 60]            tail a running
-                                                     sweep's heartbeat
-                                                     file (live rounds/s,
-                                                     decided fraction,
-                                                     ETA); no backend
-                                                     touched
+                                                     run's JSON-lines
+                                                     file: heartbeats,
+                                                     sweep-journal
+                                                     bucket records, or
+                                                     both interleaved
+                                                     (kind-dispatched
+                                                     lines, unknown
+                                                     kinds passed raw);
+                                                     no backend touched
   serve  [--port 8400] [--max-batch-jobs 32]         the async multi-
          [--trace-out trace.json]                    tenant request
                                                      plane (benor_tpu/
@@ -235,6 +248,22 @@ def _sweep(args) -> int:
               "batched engine (per bucket); add --batched, or use "
               "`trace`/poll_rounds for per-round liveness",
               file=sys.stderr)
+    if not args.batched and (args.journal or args.resume
+                             or args.trace_out or args.manifest_out):
+        # sweepscope instruments the BUCKET lifecycle; the per-point
+        # path has no buckets — a silent no-op would fake durability/
+        # tracing (the same house rule as --heartbeat-rounds)
+        print("warning: --journal/--resume/--trace-out/--manifest-out "
+              "instrument the batched engine's buckets; add --batched",
+              file=sys.stderr)
+    if args.resume and not args.journal:
+        print("sweep: --resume requires --journal (the journal is the "
+              "resume substrate)", file=sys.stderr)
+        return 1
+    if args.trace_out and args.batched:
+        from .utils.metrics import SPANS
+        SPANS.enable()
+    journal_kw = dict(journal_path=args.journal, resume=args.resume)
     mode = "balanced/no-crash" if args.balanced else "iid/crash"
     fb = " [cpu fallback]" if FELL_BACK else ""
     # banner reports the compute path actually taken, not the request:
@@ -274,7 +303,8 @@ def _sweep(args) -> int:
         if args.batched:
             cb = run_curve_batched(cfg, f_values, initial_values=bal,
                                    faults_for=faults_for, verbose=True,
-                                   heartbeat_path=args.heartbeat_out)
+                                   heartbeat_path=args.heartbeat_out,
+                                   **journal_kw)
             points = cb.points
         else:
             points = []
@@ -288,13 +318,35 @@ def _sweep(args) -> int:
                   f"disagree={pt.disagree_frac:.3f} "
                   f"{pt.trials_per_sec:.1f} trials/s", flush=True)
     elif args.batched:
-        from .sweep import rounds_vs_f_batched
-        points = rounds_vs_f_batched(cfg, f_values,
-                                     heartbeat_path=args.heartbeat_out)
+        from .sweep import run_curve_batched
+        cb = run_curve_batched(cfg, f_values, verbose=True,
+                               heartbeat_path=args.heartbeat_out,
+                               **journal_kw)
+        points = cb.points
+        for pt in points:
+            print(f"  f={pt.n_faulty}: mean_k={pt.mean_k:.2f} "
+                  f"decided={pt.decided_frac:.3f} "
+                  f"{pt.trials_per_sec:.1f} trials/s", flush=True)
     else:
         points = rounds_vs_f(cfg, f_values)
     from .utils.metrics import REGISTRY
     REGISTRY.timer("cli.sweep").record(time.perf_counter() - t0)
+    if args.batched and args.manifest_out:
+        from .sweepscope import build_sweep_manifest, save_sweep_manifest
+        try:
+            save_sweep_manifest(args.manifest_out,
+                                build_sweep_manifest(cb, cfg))
+            print(f"wrote sweep manifest to {args.manifest_out}",
+                  file=sys.stderr)
+        except ValueError as e:
+            # a resumed curve's stage clocks price the original run —
+            # the builder refuses; say so instead of writing a lie
+            print(f"sweep: no manifest written: {e}", file=sys.stderr)
+    if args.batched and args.trace_out:
+        from .utils.metrics import export_chrome_trace
+        n_ev = export_chrome_trace(args.trace_out, spans=True)
+        print(f"wrote {n_ev} trace events to {args.trace_out} "
+              f"(open in ui.perfetto.dev)", file=sys.stderr)
     if args.record:
         # recorder-derived per-point science: round history is in each
         # point (SweepPoint.round_history; --out JSON carries the rows)
@@ -745,44 +797,94 @@ def _load(args) -> int:
     return 0
 
 
-def _watch(args) -> int:
-    """Tail a running sweep's heartbeat file (meshscope's live progress
-    plane): print each new heartbeat record — rounds/sec, decided
-    fraction, ETA — as it is appended, stopping on the run's
-    ``done: true`` record, on --no-follow after one pass, or after
-    --timeout seconds of silence.  Pure host-side tail: never touches a
-    JAX backend.  Exit 0 once at least one record was seen, 1 on a
-    silent timeout (nothing to watch)."""
-    from .meshscope.heartbeat import tail_heartbeats
+def _format_heartbeat(rec) -> str:
+    bits = [f"[{rec.get('label', '?')}]"]
+    if rec.get("round") is not None:
+        bits.append(f"round={rec['round']}/{rec.get('max_rounds')}")
+    if rec.get("points_done") is not None:
+        bits.append(f"points={rec['points_done']}"
+                    f"/{rec.get('points_total')}")
+    if rec.get("rounds_per_sec") is not None:
+        bits.append(f"{rec['rounds_per_sec']:.3g} rounds/s")
+    if rec.get("decided_frac") is not None:
+        bits.append(f"decided={rec['decided_frac']:.3f}")
+    if rec.get("eta_s") is not None:
+        bits.append(f"eta={rec['eta_s']:.1f}s")
+    if rec.get("progress") is not None:
+        bits.append(f"{100 * rec['progress']:.0f}%")
+    if rec.get("done"):
+        bits.append("DONE")
+    return " ".join(bits)
 
+
+def _format_sweep_bucket(rec) -> str:
+    """One sweep-journal bucket record (sweepscope/journal.py) as a
+    watch line: which bucket landed, its stage wall clocks, its
+    compile count."""
+    idx = rec.get("point_indices") or []
+    bits = [f"[{rec.get('label', 'sweep')}-journal]",
+            f"bucket {rec.get('bucket_index')}",
+            f"({rec.get('bucket_kind')}, {len(idx)} pt"
+            f"{'s' if len(idx) != 1 else ''})"]
+    for stage in ("prepare_s", "compile_s", "run_s", "fetch_s"):
+        v = rec.get(stage)
+        if isinstance(v, (int, float)):
+            bits.append(f"{stage[:-2]}={v:.2f}s")
+    if rec.get("compile_count") is not None:
+        bits.append(f"compiles={rec['compile_count']}")
+    return " ".join(bits)
+
+
+def _format_sweep_done(rec) -> str:
+    bits = [f"[{rec.get('label', 'sweep')}-journal]",
+            f"sweep complete: {rec.get('points_total')} points / "
+            f"{rec.get('n_buckets')} buckets"]
+    if rec.get("buckets_reused"):
+        bits.append(f"({rec['buckets_reused']} journal-restored)")
+    if rec.get("overlap_headroom_s") is not None:
+        bits.append(f"overlap_headroom={rec['overlap_headroom_s']:.2f}s")
+    bits.append("DONE")
+    return " ".join(bits)
+
+
+def _watch(args) -> int:
+    """Tail a running run's JSON-lines progress file (heartbeats from
+    meshscope, sweep-journal bucket records from sweepscope, or one
+    file carrying both interleaved): print each new record as it is
+    appended — kind-dispatched formatting, unknown kinds passed through
+    raw (never dropped, never a crash — a partial trailing line is
+    simply re-read on the next poll), stopping on any ``done: true``
+    record, on --no-follow after one pass, or after --timeout seconds
+    of silence.  Pure host-side tail: never touches a JAX backend.
+    Exit 0 once at least one record was seen, 1 on a silent timeout
+    (nothing to watch)."""
+    import json as _json
+
+    from .meshscope.heartbeat import HEARTBEAT_KIND, tail_records
+    from .sweepscope.journal import BUCKET_KIND, DONE_KIND
+
+    formatters = {HEARTBEAT_KIND: _format_heartbeat,
+                  BUCKET_KIND: _format_sweep_bucket,
+                  DONE_KIND: _format_sweep_done}
     seen = 0
-    for rec in tail_heartbeats(args.path, poll_s=args.poll,
-                               timeout_s=args.timeout,
-                               follow=not args.no_follow):
+    for rec in tail_records(args.path, poll_s=args.poll,
+                            timeout_s=args.timeout,
+                            follow=not args.no_follow):
         seen += 1
-        bits = [f"[{rec.get('label', '?')}]"]
-        if rec.get("round") is not None:
-            bits.append(f"round={rec['round']}/{rec.get('max_rounds')}")
-        if rec.get("points_done") is not None:
-            bits.append(f"points={rec['points_done']}"
-                        f"/{rec.get('points_total')}")
-        if rec.get("rounds_per_sec") is not None:
-            bits.append(f"{rec['rounds_per_sec']:.3g} rounds/s")
-        if rec.get("decided_frac") is not None:
-            bits.append(f"decided={rec['decided_frac']:.3f}")
-        if rec.get("eta_s") is not None:
-            bits.append(f"eta={rec['eta_s']:.1f}s")
-        if rec.get("progress") is not None:
-            bits.append(f"{100 * rec['progress']:.0f}%")
-        if rec.get("done"):
-            bits.append("DONE")
-        print(" ".join(bits), flush=True)
+        fmt = formatters.get(rec.get("kind"))
+        if fmt is not None:
+            print(fmt(rec), flush=True)
+        else:
+            # unknown kind: pass the record through raw — a new
+            # producer's records surface verbatim instead of vanishing
+            print(_json.dumps(rec.get("raw", rec), sort_keys=True),
+                  flush=True)
         if args.max_updates and seen >= args.max_updates:
             break
     if not seen:
-        print(f"watch: no heartbeat records in {args.path} within "
-              f"{args.timeout}s (is the run armed with "
-              f"heartbeat_rounds and a heartbeat path?)",
+        print(f"watch: no records in {args.path} within "
+              f"{args.timeout}s (is the run armed with a heartbeat/"
+              f"journal path?)",
               file=sys.stderr)
         return 1
     return 0
@@ -850,6 +952,30 @@ def main(argv=None) -> int:
                    help="arm the live progress plane at this round "
                         "cadence (0 = off); the batched engine beats "
                         "per bucket")
+    s.add_argument("--journal", metavar="PATH",
+                   help="with --batched: append one durable JSON-lines "
+                        "record per completed bucket (input "
+                        "fingerprint, stage wall clocks, per-point "
+                        "payloads) — the sweepscope journal `watch` "
+                        "tails and --resume restarts from")
+    s.add_argument("--resume", action="store_true",
+                   help="with --journal: skip every bucket whose "
+                        "fingerprint matches a journal record and "
+                        "reassemble its points bit-identically from "
+                        "disk; only unfinished buckets recompile "
+                        "(tampered records rerun, never reuse)")
+    s.add_argument("--trace-out", metavar="PATH",
+                   help="with --batched: arm sweepscope span tracing "
+                        "and write the Perfetto trace (per-bucket "
+                        "prepare/compile/execute/fetch stage spans, "
+                        "flow-linked to the points each bucket "
+                        "carried) here")
+    s.add_argument("--manifest-out", metavar="PATH",
+                   help="with --batched: write the pinned-schema "
+                        "kind: sweep_manifest document (per-bucket "
+                        "stage clocks + overlap-headroom attribution; "
+                        "tools/sweep_manifest_schema.json, gated by "
+                        "tools/check_sweep_regression.py)")
 
     c = sub.add_parser("coins", help="private vs common coin, adversarial")
     c.add_argument("--n", type=int, default=100)
@@ -1090,12 +1216,15 @@ def main(argv=None) -> int:
     _add_obs_args(ld, record=False)
 
     w = sub.add_parser("watch",
-                       help="tail a running sweep's heartbeat file "
-                            "(live rounds/sec, decided fraction, ETA); "
-                            "no JAX backend touched")
-    w.add_argument("path", help="heartbeat JSON-lines file (sweep "
-                                "--heartbeat-out / TpuNetwork."
-                                "heartbeat_path)")
+                       help="tail a running run's JSON-lines progress "
+                            "file: heartbeats (rounds/sec, decided "
+                            "fraction, ETA) and/or sweep-journal "
+                            "bucket records, kind-dispatched; no JAX "
+                            "backend touched")
+    w.add_argument("path", help="JSON-lines file (sweep "
+                                "--heartbeat-out / --journal / "
+                                "TpuNetwork.heartbeat_path; mixed "
+                                "kinds interleave freely)")
     w.add_argument("--poll", type=float, default=0.2,
                    help="poll interval in seconds (default 0.2)")
     w.add_argument("--timeout", type=float, default=60.0,

@@ -165,8 +165,9 @@ def run_rules(project: Project, names=None
     regime at the field's first sim.py use.)"""
     # rule modules register on import; import them here so a bare
     # ``from .core import run_rules`` is enough to get the full set
-    from . import (rules_config, rules_layout, rules_perf,  # noqa: F401
-                   rules_serve, rules_tracer)
+    from . import (rules_config, rules_layout,  # noqa: F401
+                   rules_manifest, rules_perf, rules_serve,
+                   rules_tracer)
 
     active: List[Finding] = list(project.errors)
     suppressed: Dict[str, int] = {}

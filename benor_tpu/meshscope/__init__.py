@@ -34,7 +34,7 @@ batched regimes.
 
 from .heartbeat import (HeartbeatPublisher, publish_slice_heartbeat,
                         publish_sweep_heartbeat, read_heartbeats,
-                        tail_heartbeats)
+                        read_records, tail_heartbeats, tail_records)
 from .scalegate import (STRAGGLER_TRIP, IncomparableScaling,
                         compare_scaling)
 from .scaling import (SCALING_MANIFEST_KIND, build_scaling_manifest,
@@ -46,7 +46,8 @@ from .telemetry import (collective_bytes, detect_stragglers,
 
 __all__ = [
     "HeartbeatPublisher", "publish_slice_heartbeat",
-    "publish_sweep_heartbeat", "read_heartbeats", "tail_heartbeats",
+    "publish_sweep_heartbeat", "read_heartbeats", "read_records",
+    "tail_heartbeats", "tail_records",
     "STRAGGLER_TRIP", "IncomparableScaling", "compare_scaling",
     "SCALING_MANIFEST_KIND", "build_scaling_manifest",
     "load_scaling_manifest", "run_scaling_ladder",

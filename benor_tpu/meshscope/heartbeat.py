@@ -215,11 +215,21 @@ def publish_sweep_heartbeat(cfg, done: int, total: int,
 # --------------------------------------------------------------------------
 
 
-def read_heartbeats(path: str) -> List[dict]:
-    """Parse a heartbeat JSON-lines file -> records, in file order.
-    A torn (still-being-written) final line is skipped, not an error —
-    the writer appends line-atomically, but a reader can still catch the
-    file between the open and the flush of the very first line."""
+def read_records(path: str,
+                 kinds: Optional[Tuple[str, ...]] = None) -> List[dict]:
+    """Parse a JSON-lines file -> records, in file order.
+
+    The MIXED-KIND reader behind ``python -m benor_tpu watch``: a
+    heartbeat file, a sweep journal (benor_tpu/sweepscope/journal.py)
+    or one file carrying both interleave freely — ``kinds`` filters
+    when given, otherwise every parseable record passes through (a
+    record without a ``kind`` is wrapped as ``{"kind": None, "raw":
+    value}``, as is any non-dict JSON value, so unknown producers are
+    surfaced raw rather than dropped).  A torn (still-being-written or
+    killed-mid-append) line is skipped, not an error — the writers
+    append line-atomically, but a reader can still catch the file
+    between the open and the flush of a line, and a SIGKILLed writer
+    legitimately leaves a partial tail."""
     out: List[dict] = []
     with open(path) as fh:
         for line in fh:
@@ -230,32 +240,83 @@ def read_heartbeats(path: str) -> List[dict]:
                 rec = json.loads(line)
             except ValueError:
                 continue             # torn tail line; next poll re-reads
-            if isinstance(rec, dict) and rec.get("kind") == HEARTBEAT_KIND:
+            if not isinstance(rec, dict) or "kind" not in rec:
+                rec = {"kind": None, "raw": rec}
+            if kinds is None or rec.get("kind") in kinds:
                 out.append(rec)
     return out
 
 
-def tail_heartbeats(path: str, poll_s: float = 0.2,
-                    timeout_s: float = 60.0, follow: bool = True,
-                    stop_when_done: bool = True) -> Iterator[dict]:
-    """Yield heartbeat records as they are appended (the watch engine).
+def read_heartbeats(path: str) -> List[dict]:
+    """Parse a heartbeat JSON-lines file -> heartbeat records only, in
+    file order (the kind-filtered view of :func:`read_records`)."""
+    return read_records(path, kinds=(HEARTBEAT_KIND,))
 
-    Polls ``path`` every ``poll_s`` seconds, yielding only NEW records;
-    stops on a ``done: true`` record (when ``stop_when_done``), when
+
+def _read_new_records(path: str, offset: int,
+                      kinds: Optional[Tuple[str, ...]]
+                      ) -> Tuple[List[dict], int]:
+    """Parse only the bytes appended since ``offset`` -> (new records,
+    new offset).  The tail engine's incremental read: a sweep-journal
+    bucket record can carry hundreds of KB of per-point payload, so
+    re-parsing the whole file every poll would make the watch loop
+    O(file^2) over a long sweep.  The offset only ever advances past
+    COMPLETE (newline-terminated) lines — a torn tail (mid-append, or
+    a SIGKILLed writer's last gasp) is left in place and re-read on the
+    next poll; a complete-but-unparseable line is skipped permanently,
+    like :func:`read_records`."""
+    with open(path, "rb") as fh:
+        fh.seek(offset)
+        chunk = fh.read()
+    nl = chunk.rfind(b"\n")
+    if nl < 0:
+        return [], offset
+    out: List[dict] = []
+    for raw in chunk[:nl + 1].splitlines():
+        line = raw.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line.decode("utf-8", errors="replace"))
+        except ValueError:
+            continue
+        if not isinstance(rec, dict) or "kind" not in rec:
+            rec = {"kind": None, "raw": rec}
+        if kinds is None or rec.get("kind") in kinds:
+            out.append(rec)
+    return out, offset + nl + 1
+
+
+def tail_records(path: str, poll_s: float = 0.2,
+                 timeout_s: float = 60.0, follow: bool = True,
+                 stop_when_done: bool = True,
+                 kinds: Optional[Tuple[str, ...]] = None
+                 ) -> Iterator[dict]:
+    """Yield records as they are appended (the watch engine).
+
+    Polls ``path`` every ``poll_s`` seconds, yielding only NEW records
+    (``kinds`` filters like :func:`read_records`; reads are
+    incremental by byte offset, so a journal full of large bucket
+    payloads is parsed once, not once per poll); stops on a ``done:
+    true`` record of ANY kind (when ``stop_when_done`` — a heartbeat
+    close beat and a sweep journal's ``sweep_done`` both qualify), when
     ``follow`` is False and the file has been read through once, or
     after ``timeout_s`` seconds without any new record.  A not-yet-
     created file counts as "no new records" (the sweep may still be
     compiling), so the timeout is the only way out of a path that never
-    materializes."""
-    seen = 0
+    materializes; a file that SHRANK (a fresh run truncated its
+    journal) restarts the tail from the top."""
+    import os as _os
+
+    offset = 0
     deadline = time.monotonic() + timeout_s
     while True:
         try:
-            records = read_heartbeats(path)
+            if _os.path.getsize(path) < offset:
+                offset = 0          # truncated/rewritten: start over
+            new, offset = _read_new_records(path, offset, kinds)
         except OSError:
-            records = []
-        new = records[seen:]
-        seen = len(records)
+            new = []
         for rec in new:
             deadline = time.monotonic() + timeout_s
             yield rec
@@ -266,3 +327,13 @@ def tail_heartbeats(path: str, poll_s: float = 0.2,
         if time.monotonic() >= deadline:
             return
         time.sleep(poll_s)
+
+
+def tail_heartbeats(path: str, poll_s: float = 0.2,
+                    timeout_s: float = 60.0, follow: bool = True,
+                    stop_when_done: bool = True) -> Iterator[dict]:
+    """:func:`tail_records` filtered to heartbeat records (the original
+    single-kind watch surface, kept for its callers)."""
+    return tail_records(path, poll_s=poll_s, timeout_s=timeout_s,
+                        follow=follow, stop_when_done=stop_when_done,
+                        kinds=(HEARTBEAT_KIND,))

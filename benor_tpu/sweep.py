@@ -334,7 +334,17 @@ def sweep_bucket_key(cfg: SimConfig):
 
 @dataclasses.dataclass
 class BatchedCurve:
-    """A batched curve run plus its compile-accounting evidence."""
+    """A batched curve run plus its compile-accounting evidence.
+
+    The ``bucket_*`` lists (sweepscope, PR 13) attribute wall clock to
+    the bucket that actually spent it, in executable-build order —
+    ``SweepPoint.seconds`` stays the amortized per-point share for
+    compatibility, but a straggler bucket is no longer hidden inside a
+    uniform average.  ``run_s``/``compile_s`` keep their original
+    meanings (sums over the buckets this run actually executed;
+    journal-restored buckets contribute their JOURNALED stage clocks to
+    the lists but zero to these sums — nothing ran).
+    """
 
     points: List[SweepPoint]        # input order, same fields as run_point
     n_buckets: int
@@ -342,6 +352,29 @@ class BatchedCurve:
     compile_count: int              # XLA backend compiles observed
     compile_s: float                # wall-clock building the executables
     run_s: float                    # wall-clock executing them (post-compile)
+    #: per-bucket lifecycle stage wall clocks (build order): host-side
+    #: prepare/stack, AOT lower+compile, device execute (dispatch to
+    #: completion barrier), host fetch/assemble
+    bucket_prepare_s: List[float] = dataclasses.field(default_factory=list)
+    bucket_compile_s: List[float] = dataclasses.field(default_factory=list)
+    bucket_run_s: List[float] = dataclasses.field(default_factory=list)
+    bucket_fetch_s: List[float] = dataclasses.field(default_factory=list)
+    bucket_kinds: List[str] = dataclasses.field(default_factory=list)
+    #: input-order point indices each bucket carried
+    bucket_point_indices: List[List[int]] = dataclasses.field(
+        default_factory=list)
+    #: measured backend compiles per bucket THIS run (0 for restored)
+    bucket_compile_counts: List[int] = dataclasses.field(
+        default_factory=list)
+    #: True where the bucket was reassembled from the sweep journal
+    #: instead of executed (resume=True)
+    bucket_reused: List[bool] = dataclasses.field(default_factory=list)
+    #: end-to-end wall clock of the whole run_points_batched call
+    wall_s: float = 0.0
+    #: wall-clock an ideal compile-ahead/execute-behind pipeline would
+    #: reclaim from the measured serial bucket schedule
+    #: (sweepscope/gate.py owns the model)
+    overlap_headroom_s: float = 0.0
 
 
 def _summarize_inline(cfg: SimConfig, r, final: NetState, faults: FaultSpec):
@@ -360,8 +393,9 @@ def _stack_tree(items):
 def run_curve_batched(base_cfg: SimConfig, f_values: Sequence[int],
                       initial_values=None, faults_for=None,
                       verbose: bool = False,
-                      heartbeat_path: Optional[str] = None
-                      ) -> BatchedCurve:
+                      heartbeat_path: Optional[str] = None,
+                      journal_path: Optional[str] = None,
+                      resume: bool = False) -> BatchedCurve:
     """Run a rounds-vs-f curve with one XLA compile per static-shape
     bucket — the f-axis front door of ``run_points_batched`` (which
     batches ANY per-point config list, e.g. the topo committee curves):
@@ -373,14 +407,16 @@ def run_curve_batched(base_cfg: SimConfig, f_values: Sequence[int],
     return run_points_batched(base_cfg, cfgs,
                               initial_values=initial_values,
                               faults_for=faults_for, verbose=verbose,
-                              heartbeat_path=heartbeat_path)
+                              heartbeat_path=heartbeat_path,
+                              journal_path=journal_path, resume=resume)
 
 
 def run_points_batched(base_cfg: SimConfig, cfgs: Sequence[SimConfig],
                        initial_values=None, faults_for=None,
                        verbose: bool = False,
-                       heartbeat_path: Optional[str] = None
-                       ) -> BatchedCurve:
+                       heartbeat_path: Optional[str] = None,
+                       journal_path: Optional[str] = None,
+                       resume: bool = False) -> BatchedCurve:
     """Run a list of per-point configs with one XLA compile per
     static-shape bucket (sweep_bucket_key groups them).
 
@@ -425,12 +461,33 @@ def run_points_batched(base_cfg: SimConfig, cfgs: Sequence[SimConfig],
     JSON-lines file `python -m benor_tpu watch` tails — host-side only,
     so the bucket executables (and their compile counts) are untouched
     (benor_tpu/meshscope/heartbeat.py).
+
+    Sweepscope (benor_tpu/sweepscope): the engine stamps every bucket's
+    lifecycle stages (prepare/stack -> AOT lower+compile -> execute ->
+    fetch/assemble) onto the returned curve's ``bucket_*`` lists and,
+    when the process-wide span log is armed (``metrics.SPANS``, e.g.
+    the sweep CLI's ``--trace-out``), emits flow-linked Perfetto spans
+    per bucket and point.  ``journal_path`` arms the DURABLE sweep
+    journal: one line-atomic JSON record per completed bucket (input
+    fingerprint, stage clocks, compile count, per-point payloads), and
+    ``resume=True`` skips every bucket whose fingerprint + point
+    indices match a journal record, reassembling its points
+    bit-identically through ``point_from_raw`` with ZERO device work —
+    a SIGKILLed sweep resumes with only its unfinished buckets
+    recompiled; any journal tamper reruns rather than reuses.  Journal
+    and tracing are host-side only: off OR on, results and compile
+    counts are bit-identical (tests/test_sweepscope.py).
     """
     import warnings
 
     from .perfscope.instrument import aot_compile
+    from .sweepscope import gate as sweep_gate
+    from .sweepscope.journal import (SweepJournal, bucket_fingerprint,
+                                     deserialize_point, serialize_point)
+    from .sweepscope.spans import emit_bucket_spans
     from .utils.compile_counter import count_backend_compiles
 
+    t_wall0 = time.perf_counter()
     T, N = base_cfg.trials, base_cfg.n_nodes
     for cfg_f in cfgs:
         if (cfg_f.trials, cfg_f.n_nodes) != (T, N):
@@ -438,12 +495,16 @@ def run_points_batched(base_cfg: SimConfig, cfgs: Sequence[SimConfig],
                 "run_points_batched points must share base_cfg's "
                 f"(trials, n_nodes)=({T}, {N}); got "
                 f"({cfg_f.trials}, {cfg_f.n_nodes})")
+    if resume and journal_path is None:
+        raise ValueError("resume=True requires journal_path (the "
+                         "journal IS the resume substrate)")
     if initial_values is None:
         initial_values = random_inputs(base_cfg.seed, T, N)
 
     faults_fn = faults_for if faults_for is not None else default_crash_faults
 
-    # ---- prepare (host side): bucket the points, build + stack inputs ----
+    # ---- bucket the points (host side; input tensors are built lazily
+    # per bucket so a journal-restored bucket never pays for them) ------
     cfgs = list(cfgs)
     buckets: Dict = {}
     order: List = []
@@ -454,30 +515,24 @@ def run_points_batched(base_cfg: SimConfig, cfgs: Sequence[SimConfig],
             order.append(key)
         buckets[key]["idx"].append(i)
         buckets[key]["cfgs"].append(cfg_f)
-    for key in order:
-        b = buckets[key]
-        faults = [faults_fn(c) for c in b["cfgs"]]
-        states = [init_state(c, initial_values, fl)
-                  for c, fl in zip(b["cfgs"], faults)]
-        if key[0] == "dyn":
-            b["states"] = _stack_tree(states)
-            b["faults"] = _stack_tree(faults)
-            b["dyn"] = DynParams.stack(b["cfgs"])
-        else:
-            # init_state aliases killed to faults.faulty under the crash
-            # model; the donated state must not share a buffer with the
-            # undonated faults argument ("donated buffer used twice")
-            st = states[0]
-            b["states"] = NetState(x=st.x, decided=st.decided, k=st.k,
-                                   killed=jnp.array(st.killed))
-            b["faults"] = faults[0]
     base_key = jax.random.key(base_cfg.seed)
+    journal = (SweepJournal(journal_path, resume=resume)
+               if journal_path is not None else None)
 
     # ---- compile + run: ONE executable per bucket ------------------------
     raw = [None] * len(cfgs)
     secs = [0.0] * len(cfgs)       # per-point amortized bucket run time
     compile_s = run_s = 0.0
-    bucket_sizes = []
+    total_compiles = 0
+    bucket_sizes: List[int] = []
+    stage_prepare: List[float] = []
+    stage_compile: List[float] = []
+    stage_run: List[float] = []
+    stage_fetch: List[float] = []
+    bucket_kinds: List[str] = []
+    bucket_indices: List[List[int]] = []
+    bucket_compiles: List[int] = []
+    bucket_reused: List[bool] = []
     heartbeat = None
     if base_cfg.heartbeat_rounds:
         from .meshscope.heartbeat import (HeartbeatPublisher,
@@ -485,40 +540,96 @@ def run_points_batched(base_cfg: SimConfig, cfgs: Sequence[SimConfig],
         heartbeat = HeartbeatPublisher(base_cfg, path=heartbeat_path,
                                        label="sweep")
     points_done = 0
-    with count_backend_compiles() as counter:
-        for key in order:
-            b = buckets[key]
-            rep = b["cfgs"][0]
-            bucket_sizes.append(len(b["idx"]))
-            # The executable returns the final states TOO (last position):
-            # the loop carry is the sweep's whole memory footprint, and
-            # donating the input states lets XLA alias them onto those
-            # state outputs — the carry lives in the donated buffers
-            # instead of input + carry both being live.  The states are
-            # never fetched; only the six summary outputs cross the wire.
-            # Under cfg.record each point's flight recorder joins the
-            # executable's outputs right before the (unfetched) final
-            # state — [B, R, REC_WIDTH] per dyn bucket, filled on device
-            # inside the same vmapped loop.  cfg.witness appends each
-            # point's witness buffer after it the same way.
-            if key[0] == "dyn":
-                def runner(states, faults, dyn, bk, _cfg=rep):
-                    def one(s, fl, d):
-                        out = run_consensus_traced(_cfg, s, fl, bk, d)
-                        r, fin = out[0], out[1]
-                        summ = _summarize_inline(_cfg, r, fin, fl)
-                        return summ + tuple(out[2:]) + (fin,)
-                    return jax.vmap(one, in_axes=(0, 0, 0))(
-                        states, faults, dyn)
-                args = (b["states"], b["faults"], b["dyn"], base_key)
-            else:
-                def runner(state, faults, bk, _cfg=rep):
-                    out = run_consensus(_cfg, state, faults, bk)
+    for bi, key in enumerate(order):
+        b = buckets[key]
+        rep = b["cfgs"][0]
+        bucket_sizes.append(len(b["idx"]))
+        bucket_kinds.append(key[0])
+        bucket_indices.append(list(b["idx"]))
+        # -- prepare/stack: fault specs (also the journal fingerprint's
+        # input), then — for buckets that will actually run — the
+        # stacked state tensors
+        t_prep0 = time.perf_counter()
+        faults = [faults_fn(c) for c in b["cfgs"]]
+        rec = None
+        if journal is not None:
+            b["fp"] = bucket_fingerprint(b["cfgs"], initial_values,
+                                         faults)
+            if resume:
+                rec = journal.match(b["fp"], b["idx"])
+        if rec is not None:
+            # journal restore: the bucket's points reassemble from disk
+            # through the IDENTICAL point_from_raw path; no tensor is
+            # built, no executable compiled, nothing dispatched
+            share = (float(rec.get("run_s") or 0.0)
+                     + float(rec.get("fetch_s") or 0.0)) / len(b["idx"])
+            for j, i in enumerate(b["idx"]):
+                raw[i] = deserialize_point(b["cfgs"][j],
+                                           rec["points"][j])
+                secs[i] = share
+            restore_s = time.perf_counter() - t_prep0
+            # the lists carry the JOURNALED stage clocks so straggler
+            # attribution survives a resume; this run spent ~nothing
+            stage_prepare.append(float(rec.get("prepare_s") or 0.0))
+            stage_compile.append(float(rec.get("compile_s") or 0.0))
+            stage_run.append(float(rec.get("run_s") or 0.0))
+            stage_fetch.append(float(rec.get("fetch_s") or 0.0))
+            bucket_compiles.append(0)
+            bucket_reused.append(True)
+            journal.reused += 1
+            emit_bucket_spans(bi, key[0], b["idx"], b["cfgs"],
+                              {"restore": (t_prep0, restore_s)},
+                              reused=True)
+            points_done += len(b["idx"])
+            if heartbeat is not None:
+                publish_sweep_heartbeat(base_cfg, points_done,
+                                        len(cfgs), publisher=heartbeat)
+            continue
+        states = [init_state(c, initial_values, fl)
+                  for c, fl in zip(b["cfgs"], faults)]
+        # The executable returns the final states TOO (last position):
+        # the loop carry is the sweep's whole memory footprint, and
+        # donating the input states lets XLA alias them onto those
+        # state outputs — the carry lives in the donated buffers
+        # instead of input + carry both being live.  The states are
+        # never fetched; only the six summary outputs cross the wire.
+        # Under cfg.record each point's flight recorder joins the
+        # executable's outputs right before the (unfetched) final
+        # state — [B, R, REC_WIDTH] per dyn bucket, filled on device
+        # inside the same vmapped loop.  cfg.witness appends each
+        # point's witness buffer after it the same way.
+        if key[0] == "dyn":
+            stacked = _stack_tree(states)
+            stacked_faults = _stack_tree(faults)
+            dyn = DynParams.stack(b["cfgs"])
+
+            def runner(states, faults, dyn, bk, _cfg=rep):
+                def one(s, fl, d):
+                    out = run_consensus_traced(_cfg, s, fl, bk, d)
                     r, fin = out[0], out[1]
-                    summ = _summarize_inline(_cfg, r, fin, faults)
+                    summ = _summarize_inline(_cfg, r, fin, fl)
                     return summ + tuple(out[2:]) + (fin,)
-                args = (b["states"], b["faults"], base_key)
-            t0 = time.perf_counter()
+                return jax.vmap(one, in_axes=(0, 0, 0))(
+                    states, faults, dyn)
+            args = (stacked, stacked_faults, dyn, base_key)
+        else:
+            # init_state aliases killed to faults.faulty under the crash
+            # model; the donated state must not share a buffer with the
+            # undonated faults argument ("donated buffer used twice")
+            st = states[0]
+            state = NetState(x=st.x, decided=st.decided, k=st.k,
+                             killed=jnp.array(st.killed))
+
+            def runner(state, faults, bk, _cfg=rep):
+                out = run_consensus(_cfg, state, faults, bk)
+                r, fin = out[0], out[1]
+                summ = _summarize_inline(_cfg, r, fin, faults)
+                return summ + tuple(out[2:]) + (fin,)
+            args = (state, faults[0], base_key)
+        del states
+        prepare_s = time.perf_counter() - t_prep0
+        t0 = time.perf_counter()
+        with count_backend_compiles() as bcc:
             with warnings.catch_warnings():
                 # backends without donation support (XLA:CPU) warn that
                 # the donated buffers went unused; that's the expected
@@ -532,32 +643,90 @@ def run_points_batched(base_cfg: SimConfig, cfgs: Sequence[SimConfig],
                 compiled = aot_compile(
                     runner, args, label=f"sweep.bucket.{key[0]}",
                     donate_argnums=(0,)).compiled
-            compile_s += time.perf_counter() - t0
+            bucket_compile_s = time.perf_counter() - t0
             t0 = time.perf_counter()
             *summ, _fin = compiled(*args)
-            out = [np.asarray(o) for o in summ]             # fetch = barrier
-            bucket_run = time.perf_counter() - t0
-            run_s += bucket_run
-            del _fin               # device-resident final states: not needed
+            # completion barrier: ONE output fetched — device execution
+            # finishes before the fetch returns, so this window is the
+            # execute stage and the remaining fetches are pure host wire
+            # + assembly time (the fetch stage)
+            first = np.asarray(summ[0])
+            bucket_run_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            out = [first] + [np.asarray(o) for o in summ[1:]]
+            del _fin           # device-resident final states: not needed
+            del args           # donated input buffers are dead
             for j, i in enumerate(b["idx"]):
                 raw[i] = ([o[j] for o in out] if key[0] == "dyn"
                           else [o for o in out])
-                secs[i] = bucket_run / len(b["idx"])
-            points_done += len(b["idx"])
-            if heartbeat is not None:
-                publish_sweep_heartbeat(base_cfg, points_done, len(cfgs),
-                                        publisher=heartbeat)
+            bucket_fetch_s = time.perf_counter() - t0
+        # seconds stays the amortized share of the bucket's post-compile
+        # execution wall (execute + fetch), as it always was
+        for i in b["idx"]:
+            secs[i] = (bucket_run_s + bucket_fetch_s) / len(b["idx"])
+        compile_s += bucket_compile_s
+        run_s += bucket_run_s + bucket_fetch_s
+        total_compiles += bcc.count
+        stage_prepare.append(prepare_s)
+        stage_compile.append(bucket_compile_s)
+        stage_run.append(bucket_run_s)
+        stage_fetch.append(bucket_fetch_s)
+        bucket_compiles.append(bcc.count)
+        bucket_reused.append(False)
+        emit_bucket_spans(
+            bi, key[0], b["idx"], b["cfgs"],
+            {"prepare": (t_prep0, prepare_s),
+             "compile": (t_prep0 + prepare_s, bucket_compile_s),
+             "execute": (t_prep0 + prepare_s + bucket_compile_s,
+                         bucket_run_s),
+             "fetch": (t_prep0 + prepare_s + bucket_compile_s
+                       + bucket_run_s, bucket_fetch_s)})
+        if journal is not None:
+            journal.record_bucket(
+                bi, key[0], b["idx"], b["fp"], bcc.count,
+                {"prepare_s": prepare_s, "compile_s": bucket_compile_s,
+                 "run_s": bucket_run_s, "fetch_s": bucket_fetch_s},
+                [serialize_point(c, raw[i])
+                 for c, i in zip(b["cfgs"], b["idx"])])
+        points_done += len(b["idx"])
+        if heartbeat is not None:
+            publish_sweep_heartbeat(base_cfg, points_done, len(cfgs),
+                                    publisher=heartbeat)
     del buckets  # the donated input buffers are dead; drop the refs
 
     points = _assemble_points(cfgs, raw, secs)
+    headroom = sweep_gate.overlap_headroom_s(
+        [{"prepare_s": p, "compile_s": c, "run_s": r, "fetch_s": f}
+         for p, c, r, f in zip(stage_prepare, stage_compile, stage_run,
+                               stage_fetch)])
     cb = BatchedCurve(points=points, n_buckets=len(order),
                       bucket_sizes=bucket_sizes,
-                      compile_count=counter.count,
-                      compile_s=compile_s, run_s=run_s)
+                      compile_count=total_compiles,
+                      compile_s=compile_s, run_s=run_s,
+                      bucket_prepare_s=stage_prepare,
+                      bucket_compile_s=stage_compile,
+                      bucket_run_s=stage_run,
+                      bucket_fetch_s=stage_fetch,
+                      bucket_kinds=bucket_kinds,
+                      bucket_point_indices=bucket_indices,
+                      bucket_compile_counts=bucket_compiles,
+                      bucket_reused=bucket_reused,
+                      wall_s=time.perf_counter() - t_wall0,
+                      overlap_headroom_s=headroom)
+    if journal is not None:
+        journal.record_done(len(cfgs), len(order), headroom)
     if verbose:
+        totals = [p + c + r + f
+                  for p, c, r, f in zip(stage_prepare, stage_compile,
+                                        stage_run, stage_fetch)]
+        share = max(totals) / sum(totals) if sum(totals) > 0 else 0.0
+        reused_note = (f", {sum(bucket_reused)} journal-restored"
+                       if any(bucket_reused) else "")
         print(f"  batched curve: {len(cfgs)} points / {cb.n_buckets} "
               f"bucket(s), {cb.compile_count} compiles "
-              f"({cb.compile_s:.1f}s), run {cb.run_s:.2f}s", flush=True)
+              f"({cb.compile_s:.1f}s), run {cb.run_s:.2f}s; max bucket "
+              f"share {100 * share:.0f}%, overlap headroom "
+              f"{cb.overlap_headroom_s:.2f}s{reused_note}", flush=True)
     return cb
 
 
@@ -568,13 +737,15 @@ def _assemble_points(cfgs, raw, secs) -> List[SweepPoint]:
 
 def rounds_vs_f_batched(base_cfg: SimConfig, f_values: Sequence[int],
                         verbose: bool = True,
-                        heartbeat_path: Optional[str] = None
-                        ) -> List[SweepPoint]:
+                        heartbeat_path: Optional[str] = None,
+                        journal_path: Optional[str] = None,
+                        resume: bool = False) -> List[SweepPoint]:
     """The north-star curve via the batched engine — same defaults and
     bit-identical summaries as ``rounds_vs_f``, O(buckets) compiles
     instead of O(points)."""
     cb = run_curve_batched(base_cfg, f_values, verbose=verbose,
-                           heartbeat_path=heartbeat_path)
+                           heartbeat_path=heartbeat_path,
+                           journal_path=journal_path, resume=resume)
     if verbose:
         for pt in cb.points:
             print(f"  f={pt.n_faulty}: mean_k={pt.mean_k:.2f} "

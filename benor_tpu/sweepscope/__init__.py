@@ -1,0 +1,59 @@
+"""sweepscope — bucket-lifecycle observability + durable resumable
+journal for the batched sweep engine (ISSUE 13).
+
+Perfscope observes executables BEFORE they run, meshscope WHILE a mesh
+runs, servescope the request plane's stages — sweepscope applies the
+same discipline to the last uninstrumented plane, the bucket lifecycle
+of ``sweep.run_points_batched`` / ``run_curve_batched``:
+
+  spans     per-bucket Span timelines (prepare/stack -> AOT
+            lower+compile -> execute -> fetch/assemble) through the
+            PR 11 Span API, with Perfetto flow links from each bucket
+            span to the point indices it carried (``sweep --batched
+            --trace-out``).
+  journal   the durable sweep journal: one line-atomic JSON record per
+            completed bucket (input fingerprint, stage clocks, compile
+            count, per-point payloads) such that ``run_points_batched
+            (..., journal_path=..., resume=True)`` survives a SIGKILL —
+            completed buckets reassemble bit-identically from disk,
+            only unfinished buckets recompile, and ANY tamper
+            (fingerprint drift, truncated line, reordered indices)
+            reruns rather than reuses.  This is the preemption-survival
+            substrate ROADMAP item 4's elastic giant sweeps build on.
+  manifest  the pinned-schema ``kind: sweep_manifest`` document
+            (tools/sweep_manifest_schema.json): per-bucket stage wall
+            clocks, the strictly-serial wall, the ideal
+            compile-ahead/execute-behind pipeline bound and the
+            ``overlap_headroom`` it would reclaim — item 4's async
+            dispatch lands with its before/after number already pinned.
+  gate      the stdlib-only band comparator behind
+            tools/check_sweep_regression.py (exit 0/2/3 vs the
+            committed SWEEP_BASELINE.json; file-path-loaded, the same
+            no-jax contract as perfscope/baseline.py).
+
+House rule (PRs 2/3/5/6/11): journal and tracing OFF are bit-identical
+in results AND compile counts across dyn and static buckets, and a
+resumed sweep is bit-equal to an uninterrupted one
+(tests/test_sweepscope.py pins all three).
+"""
+
+from .gate import (HEADROOM_BAND, TELESCOPE_MIN, IncomparableSweep,
+                   compare_sweep, ideal_pipeline_s, overlap_headroom_s,
+                   serial_s)
+from .journal import (BUCKET_KIND, DONE_KIND, SweepJournal,
+                      bucket_fingerprint, read_journal)
+from .manifest import (SWEEP_MANIFEST_KIND, build_sweep_manifest,
+                       capture_base_config, capture_f_values,
+                       capture_sweep_manifest, default_sweep_scale,
+                       load_sweep_manifest, save_sweep_manifest)
+from .spans import emit_bucket_spans
+
+__all__ = [
+    "HEADROOM_BAND", "TELESCOPE_MIN", "IncomparableSweep",
+    "compare_sweep", "ideal_pipeline_s", "overlap_headroom_s",
+    "serial_s", "BUCKET_KIND", "DONE_KIND", "SweepJournal",
+    "bucket_fingerprint", "read_journal", "SWEEP_MANIFEST_KIND",
+    "build_sweep_manifest", "capture_base_config", "capture_f_values",
+    "capture_sweep_manifest", "default_sweep_scale",
+    "load_sweep_manifest", "save_sweep_manifest", "emit_bucket_spans",
+]

@@ -1,0 +1,216 @@
+"""Sweep regression gate: band-compare two sweep manifests.
+
+STDLIB-ONLY by contract: ``tools/check_sweep_regression.py`` loads this
+file BY PATH so a CI image can gate a sweep capture against the
+committed SWEEP_BASELINE.json without initializing any JAX backend —
+the same discipline as ``perfscope/baseline.py``,
+``meshscope/scalegate.py`` and ``serve/gate.py`` (an import creep here
+breaks that gate immediately).  ``tools/check_metrics_schema.py`` also
+loads this file to RECOMPUTE the ideal-pipeline bound from a manifest's
+per-bucket stages, so the cross-field check and the gate can never
+disagree about what "headroom" means.
+
+The pipeline model (``ideal_pipeline_s``): today ``sweep.
+run_points_batched`` runs its buckets strictly serially — prepare,
+compile, execute, fetch, next bucket — so the host sits idle while the
+device executes and the device sits idle while the host compiles and
+fetches.  The ideal compile-ahead/execute-behind pipeline overlaps
+them: the host prepares+compiles bucket b+1 while the device executes
+bucket b, and fetch/assembly drains off the critical path (an async
+callback).  ``overlap_headroom_s = serial_s - ideal_pipeline_s`` is the
+wall-clock that pipeline would reclaim — the before/after number
+ROADMAP item 4's per-bucket async dispatch lands against.
+
+What gates by default (structural, machine-insensitive):
+
+  * ``overlap_headroom_frac``   headroom as a fraction of the serial
+                                wall.  A manifest whose fraction GREW
+                                past ``HEADROOM_BAND`` x baseline (over
+                                the ``HEADROOM_FRAC_SLACK`` noise floor)
+                                spends relatively more time with one
+                                side idle — the sweep plane became MORE
+                                serialized (the injected-regression
+                                fixture shape).  A missing/non-numeric
+                                headroom is the worst finding: the
+                                attribution vanished.
+  * ``compile_count``           more backend compiles than baseline at
+                                the same scale means the bucketing
+                                collapsed toward compile-per-point —
+                                the regression the batched engine
+                                exists to prevent.
+  * ``telescoping.coverage``    the per-bucket stage clocks must
+                                telescope to the sweep wall clock
+                                (>= ``TELESCOPE_MIN``); a manifest whose
+                                stages no longer account for the wall is
+                                hiding where the time went.
+
+Wall-clock metrics (``wall_s``) gate only under an explicit
+``timing_band`` — shared CI machines make them noisy, exactly like the
+perf/serve gates.
+
+Comparability (exit 3, never a confident verdict): kind/schema_version
+mismatch, different platform, or a different scale block (bucket
+timings at another geometry say nothing about this one).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+#: Ratio band on the headroom fraction vs baseline before it counts as
+#: a serialization regression.
+HEADROOM_BAND = 1.5
+
+#: Absolute noise floor on the headroom-fraction delta (1.5x of nearly
+#: nothing is timer jitter, not a regression).
+HEADROOM_FRAC_SLACK = 0.15
+
+#: Minimum fraction of the sweep wall clock the per-bucket stage clocks
+#: must account for (the telescoping band; the remainder is bucketing /
+#: input-build overhead outside any stage).
+TELESCOPE_MIN = 0.7
+
+#: Stage-clock sums may exceed the wall only by timer noise.
+TELESCOPE_MAX = 1.05
+
+#: Schema version this comparator understands.
+SCHEMA_VERSION = 1
+
+#: The four bucket lifecycle stages, in execution order.  ``prepare``
+#: and ``compile`` are host work, ``run`` is device work, ``fetch`` is
+#: host work that an async pipeline drains off the critical path.
+STAGES = ("prepare_s", "compile_s", "run_s", "fetch_s")
+
+
+class IncomparableSweep(Exception):
+    """The two manifests cannot be honestly compared."""
+
+
+@dataclasses.dataclass
+class SweepFinding:
+    """One gated regression."""
+
+    metric: str
+    message: str
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+def serial_s(buckets: List[dict]) -> float:
+    """Total strictly-serial wall: every stage of every bucket, summed —
+    what the engine measures today."""
+    return float(sum(sum(float(b.get(s) or 0.0) for s in STAGES)
+                     for b in buckets))
+
+
+def ideal_pipeline_s(buckets: List[dict]) -> float:
+    """Wall clock of the ideal compile-ahead/execute-behind pipeline
+    over the measured per-bucket stages.
+
+    Two resources: the HOST (prepare + compile, in bucket order, plus
+    fetch handled off-thread) and the DEVICE (execute).  Bucket b's
+    execute can start only after its own compile lands AND the device
+    finished bucket b-1; its fetch drains concurrently with later
+    compiles.  Always <= ``serial_s`` (equal for a single bucket: a
+    bucket cannot overlap with itself), so the headroom is >= 0 by
+    construction."""
+    host = 0.0          # host cursor: prepare+compile in bucket order
+    device = 0.0        # device cursor: executes back to back
+    end = 0.0
+    for b in buckets:
+        host += float(b.get("prepare_s") or 0.0)
+        host += float(b.get("compile_s") or 0.0)
+        start = max(host, device)
+        device = start + float(b.get("run_s") or 0.0)
+        end = max(end, device + float(b.get("fetch_s") or 0.0))
+    return float(max(end, host))
+
+
+def overlap_headroom_s(buckets: List[dict]) -> float:
+    """The wall-clock an ideal pipeline would reclaim from the
+    measured serial schedule (>= 0)."""
+    return max(0.0, serial_s(buckets) - ideal_pipeline_s(buckets))
+
+
+def _require(manifest: Dict, name: str) -> Dict:
+    if not isinstance(manifest, dict) or \
+            manifest.get("kind") != "sweep_manifest":
+        raise IncomparableSweep(f"{name} is not a sweep manifest "
+                                f"(kind={manifest.get('kind')!r})")
+    if manifest.get("schema_version") != SCHEMA_VERSION:
+        raise IncomparableSweep(
+            f"{name} schema_version {manifest.get('schema_version')!r} "
+            f"!= {SCHEMA_VERSION}")
+    return manifest
+
+
+def compare_sweep(manifest: Dict, baseline: Dict,
+                  headroom_band: float = HEADROOM_BAND,
+                  timing_band: Optional[float] = None
+                  ) -> List[SweepFinding]:
+    """New manifest vs baseline -> regression findings (empty = in-band).
+
+    Raises IncomparableSweep when a verdict would be dishonest (see
+    module docstring); the CLI maps that to exit 3.
+    """
+    _require(manifest, "manifest")
+    _require(baseline, "baseline")
+    if manifest.get("platform") != baseline.get("platform"):
+        raise IncomparableSweep(
+            f"platform differs: {manifest.get('platform')!r} vs baseline "
+            f"{baseline.get('platform')!r} — recapture on the baseline "
+            f"platform or re-baseline")
+    if manifest.get("scale") != baseline.get("scale"):
+        raise IncomparableSweep(
+            f"sweep scale differs: {manifest.get('scale')} vs baseline "
+            f"{baseline.get('scale')}")
+
+    findings: List[SweepFinding] = []
+    hr = manifest.get("overlap_headroom_frac")
+    base_hr = baseline.get("overlap_headroom_frac")
+    if not isinstance(hr, (int, float)) or isinstance(hr, bool):
+        findings.append(SweepFinding(
+            "overlap_headroom_frac",
+            f"overlap headroom missing/non-numeric ({hr!r}): the "
+            f"pipeline attribution vanished — the worst observability "
+            f"collapse, nothing prices item 4's async dispatch anymore"))
+    elif isinstance(base_hr, (int, float)) and \
+            not isinstance(base_hr, bool):
+        if (hr > base_hr * headroom_band
+                and hr - base_hr > HEADROOM_FRAC_SLACK):
+            findings.append(SweepFinding(
+                "overlap_headroom_frac",
+                f"serialized-pipeline regression: overlap headroom "
+                f"fraction {hr:.3f} > {headroom_band} x baseline "
+                f"{base_hr:.3f} (delta over the {HEADROOM_FRAC_SLACK} "
+                f"noise floor) — the sweep spends relatively more wall "
+                f"clock with the host or device idle"))
+    new_cc = manifest.get("compile_count")
+    base_cc = baseline.get("compile_count")
+    if isinstance(new_cc, int) and isinstance(base_cc, int) and \
+            new_cc > base_cc:
+        findings.append(SweepFinding(
+            "compile_count",
+            f"{new_cc} backend compiles vs baseline {base_cc} at the "
+            f"same scale — the bucketing regressed toward "
+            f"compile-per-point"))
+    tel = manifest.get("telescoping") or {}
+    cov = tel.get("coverage")
+    if not isinstance(cov, (int, float)) or isinstance(cov, bool) or \
+            cov < TELESCOPE_MIN:
+        findings.append(SweepFinding(
+            "telescoping.coverage",
+            f"bucket stage clocks cover {cov!r} of the sweep wall clock "
+            f"(< {TELESCOPE_MIN}): the stage model no longer accounts "
+            f"for where the time goes"))
+    if timing_band is not None:
+        wall = float(manifest.get("wall_s") or 0.0)
+        base_wall = float(baseline.get("wall_s") or 0.0)
+        if base_wall > 0 and wall > base_wall * timing_band:
+            findings.append(SweepFinding(
+                "wall_s",
+                f"sweep wall {wall:.2f}s > {timing_band} x baseline "
+                f"{base_wall:.2f}s"))
+    return findings

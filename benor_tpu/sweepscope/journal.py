@@ -1,0 +1,240 @@
+"""Durable sweep journal: append-only bucket records + exact resume.
+
+A preempted 10M-point sweep restarts from zero today — every bucket
+recompiles, every point reruns.  The journal closes that gap HOST-SIDE:
+after each bucket of ``sweep.run_points_batched`` completes, ONE
+JSON line (``kind: sweep_bucket``, written line-atomically via
+``metrics.append_jsonl``) records everything needed to reassemble that
+bucket's points without touching a device:
+
+  * the bucket's position, kind (dyn/static) and point indices;
+  * an INPUT FINGERPRINT — sha256 over every point config (canonical
+    JSON of the frozen dataclass), the initial-values array (shape,
+    dtype, bytes) and the fault masks — so a journal written for one
+    sweep can never be silently replayed into a different one;
+  * the measured stage wall clocks (prepare/compile/run/fetch) and the
+    bucket's backend-compile count;
+  * the per-point summary payloads, serialized value-exactly (Python
+    floats round-trip through JSON bit-exactly; histograms and
+    recorder/witness buffers as int lists).
+
+``run_points_batched(..., journal_path=..., resume=True)`` then skips
+every bucket whose fingerprint + point indices match a journal record
+and reassembles its points through the IDENTICAL ``point_from_raw``
+code path — bit-equal to an uninterrupted run, with exactly the
+unfinished buckets recompiled (tests/test_sweepscope.py pins both,
+including a SIGKILL-mid-bucket forensics run).  Any mismatch —
+fingerprint drift, a truncated (killed-mid-append) trailing line,
+reordered/edited point indices, a short or edited payload (every
+record carries a digest of its payload list, recomputed before reuse)
+— makes the bucket RERUN, never silently reuse: a tampered journal
+costs time, not correctness.
+
+Journal off is the absolute default and bit-identical in results AND
+compile counts (everything here is host-side, out-of-band of the
+compiled executables — the flight-recorder house rule applied to the
+sweep plane).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import metrics
+
+#: Record tag of one completed bucket (what ``watch`` renders and
+#: ``resume`` keys on).
+BUCKET_KIND = "sweep_bucket"
+
+#: Terminal record of a completed sweep (``done: true`` — ``watch``
+#: stops on it like a heartbeat close beat).
+DONE_KIND = "sweep_done"
+
+#: Bumped with any record-shape change; part of the fingerprint, so a
+#: journal written by an older engine reruns rather than misparses.
+JOURNAL_VERSION = 1
+
+
+def _hash_array(h, arr) -> None:
+    a = np.asarray(arr)
+    h.update(str(a.shape).encode())
+    h.update(str(a.dtype).encode())
+    h.update(a.tobytes())
+
+
+def bucket_fingerprint(cfgs, initial_values, faults) -> str:
+    """Input fingerprint of one bucket: config hash + seed + shapes.
+
+    Covers every input the bucket executable consumes — the per-point
+    frozen configs (canonical sorted-key JSON; the seed rides inside),
+    the shared initial-values array and each point's fault masks
+    (faulty + crash_round) — so "same fingerprint" means "same compiled
+    program on the same operands" and a journaled payload may stand in
+    for a rerun bit-for-bit."""
+    h = hashlib.sha256()
+    h.update(f"sweep-journal-v{JOURNAL_VERSION}".encode())
+    for c in cfgs:
+        h.update(json.dumps(dataclasses.asdict(c), sort_keys=True,
+                            default=str).encode())
+    _hash_array(h, initial_values)
+    for fl in faults:
+        _hash_array(h, fl.faulty)
+        _hash_array(h, fl.crash_round)
+    return "sha256:" + h.hexdigest()
+
+
+def serialize_point(cfg_f, vals) -> dict:
+    """One point's raw bucket outputs -> a JSON-exact payload.
+
+    ``vals`` is the ``_summarize_inline`` layout ``point_from_raw``
+    consumes: (rounds, decided, mean_k, ones, k_hist, disagree
+    [, recorder][, witness]).  Scalars are stored as the exact Python
+    floats ``point_from_raw`` would produce (``float()`` of a float32
+    is exact in double, and JSON round-trips doubles exactly), so
+    deserialize -> point_from_raw is bit-equal to the live path."""
+    r, dec, mk, ones, khist, dis, *rest = vals
+    rest = list(rest)
+    d = {
+        "rounds": int(r),
+        "decided": float(dec),
+        "mean_k": float(mk),
+        "ones": float(ones),
+        "k_hist": np.asarray(khist).astype(np.int64).tolist(),
+        "disagree": float(dis),
+    }
+    if cfg_f.record:
+        d["round_history"] = np.asarray(rest.pop(0),
+                                        np.int32).tolist()
+    if cfg_f.witness:
+        d["witness"] = np.asarray(rest.pop(0), np.int32).tolist()
+    return d
+
+
+def deserialize_point(cfg_f, payload: dict) -> list:
+    """A journal payload -> the raw ``vals`` list ``point_from_raw``
+    consumes (the inverse of :func:`serialize_point`)."""
+    vals = [payload["rounds"], payload["decided"], payload["mean_k"],
+            payload["ones"], np.asarray(payload["k_hist"], np.int64),
+            payload["disagree"]]
+    if cfg_f.record:
+        vals.append(np.asarray(payload["round_history"], np.int32))
+    if cfg_f.witness:
+        vals.append(np.asarray(payload["witness"], np.int32))
+    return vals
+
+
+def payload_digest(points: List[dict]) -> str:
+    """Digest of a bucket record's per-point payload list (canonical
+    JSON).  Written into every record and recomputed at resume time, so
+    a payload tampered IN PLACE — a renamed key, an edited value — is
+    as detectable as a drifted input fingerprint: the bucket reruns,
+    it is never silently reused."""
+    return "sha256:" + hashlib.sha256(
+        json.dumps(points, sort_keys=True).encode()).hexdigest()
+
+
+def read_journal(path: str) -> List[dict]:
+    """Parse a journal file -> bucket/done records, in file order.
+    A torn (killed-mid-append) or hand-mangled line is SKIPPED, not an
+    error: its bucket simply has no record, so resume reruns it."""
+    out: List[dict] = []
+    try:
+        with open(path) as fh:
+            lines = fh.readlines()
+    except OSError:
+        return out
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue                  # torn/tampered line -> no record
+        if isinstance(rec, dict) and rec.get("kind") in (BUCKET_KIND,
+                                                         DONE_KIND):
+            out.append(rec)
+    return out
+
+
+class SweepJournal:
+    """One run's journal handle: the write side appends bucket/done
+    records; the resume side indexes existing records by
+    (fingerprint, point indices) so lookup is tamper-evident by
+    construction — ANY drift in either key misses and the bucket
+    reruns."""
+
+    def __init__(self, path: str, resume: bool = False,
+                 label: str = "sweep"):
+        self.path = path
+        self.label = label
+        self.reused = 0
+        self._lookup: Dict[Tuple[str, Tuple[int, ...]], dict] = {}
+        if resume:
+            for rec in read_journal(path):
+                if rec.get("kind") != BUCKET_KIND:
+                    continue
+                fp = rec.get("fingerprint")
+                idx = rec.get("point_indices")
+                if isinstance(fp, str) and isinstance(idx, list):
+                    # latest record wins (an append-only journal may
+                    # carry a superseded attempt for the same bucket)
+                    self._lookup[(fp, tuple(int(i) for i in idx))] = rec
+        else:
+            # a fresh run must not inherit a stale journal: truncate so
+            # the file holds exactly this run's records
+            with open(path, "w"):
+                pass
+
+    def match(self, fingerprint: str,
+              point_indices: List[int]) -> Optional[dict]:
+        """The completed-bucket record for these exact inputs, or None.
+        A record whose payload count disagrees with its own index list,
+        or whose payloads no longer hash to the recorded digest (a key
+        renamed, a value edited), is tampered and never reused."""
+        rec = self._lookup.get((fingerprint, tuple(point_indices)))
+        if rec is None:
+            return None
+        pts = rec.get("points")
+        if (not isinstance(pts, list)
+                or len(pts) != len(point_indices)
+                or rec.get("payload_sha256") != payload_digest(pts)):
+            metrics.REGISTRY.counter("sweepscope.journal.tampered").inc()
+            return None
+        return rec
+
+    def record_bucket(self, index: int, kind: str,
+                      point_indices: List[int], fingerprint: str,
+                      compile_count: int, stages: Dict[str, float],
+                      points: List[dict]) -> dict:
+        rec = {
+            "kind": BUCKET_KIND, "label": self.label,
+            "journal_version": JOURNAL_VERSION,
+            "bucket_index": int(index), "bucket_kind": kind,
+            "point_indices": [int(i) for i in point_indices],
+            "fingerprint": fingerprint,
+            "compile_count": int(compile_count),
+            **{k: round(float(v), 6) for k, v in stages.items()},
+            "payload_sha256": payload_digest(points),
+            "points": points,
+        }
+        metrics.append_jsonl(self.path, rec)
+        metrics.REGISTRY.counter("sweepscope.journal.buckets").inc()
+        return rec
+
+    def record_done(self, points_total: int, n_buckets: int,
+                    overlap_headroom_s: float) -> dict:
+        rec = {
+            "kind": DONE_KIND, "label": self.label, "done": True,
+            "points_total": int(points_total),
+            "n_buckets": int(n_buckets),
+            "buckets_reused": int(self.reused),
+            "overlap_headroom_s": round(float(overlap_headroom_s), 6),
+        }
+        metrics.append_jsonl(self.path, rec)
+        return rec

@@ -1,0 +1,168 @@
+"""The pinned-schema ``kind: sweep_manifest`` document.
+
+Reduces one ``sweep.BatchedCurve`` (the per-bucket stage clocks PR 13
+surfaced on it) to the committed-artifact contract the sweep gate
+consumes: per-bucket prepare/compile/run/fetch wall clocks, their
+stage totals, the strictly-serial wall, the ideal-pipeline bound and
+the ``overlap_headroom`` attribution (sweepscope/gate.py owns the
+model so the gate and the cross-field checker can never disagree),
+plus the telescoping cross-check that the stage clocks account for the
+sweep's measured end-to-end wall.  Schema:
+tools/sweep_manifest_schema.json, auto-detected + cross-field-validated
+by tools/check_metrics_schema.check_sweep_manifest; gated against the
+committed SWEEP_BASELINE.json by tools/check_sweep_regression.py
+(exit 0/2/3).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Optional, Sequence
+
+from . import gate
+
+#: The manifest's ``kind`` tag (benorlint ``manifest-kind-parity`` pins
+#: that a registered checker exists for it in
+#: tools/check_metrics_schema.py MANIFEST_CHECKERS).
+SWEEP_MANIFEST_KIND = "sweep_manifest"
+
+SCHEMA_VERSION = gate.SCHEMA_VERSION
+
+
+def default_sweep_scale() -> Dict:
+    """The fixed CPU-smoke capture scale the committed
+    SWEEP_BASELINE.json was taken at: the smallest geometry whose f
+    grid exercises BOTH bucket kinds — three CF-regime points sharing
+    one dyn bucket (quorum > sampling.EXACT_TABLE_MAX) plus one
+    exact-table point in a static bucket of its own."""
+    return {"n_nodes": 9000, "trials": 4, "max_rounds": 12, "seed": 0}
+
+
+def capture_f_values(n_nodes: int) -> list:
+    """The standard capture's f grid at ``n_nodes``: three dyn-bucket
+    points + one quorum-specialized (exact-table) point."""
+    from ..ops import sampling
+    if n_nodes <= sampling.EXACT_TABLE_MAX:
+        raise ValueError(
+            f"the sweep capture needs n_nodes > "
+            f"{sampling.EXACT_TABLE_MAX} so its CF points share a dyn "
+            f"bucket (got {n_nodes})")
+    dyn = [n_nodes // 15, n_nodes // 7, n_nodes // 5]
+    static = [n_nodes - sampling.EXACT_TABLE_MAX + max(1, n_nodes // 18)]
+    return dyn + static
+
+
+def build_sweep_manifest(cb, base_cfg, platform: Optional[str] = None,
+                         device_kind: Optional[str] = None) -> Dict:
+    """A ``BatchedCurve`` + its base config -> the manifest document.
+
+    Refuses a resumed curve: a journal-restored bucket's stage clocks
+    price the ORIGINAL run's pipeline, so a manifest mixing them with
+    this run's wall clock could not telescope honestly."""
+    if any(cb.bucket_reused):
+        raise ValueError(
+            "cannot build a sweep manifest from a resumed curve "
+            f"({sum(cb.bucket_reused)} of {cb.n_buckets} buckets were "
+            "journal-restored): the stage clocks price the original "
+            "run, not this wall clock — capture an uninterrupted run")
+    if platform is None or device_kind is None:
+        import jax
+        dev = jax.devices()[0]
+        platform = dev.platform if platform is None else platform
+        device_kind = (dev.device_kind if device_kind is None
+                       else device_kind)
+    buckets = []
+    for i in range(cb.n_buckets):
+        buckets.append({
+            "index": i,
+            "kind": cb.bucket_kinds[i],
+            "size": cb.bucket_sizes[i],
+            "point_indices": [int(p) for p in cb.bucket_point_indices[i]],
+            "prepare_s": round(cb.bucket_prepare_s[i], 6),
+            "compile_s": round(cb.bucket_compile_s[i], 6),
+            "run_s": round(cb.bucket_run_s[i], 6),
+            "fetch_s": round(cb.bucket_fetch_s[i], 6),
+            "compile_count": int(cb.bucket_compile_counts[i]),
+        })
+    totals = {s: round(sum(float(b[s]) for b in buckets), 6)
+              for s in gate.STAGES}
+    serial = round(gate.serial_s(buckets), 6)
+    ideal = round(gate.ideal_pipeline_s(buckets), 6)
+    headroom = round(max(0.0, serial - ideal), 6)
+    wall = round(float(cb.wall_s), 6)
+    coverage = round(serial / wall, 6) if wall > 0 else 0.0
+    return {
+        "kind": SWEEP_MANIFEST_KIND,
+        "schema_version": SCHEMA_VERSION,
+        "platform": platform,
+        "device_kind": device_kind,
+        "scale": {
+            "n_nodes": int(base_cfg.n_nodes),
+            "trials": int(base_cfg.trials),
+            "max_rounds": int(base_cfg.max_rounds),
+            "seed": int(base_cfg.seed),
+            "n_points": len(cb.points),
+            "f_values": [int(p.n_faulty) for p in cb.points],
+        },
+        "n_buckets": int(cb.n_buckets),
+        "compile_count": int(cb.compile_count),
+        "wall_s": wall,
+        "buckets": buckets,
+        "stage_totals": totals,
+        "serial_s": serial,
+        "ideal_pipeline_s": ideal,
+        "overlap_headroom_s": headroom,
+        "overlap_headroom_frac": (round(headroom / serial, 6)
+                                  if serial > 0 else 0.0),
+        "telescoping": {
+            "stage_sum_s": serial,
+            "wall_s": wall,
+            "coverage": coverage,
+        },
+    }
+
+
+def capture_base_config(f_values: Optional[Sequence[int]] = None,
+                        **scale):
+    """The standard capture workload -> (base SimConfig, f grid).  The
+    ONE definition bench's ``_sweepscope_check`` and
+    :func:`capture_sweep_manifest` (the committed-baseline
+    regeneration) both build from, so the artifact and CI can never
+    silently price different workloads."""
+    from ..config import SimConfig
+
+    sc = default_sweep_scale()
+    sc.update(scale)
+    fs = (capture_f_values(sc["n_nodes"]) if f_values is None
+          else list(f_values))
+    base = SimConfig(n_nodes=sc["n_nodes"], n_faulty=0,
+                     trials=sc["trials"], max_rounds=sc["max_rounds"],
+                     seed=sc["seed"], delivery="quorum",
+                     scheduler="uniform", path="histogram")
+    return base, fs
+
+
+def capture_sweep_manifest(journal_path: Optional[str] = None,
+                           f_values: Optional[Sequence[int]] = None,
+                           **scale):
+    """Run the standard two-bucket capture curve and build its manifest
+    -> (manifest, BatchedCurve)."""
+    from ..sweep import run_curve_batched
+
+    base, fs = capture_base_config(f_values=f_values, **scale)
+    cb = run_curve_batched(base, fs, journal_path=journal_path)
+    return build_sweep_manifest(cb, base), cb
+
+
+def save_sweep_manifest(path: str, manifest: Dict) -> None:
+    with open(path, "w") as fh:
+        json.dump(manifest, fh, indent=1)
+
+
+def load_sweep_manifest(path: str) -> Dict:
+    with open(path) as fh:
+        doc = json.load(fh)
+    if doc.get("kind") != SWEEP_MANIFEST_KIND:
+        raise ValueError(
+            f"{path}: not a sweep manifest (kind={doc.get('kind')!r})")
+    return doc

@@ -1,0 +1,70 @@
+"""Bucket-lifecycle span tracing for the batched sweep engine.
+
+The PR 11 Span API (utils/metrics: ``SPANS``, Perfetto flow links)
+applied to the sweep plane: every bucket of ``sweep.run_points_batched``
+emits one whole-bucket span with four stage children — prepare/stack ->
+AOT lower+compile -> execute -> fetch/assemble — and a flow arrow from
+the bucket span to each POINT it carried (one thin span per point on
+the ``sweep.points`` track, spanning the bucket's execute window), so
+ui.perfetto.dev answers "which bucket spent the time, and which curve
+points rode it" at a glance.  ``python -m benor_tpu sweep --batched
+--trace-out trace.json`` arms it.
+
+Tracing is DISABLED by default (``SPANS.add`` is a no-op) and only ever
+consumes host-side ``perf_counter`` stamps the engine takes regardless
+for its per-bucket stage clocks — so tracing on/off is bit-identical in
+results AND compile counts (tests/test_sweepscope.py pins it, the same
+house rule as servescope's).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.metrics import SPANS, perf_to_epoch
+
+#: Stage names in lifecycle order, as emitted on the bucket track.
+STAGE_NAMES = ("prepare", "compile", "execute", "fetch")
+
+
+def emit_bucket_spans(bucket_index: int, kind: str,
+                      point_indices: List[int], cfgs,
+                      stamps: Dict[str, Tuple[float, float]],
+                      reused: bool = False,
+                      label: str = "sweep") -> Optional[int]:
+    """Emit one bucket's span tree into the process-wide SPANS log.
+
+    ``stamps`` maps stage name -> (perf_counter start, duration s); a
+    reused (journal-restored) bucket passes a single ``restore`` stamp
+    instead of the four lifecycle stages.  Returns the bucket span id
+    (None when tracing is off — the disabled path does no work beyond
+    this one attribute read)."""
+    if not SPANS.enabled:
+        return None
+    order = ("restore",) if reused else STAGE_NAMES
+    present = [s for s in order if s in stamps]
+    if not present:
+        return None
+    start = min(stamps[s][0] for s in present)
+    end = max(stamps[s][0] + stamps[s][1] for s in present)
+    flows = [SPANS.new_flow() for _ in point_indices]
+    bucket_id = SPANS.add(
+        f"{label}.bucket[{bucket_index}]", perf_to_epoch(start),
+        end - start, track=f"{label}.buckets", flow_out=flows,
+        args={"bucket": int(bucket_index), "kind": kind,
+              "size": len(point_indices), "reused": bool(reused),
+              "points": [int(i) for i in point_indices]})
+    for stage in present:
+        t0, dur = stamps[stage]
+        SPANS.add(f"{label}.{stage}", perf_to_epoch(t0), dur,
+                  track=f"{label}.buckets", parent_id=bucket_id,
+                  args={"bucket": int(bucket_index)})
+    # the execute window is when each point's summary was actually
+    # computed; journal-restored buckets anchor points on the restore
+    ex_start, ex_dur = stamps.get("execute", stamps[present[0]])
+    for fid, idx, cfg in zip(flows, point_indices, cfgs):
+        SPANS.add(f"{label}.point[{int(idx)}]", perf_to_epoch(ex_start),
+                  ex_dur, track=f"{label}.points", flow_in=fid,
+                  args={"point": int(idx), "bucket": int(bucket_index),
+                        "n_faulty": int(cfg.n_faulty)})
+    return bucket_id

@@ -748,6 +748,96 @@ def test_registry_module_gone_is_also_stale(tmp_path):
 
 
 # --------------------------------------------------------------------------
+# manifest-kind-parity: emitted manifest kinds need registered checkers
+# --------------------------------------------------------------------------
+
+
+MANIFEST_EMIT_SRC = """\
+    FOO_MANIFEST_KIND = "foo_manifest"        # MARK-const
+
+
+    def build():
+        return {"kind": "bar_manifest"}       # MARK-dict
+"""
+
+
+def test_manifest_kind_fixture_without_tools(tmp_path):
+    # no tools/check_metrics_schema.py next to the tree: every emitted
+    # kind is unregistered by definition (the missing-funnel behavior
+    # of perf-unregistered-jit)
+    root = _write_pkg(tmp_path, {"emit.py": MANIFEST_EMIT_SRC})
+    active, _ = _findings(root, rules=["manifest-kind-parity"])
+    got = sorted((f.path, f.line) for f in active)
+    assert got == [
+        ("emit.py", _line_of(MANIFEST_EMIT_SRC, "MARK-const")),
+        ("emit.py", _line_of(MANIFEST_EMIT_SRC, "MARK-dict")),
+    ]
+    assert all(f.rule == "manifest-kind-parity" for f in active)
+    assert all("not in the tree" in f.message for f in active)
+
+
+def test_manifest_kind_identifier_strings_do_not_count(tmp_path):
+    # __all__ rosters of *_manifest function NAMES and comparison-site
+    # consumers are not emissions — only the dict-entry and *_KIND
+    # constant spellings count
+    root = _write_pkg(tmp_path, {"mod.py": """\
+        __all__ = ["save_sweep_manifest", "build_scaling_manifest"]
+
+
+        def compare(doc):
+            return doc.get("kind") == "nonexistent_manifest"
+    """})
+    active, _ = _findings(root, rules=["manifest-kind-parity"])
+    assert active == []
+
+
+def _manifest_tree(tmp_path) -> str:
+    """The real sweepscope manifest builder + the real checker registry
+    in the sibling tools/ dir (the rule resolves the registry relative
+    to the lint root's PARENT, mirroring the repo layout)."""
+    root = tmp_path / "pkg"
+    (root / "sweepscope").mkdir(parents=True)
+    shutil.copy(os.path.join(PKG_DIR, "sweepscope", "manifest.py"),
+                root / "sweepscope" / "manifest.py")
+    (tmp_path / "tools").mkdir()
+    shutil.copy(os.path.join(REPO, "tools", "check_metrics_schema.py"),
+                tmp_path / "tools" / "check_metrics_schema.py")
+    return str(root)
+
+
+def test_manifest_kind_clean_on_shipped_registry(tmp_path):
+    active, _ = _findings(_manifest_tree(tmp_path),
+                          rules=["manifest-kind-parity"])
+    assert active == []
+
+
+def test_removing_sweep_checker_registration_fails(tmp_path):
+    """The acceptance mutation: un-registering check_sweep_manifest
+    makes the (unchanged) sweepscope emission an unvalidated kind."""
+    root = _manifest_tree(tmp_path)
+    _edit(str(tmp_path), "tools/check_metrics_schema.py",
+          '    "sweep_manifest": "check_sweep_manifest",\n', "",
+          count=1)
+    active, _ = _findings(root, rules=["manifest-kind-parity"])
+    assert len(active) == 1
+    f = active[0]
+    assert f.path == "sweepscope/manifest.py"
+    assert "'sweep_manifest'" in f.message
+
+
+def test_stale_manifest_checker_row_is_a_finding(tmp_path):
+    # a registry row whose checker function left the tool validates
+    # nothing — the JIT_REGISTRY staleness discipline
+    root = _manifest_tree(tmp_path)
+    _edit(str(tmp_path), "tools/check_metrics_schema.py",
+          '"check_sweep_manifest"', '"check_sweep_gone"', count=1)
+    active, _ = _findings(root, rules=["manifest-kind-parity"])
+    stale = [f for f in active if "stale" in f.message]
+    assert len(stale) == 1
+    assert "check_sweep_gone" in stale[0].message
+
+
+# --------------------------------------------------------------------------
 # self-check: the shipped tree is lint-clean, suppressions accounted
 # --------------------------------------------------------------------------
 

@@ -1,0 +1,642 @@
+"""sweepscope (benor_tpu/sweepscope) — bucket-lifecycle tracing,
+overlap-headroom attribution, and the durable resumable sweep journal.
+
+Pins the PR 13 house rules:
+
+  * journal OFF and ON are bit-identical in the science fields AND
+    backend compile counts, across dyn and static buckets;
+  * span tracing OFF and ON are bit-identical the same way, and the
+    emitted spans nest (four lifecycle stages inside each bucket span)
+    with 1:1 flow links from every bucket to the points it carried;
+  * a resumed sweep is bit-equal to an uninterrupted one — including
+    after a SIGKILL mid-bucket — with exactly the unfinished buckets
+    recompiled; ANY journal tamper (fingerprint drift, truncated line,
+    reordered indices) reruns rather than reuses;
+  * the ``kind: sweep_manifest`` document validates against
+    tools/sweep_manifest_schema.json with its cross-field pins
+    (stage telescoping, headroom recomputed from stages), and
+    tools/check_sweep_regression.py exits 0 on the committed
+    SWEEP_BASELINE.json, 2 on an injected serialized-pipeline
+    regression, 3 on a platform mismatch;
+  * ``python -m benor_tpu watch`` tails mixed-kind JSON-lines files
+    (heartbeats + journal bucket records interleaved, unknown kinds
+    passed through raw, torn trailing lines skipped).
+"""
+
+import copy
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from benor_tpu.config import SimConfig
+from benor_tpu.ops import sampling
+from benor_tpu.sweep import run_curve_batched, run_points_batched
+from benor_tpu.sweepscope import (IncomparableSweep, build_sweep_manifest,
+                                  bucket_fingerprint, compare_sweep,
+                                  ideal_pipeline_s, read_journal,
+                                  serial_s)
+from benor_tpu.sweepscope.gate import SweepFinding  # noqa: F401  (API)
+from benor_tpu.sweepscope.journal import BUCKET_KIND, DONE_KIND
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO, "SWEEP_BASELINE.json")
+GATE_TOOL = os.path.join(REPO, "tools", "check_sweep_regression.py")
+SCHEMA_TOOL = os.path.join(REPO, "tools", "check_metrics_schema.py")
+
+#: Mixed-bucket geometry: two CF-regime points share a dyn bucket
+#: (quorum > EXACT_TABLE_MAX), one exact-table point gets a static
+#: bucket — the smallest sweep exercising BOTH bucket kinds.
+CF_N = 9000
+EXACT_F = CF_N - sampling.EXACT_TABLE_MAX + 500
+MIXED_FS = [600, 1200, EXACT_F]
+
+
+def _cfg(seed=3, **kw):
+    base = dict(n_nodes=CF_N, n_faulty=0, trials=4, delivery="quorum",
+                scheduler="uniform", path="histogram", max_rounds=12,
+                seed=seed)
+    base.update(kw)
+    return SimConfig(**base)
+
+
+def science(p):
+    return (p.rounds_executed, p.decided_frac, p.mean_k, p.ones_frac,
+            p.disagree_frac, tuple(p.k_hist.tolist()))
+
+
+def assert_bit_equal(pa, pb):
+    assert len(pa) == len(pb)
+    for a, b in zip(pa, pb):
+        assert science(a) == science(b), (a.n_faulty, b.n_faulty)
+
+
+@pytest.fixture(scope="module")
+def mixed_runs(tmp_path_factory):
+    """One mixed dyn+static curve run journal-off and journal-on (the
+    expensive compiles paid once for the whole module)."""
+    td = tmp_path_factory.mktemp("sweepscope")
+    jp = str(td / "journal.jsonl")
+    cfg = _cfg()
+    cb_off = run_curve_batched(cfg, MIXED_FS)
+    cb_on = run_curve_batched(cfg, MIXED_FS, journal_path=jp)
+    return cfg, jp, cb_off, cb_on
+
+
+# --------------------------------------------------------------------------
+# house rule: journal off/on bit-identical, across dyn AND static buckets
+# --------------------------------------------------------------------------
+
+
+def test_journal_off_on_bit_identical_and_compile_parity(mixed_runs):
+    cfg, jp, cb_off, cb_on = mixed_runs
+    assert set(cb_off.bucket_kinds) == {"dyn", "static"}
+    assert_bit_equal(cb_off.points, cb_on.points)
+    assert cb_off.compile_count == cb_on.compile_count == 2
+    recs = read_journal(jp)
+    kinds = [r["kind"] for r in recs]
+    assert kinds == [BUCKET_KIND, BUCKET_KIND, DONE_KIND]
+    for rec in recs[:2]:
+        assert rec["fingerprint"].startswith("sha256:")
+        assert rec["compile_count"] == 1
+        assert len(rec["points"]) == len(rec["point_indices"])
+        for stage in ("prepare_s", "compile_s", "run_s", "fetch_s"):
+            assert rec[stage] >= 0.0
+    assert recs[2]["done"] is True
+
+
+def test_batched_curve_stage_attribution(mixed_runs):
+    cfg, jp, cb, _ = mixed_runs
+    n = cb.n_buckets
+    for lst in (cb.bucket_prepare_s, cb.bucket_compile_s,
+                cb.bucket_run_s, cb.bucket_fetch_s, cb.bucket_kinds,
+                cb.bucket_point_indices, cb.bucket_compile_counts,
+                cb.bucket_reused):
+        assert len(lst) == n
+    # the legacy aggregates are exactly the per-bucket sums
+    assert abs(cb.compile_s - sum(cb.bucket_compile_s)) < 1e-6
+    assert abs(cb.run_s - (sum(cb.bucket_run_s)
+                           + sum(cb.bucket_fetch_s))) < 1e-6
+    assert cb.compile_count == sum(cb.bucket_compile_counts)
+    # indices partition the input order
+    flat = sorted(i for idx in cb.bucket_point_indices for i in idx)
+    assert flat == list(range(len(cb.points)))
+    # the wall clock bounds the stage sums; headroom is non-negative
+    stage_sum = (sum(cb.bucket_prepare_s) + sum(cb.bucket_compile_s)
+                 + sum(cb.bucket_run_s) + sum(cb.bucket_fetch_s))
+    assert cb.wall_s >= stage_sum - 1e-3
+    assert cb.overlap_headroom_s >= 0.0
+    # seconds stays the amortized bucket share (compat satellite)
+    for bi, idx in enumerate(cb.bucket_point_indices):
+        share = (cb.bucket_run_s[bi] + cb.bucket_fetch_s[bi]) / len(idx)
+        for i in idx:
+            assert cb.points[i].seconds == pytest.approx(share)
+
+
+def test_verbose_prints_max_bucket_share(mixed_runs, capsys, tmp_path):
+    cfg, jp, cb_off, _ = mixed_runs
+    # a zero-compile verbose resume is the cheap way to see the line
+    cb = run_curve_batched(cfg, MIXED_FS, journal_path=jp, resume=True,
+                           verbose=True)
+    out = capsys.readouterr().out
+    assert "max bucket share" in out
+    assert "overlap headroom" in out
+    assert "journal-restored" in out
+    assert_bit_equal(cb_off.points, cb.points)
+
+
+# --------------------------------------------------------------------------
+# resume: bit-equality + exact compile accounting + tamper matrix
+# --------------------------------------------------------------------------
+
+
+def test_resume_full_journal_zero_compiles_bit_equal(mixed_runs):
+    cfg, jp, cb_off, _ = mixed_runs
+    cb = run_curve_batched(cfg, MIXED_FS, journal_path=jp, resume=True)
+    assert cb.compile_count == 0
+    assert cb.bucket_reused == [True, True]
+    assert cb.bucket_compile_counts == [0, 0]
+    assert_bit_equal(cb_off.points, cb.points)
+    # the journaled stage clocks survive the resume (attribution)
+    assert all(c > 0 for c in cb.bucket_compile_s)
+
+
+def test_resume_requires_journal_path():
+    with pytest.raises(ValueError, match="journal_path"):
+        run_points_batched(_cfg(), [_cfg(n_faulty=600)], resume=True)
+
+
+def test_fresh_run_truncates_stale_journal(mixed_runs, tmp_path):
+    cfg, jp, cb_off, _ = mixed_runs
+    stale = tmp_path / "stale.jsonl"
+    stale.write_text('{"kind": "sweep_bucket", "bucket_index": 99}\n')
+    # journal-on WITHOUT resume: the stale content must not survive
+    cb = run_curve_batched(cfg, MIXED_FS, journal_path=str(stale))
+    recs = read_journal(str(stale))
+    assert [r["kind"] for r in recs] == [BUCKET_KIND, BUCKET_KIND,
+                                         DONE_KIND]
+    assert all(r.get("bucket_index") != 99 for r in recs)
+    assert_bit_equal(cb_off.points, cb.points)
+
+
+def _tamper(jp, tmp_path, mode):
+    """Copy the journal and tamper ONE bucket record; returns the path
+    and the index of the tampered bucket."""
+    with open(jp) as fh:
+        lines = [ln for ln in fh.read().splitlines() if ln.strip()]
+    # lines: bucket 0, bucket 1, done
+    target = 1                       # the static single-point bucket
+    if mode == "fingerprint":
+        rec = json.loads(lines[target])
+        rec["fingerprint"] = "sha256:" + "0" * 64
+        lines[target] = json.dumps(rec)
+    elif mode == "truncated":
+        lines[target] = lines[target][:len(lines[target]) // 2]
+    elif mode == "reordered":
+        rec = json.loads(lines[0])   # the 2-point dyn bucket
+        rec["point_indices"] = list(reversed(rec["point_indices"]))
+        lines[0] = json.dumps(rec)
+        target = 0
+    elif mode == "short_payload":
+        rec = json.loads(lines[0])
+        rec["points"] = rec["points"][:1]
+        lines[0] = json.dumps(rec)
+        target = 0
+    elif mode == "payload_value":
+        # an edited science value: indices + fingerprint untouched, so
+        # only the payload digest can catch it
+        rec = json.loads(lines[target])
+        rec["points"][0]["mean_k"] = 99.0
+        lines[target] = json.dumps(rec)
+    elif mode == "payload_key":
+        # a renamed payload key: must rerun, not crash the resume
+        rec = json.loads(lines[target])
+        rec["points"][0]["mean_kk"] = rec["points"][0].pop("mean_k")
+        lines[target] = json.dumps(rec)
+    out = tmp_path / f"tampered_{mode}.jsonl"
+    out.write_text("\n".join(lines) + "\n")
+    return str(out), target
+
+
+@pytest.mark.parametrize("mode", ["fingerprint", "truncated",
+                                  "reordered", "short_payload",
+                                  "payload_value", "payload_key"])
+def test_tampered_journal_reruns_never_reuses(mixed_runs, tmp_path,
+                                              mode):
+    cfg, jp, cb_off, _ = mixed_runs
+    tp, target = _tamper(jp, tmp_path, mode)
+    cb = run_curve_batched(cfg, MIXED_FS, journal_path=tp, resume=True)
+    # exactly the tampered bucket reruns; the untouched one restores
+    assert cb.compile_count == 1
+    assert sum(cb.bucket_reused) == cb.n_buckets - 1
+    assert cb.bucket_reused[target] is False
+    # and the rerun is still bit-equal to the uninterrupted oracle
+    assert_bit_equal(cb_off.points, cb.points)
+
+
+def test_partial_journal_reruns_only_missing(mixed_runs, tmp_path):
+    cfg, jp, cb_off, _ = mixed_runs
+    with open(jp) as fh:
+        lines = [ln for ln in fh.read().splitlines() if ln.strip()]
+    partial = tmp_path / "partial.jsonl"
+    partial.write_text(lines[0] + "\n")      # only bucket 0 completed
+    cb = run_curve_batched(cfg, MIXED_FS, journal_path=str(partial),
+                           resume=True)
+    assert cb.compile_count == 1
+    assert cb.bucket_reused == [True, False]
+    assert_bit_equal(cb_off.points, cb.points)
+    # the rerun bucket appended its fresh record + a done record
+    kinds = [r["kind"] for r in read_journal(str(partial))]
+    assert kinds == [BUCKET_KIND, BUCKET_KIND, DONE_KIND]
+
+
+def test_fingerprint_covers_every_input():
+    from benor_tpu.state import FaultSpec
+    from benor_tpu.sweep import default_crash_faults, random_inputs
+    cfg = _cfg(n_faulty=600)
+    iv = random_inputs(cfg.seed, cfg.trials, cfg.n_nodes)
+    fl = default_crash_faults(cfg)
+    fp = bucket_fingerprint([cfg], iv, [fl])
+    assert fp == bucket_fingerprint([cfg], iv, [fl])      # deterministic
+    assert fp != bucket_fingerprint([cfg.replace(seed=4)], iv, [fl])
+    iv2 = iv.copy()
+    iv2[0, 0] ^= 1
+    assert fp != bucket_fingerprint([cfg], iv2, [fl])
+    assert fp != bucket_fingerprint(
+        [cfg], iv, [FaultSpec.none(cfg.trials, cfg.n_nodes)])
+
+
+# --------------------------------------------------------------------------
+# SIGKILL forensics: preemption mid-bucket, resume bit-equal
+# --------------------------------------------------------------------------
+
+
+_CHILD_SRC = """\
+import os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, sys.argv[2])
+from benor_tpu.config import SimConfig
+from benor_tpu.sweep import default_crash_faults, run_points_batched
+
+base = SimConfig(n_nodes=64, n_faulty=0, trials=8, delivery="quorum",
+                 scheduler="uniform", path="histogram", max_rounds=8,
+                 seed=5)
+cfgs = [base.replace(n_faulty=f) for f in (8, 12, 16)]
+
+
+def slow_faults(c):
+    # widen the kill window: the parent SIGKILLs while a later bucket
+    # is mid-prepare (the fault masks themselves are identical to the
+    # default policy, so the fingerprints match the parent's resume)
+    time.sleep(1.0)
+    return default_crash_faults(c)
+
+
+run_points_batched(base, cfgs, faults_for=slow_faults,
+                   journal_path=sys.argv[1])
+"""
+
+
+def test_sigkill_mid_sweep_resumes_bit_equal(tmp_path):
+    """The preemption-forensics acceptance: SIGKILL a journaled sweep
+    mid-bucket, resume, pin bit-equality vs the uninterrupted oracle
+    AND exactly n_remaining_buckets compiles."""
+    jp = str(tmp_path / "kill_journal.jsonl")
+    script = tmp_path / "child.py"
+    script.write_text(_CHILD_SRC)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen([sys.executable, str(script), jp, REPO],
+                            env=env, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                break
+            done = [r for r in read_journal(jp)
+                    if r.get("kind") == BUCKET_KIND]
+            if done:
+                break
+            time.sleep(0.05)
+        assert proc.poll() is None, \
+            "child exited before the kill — the sweep ran to completion"
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    recs = [r for r in read_journal(jp) if r.get("kind") == BUCKET_KIND]
+    n_done = len(recs)
+    assert 1 <= n_done < 3, n_done
+
+    base = SimConfig(n_nodes=64, n_faulty=0, trials=8,
+                     delivery="quorum", scheduler="uniform",
+                     path="histogram", max_rounds=8, seed=5)
+    cfgs = [base.replace(n_faulty=f) for f in (8, 12, 16)]
+    oracle = run_points_batched(base, cfgs)
+    resumed = run_points_batched(base, cfgs, journal_path=jp,
+                                 resume=True)
+    assert resumed.compile_count == 3 - n_done
+    assert sum(resumed.bucket_reused) == n_done
+    assert_bit_equal(oracle.points, resumed.points)
+
+
+# --------------------------------------------------------------------------
+# span tracing: off/on bit-identity, nesting, flow links
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture
+def span_log():
+    from benor_tpu.utils.metrics import SPANS
+    SPANS.clear()
+    SPANS.enable()
+    yield SPANS
+    SPANS.disable()
+    SPANS.clear()
+
+
+def test_tracing_off_on_bit_identical_with_nested_flow_spans(
+        span_log, tmp_path):
+    base = SimConfig(n_nodes=64, n_faulty=0, trials=8,
+                     delivery="quorum", scheduler="uniform",
+                     path="histogram", max_rounds=8, seed=7)
+    fs = [8, 12]
+    span_log.disable()
+    cb_off = run_curve_batched(base, fs)
+    span_log.enable()
+    cb_on = run_curve_batched(base, fs)
+    assert_bit_equal(cb_off.points, cb_on.points)
+    assert cb_off.compile_count == cb_on.compile_count
+
+    spans = span_log.snapshot()
+    buckets = [s for s in spans if s.name.startswith("sweep.bucket[")]
+    points = [s for s in spans if s.name.startswith("sweep.point[")]
+    assert len(buckets) == cb_on.n_buckets
+    assert len(points) == len(cb_on.points)
+    eps = 1e-3
+    all_point_flows = set()
+    for b in buckets:
+        children = [s for s in spans if s.parent_id == b.span_id]
+        assert [s.name for s in children] == [
+            "sweep.prepare", "sweep.compile", "sweep.execute",
+            "sweep.fetch"]
+        for c in children:
+            assert c.start >= b.start - eps
+            assert c.start + c.dur_s <= b.start + b.dur_s + eps
+        # lifecycle stages are consecutive, in order
+        for a, c in zip(children, children[1:]):
+            assert c.start >= a.start + a.dur_s - eps
+        assert len(b.flow_out) == b.args["size"]
+    for p in points:
+        assert p.track == "sweep.points"
+        assert len(p.flow_in) == 1
+        all_point_flows.add(p.flow_in[0])
+    # 1:1 flow resolution: every bucket-emitted flow id terminates at
+    # exactly one point span
+    emitted = {fid for b in buckets for fid in b.flow_out}
+    assert emitted == all_point_flows
+    assert len(all_point_flows) == len(points)
+
+    # the Perfetto export renders the arrows as s/f pairs
+    from benor_tpu.utils.metrics import export_chrome_trace
+    out = tmp_path / "sweep_trace.json"
+    export_chrome_trace(str(out), spans=True)
+    events = json.load(open(out))["traceEvents"]
+    flows_s = [e for e in events if e.get("ph") == "s"]
+    flows_f = [e for e in events if e.get("ph") == "f"]
+    assert {e["id"] for e in flows_s} == emitted
+    assert {e["id"] for e in flows_f} == emitted
+
+
+# --------------------------------------------------------------------------
+# manifest: schema + cross-field pins, pipeline model, builder guards
+# --------------------------------------------------------------------------
+
+
+def _load_schema_tool():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("_cms", SCHEMA_TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_pipeline_model_bounds():
+    one = [{"prepare_s": 0.1, "compile_s": 2.0, "run_s": 1.0,
+            "fetch_s": 0.2}]
+    # a single bucket cannot overlap with itself
+    assert ideal_pipeline_s(one) == pytest.approx(serial_s(one))
+    two = one + [{"prepare_s": 0.1, "compile_s": 2.0, "run_s": 3.0,
+                  "fetch_s": 0.2}]
+    ideal = ideal_pipeline_s(two)
+    assert ideal < serial_s(two)
+    # bucket 2's prepare+compile (2.1s, host) overlaps bucket 1's
+    # execute+fetch (1.2s, device+drain): host finishes at 4.2, the
+    # device then runs bucket 2 for 3.0 and its fetch drains 0.2 ->
+    # ideal 7.4 of the 8.6 serial, headroom = the hidden 1.2
+    assert ideal == pytest.approx(4.2 + 3.0 + 0.2)
+    assert serial_s(two) - ideal == pytest.approx(1.2)
+
+
+def test_manifest_schema_valid_and_cross_field(mixed_runs):
+    cfg, jp, cb, _ = mixed_runs
+    tool = _load_schema_tool()
+    manifest = build_sweep_manifest(cb, cfg)
+    assert tool.check_sweep_manifest(manifest) == []
+
+    # hand-edited headroom cannot survive the recompute
+    bad = copy.deepcopy(manifest)
+    bad["overlap_headroom_s"] = bad["overlap_headroom_s"] + 1.0
+    assert any("overlap_headroom_s" in e
+               for e in tool.check_sweep_manifest(bad))
+    # neither can a drifted stage total
+    bad = copy.deepcopy(manifest)
+    bad["stage_totals"]["compile_s"] += 1.0
+    assert any("stage_totals.compile_s" in e
+               for e in tool.check_sweep_manifest(bad))
+    # point indices must partition the point set
+    bad = copy.deepcopy(manifest)
+    bad["buckets"][1]["point_indices"] = list(
+        bad["buckets"][0]["point_indices"])
+    bad["buckets"][1]["size"] = len(bad["buckets"][1]["point_indices"])
+    assert any("partition" in e for e in tool.check_sweep_manifest(bad))
+    # compile_count must sum the bucket counts
+    bad = copy.deepcopy(manifest)
+    bad["compile_count"] += 1
+    assert any("compile_count" in e
+               for e in tool.check_sweep_manifest(bad))
+    # telescoping coverage is recomputed, not trusted
+    bad = copy.deepcopy(manifest)
+    bad["telescoping"]["coverage"] = 0.2
+    assert any("coverage" in e for e in tool.check_sweep_manifest(bad))
+
+
+def test_manifest_builder_refuses_resumed_curve(mixed_runs):
+    cfg, jp, cb_off, _ = mixed_runs
+    cb = run_curve_batched(cfg, MIXED_FS, journal_path=jp, resume=True)
+    with pytest.raises(ValueError, match="resumed"):
+        build_sweep_manifest(cb, cfg)
+
+
+def test_committed_baseline_schema_autodetect_and_self_gate():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, SCHEMA_TOOL, BASELINE],
+                          capture_output=True, text=True, env=env,
+                          timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "sweep manifest OK" in proc.stdout
+    proc = subprocess.run([sys.executable, GATE_TOOL, BASELINE],
+                          capture_output=True, text=True, env=env,
+                          timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# --------------------------------------------------------------------------
+# gate: exit codes + finding semantics
+# --------------------------------------------------------------------------
+
+
+def _baseline():
+    with open(BASELINE) as fh:
+        return json.load(fh)
+
+
+def test_gate_in_band_on_identical_manifests():
+    m = _baseline()
+    assert compare_sweep(m, m) == []
+
+
+def test_gate_flags_serialized_pipeline_regression():
+    m = _baseline()
+    bad = copy.deepcopy(m)
+    bad["overlap_headroom_frac"] = 0.6
+    findings = compare_sweep(bad, m)
+    assert any("serialized-pipeline" in f.message for f in findings)
+
+
+def test_gate_flags_vanished_headroom_and_compile_creep():
+    m = _baseline()
+    bad = copy.deepcopy(m)
+    del bad["overlap_headroom_frac"]
+    bad["compile_count"] = m["compile_count"] + 3
+    metrics = {f.metric for f in compare_sweep(bad, m)}
+    assert "overlap_headroom_frac" in metrics
+    assert "compile_count" in metrics
+
+
+def test_gate_flags_broken_telescoping():
+    m = _baseline()
+    bad = copy.deepcopy(m)
+    bad["telescoping"]["coverage"] = 0.3
+    assert any(f.metric == "telescoping.coverage"
+               for f in compare_sweep(bad, m))
+
+
+def test_gate_incomparable_on_platform_and_scale():
+    m = _baseline()
+    other = copy.deepcopy(m)
+    other["platform"] = "definitely-not-" + str(m["platform"])
+    with pytest.raises(IncomparableSweep, match="platform"):
+        compare_sweep(other, m)
+    other = copy.deepcopy(m)
+    other["scale"] = dict(other["scale"], n_nodes=123)
+    with pytest.raises(IncomparableSweep, match="scale"):
+        compare_sweep(other, m)
+
+
+def test_gate_cli_exit_codes(tmp_path):
+    """The CI contract end-to-end: 0 in-band, 2 on the injected
+    serialized-pipeline regression fixture, 3 on platform mismatch."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    m = _baseline()
+
+    regressed = copy.deepcopy(m)
+    regressed["overlap_headroom_frac"] = 0.6
+    rp = tmp_path / "regressed.json"
+    rp.write_text(json.dumps(regressed))
+    proc = subprocess.run([sys.executable, GATE_TOOL, str(rp), BASELINE],
+                          capture_output=True, text=True, env=env,
+                          timeout=60)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "serialized-pipeline" in proc.stdout
+
+    foreign = copy.deepcopy(m)
+    foreign["platform"] = "tpu-from-another-lab"
+    fp = tmp_path / "foreign.json"
+    fp.write_text(json.dumps(foreign))
+    proc = subprocess.run([sys.executable, GATE_TOOL, str(fp), BASELINE],
+                          capture_output=True, text=True, env=env,
+                          timeout=60)
+    assert proc.returncode == 3, proc.stdout + proc.stderr
+
+    missing = subprocess.run(
+        [sys.executable, GATE_TOOL, str(rp),
+         str(tmp_path / "nope.json"), "--strict"],
+        capture_output=True, text=True, env=env, timeout=60)
+    assert missing.returncode == 3
+
+
+# --------------------------------------------------------------------------
+# watch: mixed-kind tailing
+# --------------------------------------------------------------------------
+
+
+def test_watch_renders_mixed_kinds_and_survives_torn_tail(tmp_path,
+                                                          capsys):
+    from benor_tpu.__main__ import main
+    p = tmp_path / "mixed.jsonl"
+    lines = [
+        json.dumps({"kind": "heartbeat", "label": "sweep",
+                    "round": None, "max_rounds": 8,
+                    "rounds_per_sec": None, "decided_frac": None,
+                    "eta_s": None, "progress": 0.5, "points_done": 1,
+                    "points_total": 3, "elapsed_s": 0.1,
+                    "done": False}),
+        json.dumps({"kind": "sweep_bucket", "label": "sweep",
+                    "bucket_index": 0, "bucket_kind": "dyn",
+                    "point_indices": [0, 1, 2],
+                    "fingerprint": "sha256:x", "compile_count": 1,
+                    "prepare_s": 0.1, "compile_s": 2.0, "run_s": 0.3,
+                    "fetch_s": 0.01, "points": []}),
+        json.dumps({"kind": "mystery_kind", "payload": 7}),
+        json.dumps([1, 2, 3]),
+    ]
+    p.write_text("\n".join(lines) + "\n" + '{"kind": "sweep_bu')
+    assert main(["watch", str(p), "--no-follow"]) == 0
+    out_lines = capsys.readouterr().out.strip().splitlines()
+    assert len(out_lines) == 4          # the torn tail line is skipped
+    assert "points=1/3" in out_lines[0]
+    assert "bucket 0 (dyn, 3 pts)" in out_lines[1]
+    assert "compile=2.00s" in out_lines[1]
+    assert "mystery_kind" in out_lines[2]      # unknown kind: raw
+    assert out_lines[3] == "[1, 2, 3]"         # non-dict JSON: raw
+
+
+def test_watch_stops_on_sweep_done(tmp_path, capsys):
+    from benor_tpu.__main__ import main
+    p = tmp_path / "journal.jsonl"
+    lines = [
+        json.dumps({"kind": "sweep_bucket", "label": "sweep",
+                    "bucket_index": 0, "bucket_kind": "static",
+                    "point_indices": [0], "fingerprint": "sha256:x",
+                    "compile_count": 1, "prepare_s": 0.0,
+                    "compile_s": 1.0, "run_s": 0.1, "fetch_s": 0.0,
+                    "points": []}),
+        json.dumps({"kind": "sweep_done", "label": "sweep",
+                    "done": True, "points_total": 1, "n_buckets": 1,
+                    "buckets_reused": 0, "overlap_headroom_s": 0.0}),
+        json.dumps({"kind": "heartbeat", "label": "after",
+                    "done": False}),
+    ]
+    p.write_text("\n".join(lines) + "\n")
+    # --timeout large: the done record must be what stops the tail
+    assert main(["watch", str(p), "--timeout", "30"]) == 0
+    out = capsys.readouterr().out
+    assert "sweep complete: 1 points / 1 buckets" in out
+    assert "DONE" in out
+    assert "[after]" not in out        # tail stopped AT the done record
