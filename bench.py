@@ -85,7 +85,8 @@ _DETAIL_KEYS = ("curve", "pallas_check", "pallas_hist_check",
                 "pallas_equiv_check", "pallas_weak_coin_check",
                 "pallas_round_check", "pallas_demoted",
                 "batched_sweep_check", "flight_recorder", "perfscope",
-                "meshscope", "serve", "topo", "sweepscope", "lint")
+                "meshscope", "serve", "topo", "sweepscope",
+                "kernelscope", "lint")
 
 
 def _split_headline(out: dict) -> tuple[dict, dict]:
@@ -159,6 +160,14 @@ def _split_headline(out: dict) -> tuple[dict, dict]:
         # SWEEP_BASELINE.json when comparable; the manifest lives in
         # the sidecar's sweepscope blob
         head["sweep_obs_ok"] = bool(sw.get("ok"))
+    ks = out.get("kernelscope")
+    if isinstance(ks, dict):
+        # ONE compact bool: telemetry off/on bit-identical in results +
+        # compile counts, kernel manifest schema-valid with the
+        # predicted/measured byte telescoping present, and in-band vs
+        # KERNEL_BASELINE.json when comparable; the per-stage/per-tile
+        # attribution lives in the sidecar's kernelscope blob
+        head["kernel_obs_ok"] = bool(ks.get("ok"))
     tp = out.get("topo")
     if isinstance(tp, dict):
         # ONE compact bool: topology='complete' bit-identical (results +
@@ -1137,6 +1146,18 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
         f"committee_rows={len(topo_check.get('committee_curve', []))} "
         f"committee_compiles={topo_check.get('committee_compile_count')} "
         f"audit_ok={topo_check.get('audit_ok')}")
+    try:
+        kernelscope_check = _kernelscope_check()
+    except Exception as e:  # noqa: BLE001 — accounting must not kill the run
+        kernelscope_check = {"ok": False,
+                             "error": f"{type(e).__name__}: {e}"}
+    km = kernelscope_check.get("manifest", {})
+    log(f"bench: kernelscope check ok={kernelscope_check.get('ok')} "
+        f"kernels={sorted(km.get('kernels', {}))} "
+        f"bit_equal={kernelscope_check.get('bit_equal_off_on')} "
+        f"compile_parity={kernelscope_check.get('compile_parity')} "
+        f"baseline_comparable="
+        f"{kernelscope_check.get('baseline_comparable')}")
 
     total_trials = trials * len(regimes)
     log(f"bench: sweep elapsed {elapsed:.2f}s for {total_trials} trials; "
@@ -1194,6 +1215,7 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
         "serve": serve_check,
         "topo": topo_check,
         "sweepscope": sweepscope_check,
+        "kernelscope": kernelscope_check,
         "pallas_demoted": demoted,
     }
 
@@ -1518,8 +1540,21 @@ def _topo_check(seed: int) -> dict:
           and report.ok and len(curves["degree_curve"]) > 0
           and len(curves["committee_curve"]) > 0
           and curves["committee_compile_count"] == 1)
+    # the sim.demotion.* counter family (PR 14): how many DEMOTED
+    # EXECUTABLE BUILDS this process traced (the announcers live inside
+    # jitted bodies, so a warm jit cache does not re-tick) — the
+    # structured topo demotion's one-shot warning made visible to
+    # tooling; counters are process-wide, so this is the whole bench
+    # run's tally
+    from benor_tpu.utils.metrics import REGISTRY
+    demotions = {
+        "structured": int(REGISTRY.counter(
+            "sim.demotion.structured").value),
+        "debug": int(REGISTRY.counter("sim.demotion.debug").value),
+    }
     return {"ok": bool(ok), "n": n_topo, "trials": trials,
             "complete_identity": identity, **curves,
+            "demotions": demotions,
             "audit_ok": bool(report.ok),
             "audit_checks": sum(report.checks.values()),
             "audit_violations": len(report.violations)}
@@ -1616,6 +1651,91 @@ def _sweepscope_check() -> dict:
     blob["ok"] = (not schema_errors and bit_equal and compile_parity
                   and resume_bit_equal and cb_res.compile_count == 0
                   and headroom_present and not regressions)
+    return blob
+
+
+def _kernelscope_check() -> dict:
+    """The pallas kernel interior's observability acceptance (PR 14,
+    benor_tpu/kernelscope) at the fixed CPU-safe capture scale the
+    committed KERNEL_BASELINE.json was taken at (both fused dispatches:
+    the single-pass kernel + the two-kernel plane pipeline):
+
+      * telemetry OFF vs ON must be bit-identical in the science fields
+        (recorded per kernel by the capture) AND cost the same NUMBER
+        of backend compiles — the house rule, measured here with the
+        jax.monitoring hook on fresh seeds so the jit cache cannot
+        fake it;
+      * the ``kind: kernel_manifest`` document must be schema-valid
+        (tools/kernel_manifest_schema.json via the file-path-loaded
+        checker — cross-field recomputation of pad waste, predicted
+        bytes and the byte ratio included) with the predicted-vs-
+        measured byte telescoping PRESENT for every kernel;
+      * the same gate CI runs (kernelscope/gate.compare_kernels behind
+        tools/check_kernel_regression.py) must be in-band vs the
+        committed KERNEL_BASELINE.json when comparable (an accelerator
+        capture vs the CPU baseline is honestly reported incomparable,
+        not silently passed).
+    """
+    import importlib.util
+
+    from benor_tpu.kernelscope import (IncomparableKernels,
+                                       capture_kernels, compare_kernels,
+                                       load_kernel_manifest)
+    from benor_tpu.kernelscope.capture import _inputs, _two_kernel_cfg
+    from benor_tpu.utils.compile_counter import count_backend_compiles
+
+    manifest = capture_kernels()
+    spec = importlib.util.spec_from_file_location(
+        "_check_metrics_schema",
+        os.path.join(HERE, "tools", "check_metrics_schema.py"))
+    cms = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cms)
+    schema_errors = cms.check_kernel_manifest(manifest)
+    bit_equal = all(k.get("bit_equal_off_on")
+                    for k in manifest["kernels"].values())
+    telescoping = all(k.get("byte_ratio") is not None
+                      for k in manifest["kernels"].values()
+                      if k.get("measured_bytes_per_round"))
+
+    # compile-count parity, fresh seeds so the jit cache cannot hide a
+    # recompile (the same discipline as test_fused_compile_counts_*)
+    from benor_tpu.sim import run_consensus
+    counts = []
+    for telem, seed in ((False, 7101), (True, 7103)):
+        cfg = _two_kernel_cfg(256, 8, 12, seed,
+                              kernel_telemetry=telem)
+        state, faults, key = _inputs(cfg)
+        with count_backend_compiles() as cc:
+            out = run_consensus(cfg, state, faults, key)
+            int(out[0])
+        counts.append(cc.count)
+    compile_parity = counts[0] == counts[1]
+
+    blob = {
+        "manifest": manifest,
+        "schema_errors": schema_errors,
+        "bit_equal_off_on": bool(bit_equal),
+        "compile_parity": bool(compile_parity),
+        "compile_counts_off_on": counts,
+        "telescoping_present": bool(telescoping),
+    }
+    regressions = []
+    comparable = None
+    baseline_path = os.path.join(HERE, "KERNEL_BASELINE.json")
+    if os.path.exists(baseline_path):
+        try:
+            regressions = [f.to_dict() for f in compare_kernels(
+                manifest, load_kernel_manifest(baseline_path))]
+            comparable = True
+        except (IncomparableKernels, ValueError) as e:
+            comparable = False
+            blob["baseline_note"] = f"{e}"
+    else:
+        blob["baseline_note"] = "no committed KERNEL_BASELINE.json"
+    blob["baseline_comparable"] = comparable
+    blob["regressions"] = regressions
+    blob["ok"] = (not schema_errors and bit_equal and compile_parity
+                  and telescoping and not regressions)
     return blob
 
 

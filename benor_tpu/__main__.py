@@ -507,6 +507,85 @@ def _repo_root() -> str:
     return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _profile_kernels(args) -> int:
+    """kernelscope capture (`profile --kernels`): arm the in-kernel
+    stage counters on both fused dispatches (the single-pass kernel and
+    the two-kernel plane pipeline), assemble the per-stage/per-tile
+    attribution report with the layout-derived predicted bytes
+    telescoped against the executables' cost model, emit the
+    pinned-schema ``kind: kernel_manifest`` and gate it against the
+    committed KERNEL_BASELINE.json (tools/check_kernel_regression.py's
+    exact comparator): exit 2 on a kernel-plane regression, 3 never
+    (an incomparable baseline is reported and skipped, like the perf
+    gate), 0 otherwise."""
+    from .kernelscope import (IncomparableKernels, capture_kernels,
+                              compare_kernels, load_kernel_manifest,
+                              save_kernel_manifest)
+
+    manifest = capture_kernels(n_nodes=args.n, trials=args.trials,
+                               max_rounds=args.max_rounds,
+                               seed=args.seed,
+                               telemetry_path=args.telemetry_out)
+    fb = " [cpu fallback]" if FELL_BACK else ""
+    if args.format == "json":
+        print(json.dumps(manifest, indent=1))
+    else:
+        sc = manifest["scale"]
+        mode = "interpret" if manifest["interpret"] else "compiled"
+        print(f"kernelscope: {manifest['platform']} "
+              f"({manifest['device_kind']}, {mode}), scale "
+              f"N={sc['n_nodes']} T={sc['trials']} "
+              f"R<={sc['max_rounds']} seed={sc['seed']}{fb}")
+        for name, rep in manifest["kernels"].items():
+            pred = rep["predicted_bytes_per_round"]
+            print(f"  {name} [{rep['dispatch']}/{rep['counts_mode']}]: "
+                  f"rounds={rep['rounds_executed']} "
+                  f"pad_waste={rep['pad_waste_frac']} "
+                  f"hops/round={rep['plane_hops_per_round']} "
+                  f"predicted={pred['total']}B/round "
+                  f"measured={rep['measured_bytes_per_round']} "
+                  f"ratio={rep['byte_ratio']} "
+                  f"bit_equal={rep['bit_equal_off_on']}")
+            for stage, blk in rep["stages"].items():
+                print(f"    {stage}: {blk['counters']}")
+        fvx = manifest.get("fused_vs_xla")
+        if fvx:
+            print(f"  fused_vs_xla: gap={fvx['gap_bytes']}B "
+                  f"(fused {fvx['fused_run_bytes']} vs xla "
+                  f"{fvx['xla_run_bytes']}), stage shares "
+                  f"{fvx['stage_attribution']}, "
+                  f"bit_equal={fvx['bit_equal']}")
+    if args.profile_out:
+        save_kernel_manifest(args.profile_out, manifest)
+        print(f"wrote kernel manifest to {args.profile_out}",
+              file=sys.stderr)
+    _export_metrics(args.metrics_out)
+
+    baseline_path = args.baseline or os.path.join(_repo_root(),
+                                                  "KERNEL_BASELINE.json")
+    if args.update_baseline:
+        save_kernel_manifest(baseline_path, manifest)
+        print(f"re-baselined {baseline_path}", file=sys.stderr)
+        return 0
+    if not os.path.exists(baseline_path):
+        print(f"no baseline at {baseline_path} — capture-only run "
+              f"(--update-baseline to create one)", file=sys.stderr)
+        return 0
+    try:
+        findings = compare_kernels(manifest,
+                                   load_kernel_manifest(baseline_path))
+    except (IncomparableKernels, ValueError) as e:
+        print(f"baseline {baseline_path} not comparable: {e}",
+              file=sys.stderr)
+        return 0
+    for f in findings:
+        print(f"REGRESSION [{f.kind}]: {f.message}", file=sys.stderr)
+    if findings:
+        return 2
+    print(f"kernel gate: in-band vs {baseline_path}", file=sys.stderr)
+    return 0
+
+
 def _profile(args) -> int:
     """AOT cost/memory observatory (benor_tpu/perfscope): stage-timed
     capture of the five compiled regimes — trace/lower, backend compile,
@@ -517,6 +596,8 @@ def _profile(args) -> int:
     (--trace-dir, with the metrics registry's counter tracks exported
     next to it), and gates against a committed baseline: exit 2 on an
     out-of-band structural metric, 0 otherwise."""
+    if args.kernels:
+        return _profile_kernels(args)
     from .perfscope import (IncomparableManifests, build_manifest,
                             capture_all, compare_manifests, load_manifest,
                             missing_regimes, save_manifest)
@@ -835,6 +916,26 @@ def _format_sweep_bucket(rec) -> str:
     return " ".join(bits)
 
 
+def _format_kernel_telem(rec) -> str:
+    """One kernelscope telemetry record (kernelscope/report.py) as a
+    watch line: which kernel, its round count, the pad-waste fraction
+    and the per-stage counter totals — compact; the per-tile detail
+    lives in the kernel manifest."""
+    bits = [f"[{rec.get('label', 'kernelscope')}]",
+            f"kernel={rec.get('kernel')}",
+            f"rounds={rec.get('rounds')}"]
+    if rec.get("pad_waste_frac") is not None:
+        bits.append(f"pad_waste={rec['pad_waste_frac']:.3f}")
+    totals = rec.get("stage_totals") or {}
+    for stage in sorted(totals):
+        c = totals[stage]
+        bits.append(f"{stage}(hist={c.get('hist_visits')} "
+                    f"quorum={c.get('quorum_passes')} "
+                    f"coins={c.get('coin_draws')} "
+                    f"hops={c.get('plane_hops')})")
+    return " ".join(bits)
+
+
 def _format_sweep_done(rec) -> str:
     bits = [f"[{rec.get('label', 'sweep')}-journal]",
             f"sweep complete: {rec.get('points_total')} points / "
@@ -860,12 +961,14 @@ def _watch(args) -> int:
     (nothing to watch)."""
     import json as _json
 
+    from .kernelscope.report import KERNEL_TELEM_KIND
     from .meshscope.heartbeat import HEARTBEAT_KIND, tail_records
     from .sweepscope.journal import BUCKET_KIND, DONE_KIND
 
     formatters = {HEARTBEAT_KIND: _format_heartbeat,
                   BUCKET_KIND: _format_sweep_bucket,
-                  DONE_KIND: _format_sweep_done}
+                  DONE_KIND: _format_sweep_done,
+                  KERNEL_TELEM_KIND: _format_kernel_telem}
     seen = 0
     for rec in tail_records(args.path, poll_s=args.poll,
                             timeout_s=args.timeout,
@@ -1112,6 +1215,19 @@ def main(argv=None) -> int:
                     help="wrap the capture in a jax.profiler trace "
                          "(TensorBoard/Perfetto) and export the metrics "
                          "registry's counter tracks next to it")
+    pf.add_argument("--kernels", action="store_true",
+                    help="kernelscope capture instead of the perfscope "
+                         "regimes: in-kernel stage counters + "
+                         "layout-derived HBM traffic attribution for "
+                         "the fused pallas dispatches -> pinned-schema "
+                         "kind:kernel_manifest, gated against "
+                         "KERNEL_BASELINE.json (exit 2 on regression); "
+                         "--baseline/--update-baseline/--profile-out "
+                         "apply to the kernel manifest")
+    pf.add_argument("--telemetry-out", metavar="PATH", default=None,
+                    help="with --kernels: append live kind:"
+                         "kernel_telemetry JSON-lines records here "
+                         "(`python -m benor_tpu watch` renders them)")
     _add_obs_args(pf, record=False)
 
     sc = sub.add_parser("scale",

@@ -344,6 +344,124 @@ def check_pack_parity(project: Project) -> List[Finding]:
     return findings
 
 
+@rule("telem-layout", "layout",
+      "kernel telemetry columns must derive from the TELEM_COLS table "
+      "and fit the PARTIAL_COLS budget")
+def check_telem_layout(project: Project) -> List[Finding]:
+    """The PR-14 stage-counter block (SimConfig.kernel_telemetry) rides
+    the same per-tile partial buffers as the recorder and witness
+    blocks — the same silent-corruption surface, policed the same way:
+
+      * TELEM_COLS must exist as a pure-literal name -> (base, width)
+        table, overlap-free and dense from offset 0;
+      * the kernels' ONE emission site (``_telem_cols``) must key its
+        value dict on exactly the table's names — removing a column
+        from either side (including the last one, which density alone
+        cannot see) breaks the set equality;
+      * the worst-case column budget must still fit PARTIAL_COLS on
+        both kernels: base partials + recorder block + witness blocks
+        at WITNESS_MAX_NODES + the telemetry block;
+      * hand-numbered telemetry constants (a module-level ``*TELEM*``
+        name bound to an int literal) are a finding — indices derive
+        from the table (``_telem_base``/TELEM_WIDTH are computed, not
+        hand-counted), or the next rework silently lands two features
+        on one column.
+    """
+    findings: List[Finding] = []
+    src = project.source(KERNEL_FILE)
+    if src is None:
+        return findings
+    table, line, errs = _table(project, KERNEL_FILE, "TELEM_COLS",
+                               rule_name="telem-layout")
+    findings += errs
+    if table is None:
+        return findings
+    findings += _check_ranges(KERNEL_FILE, line, "TELEM_COLS",
+                              _by_base(table), 0,
+                              rule_name="telem-layout")
+
+    # 1. emission parity: the _telem_cols value-dict keys == the table
+    emit_keys = None
+    emit_line = line
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.FunctionDef) and \
+                node.name == "_telem_cols":
+            emit_line = node.lineno
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Dict) and all(
+                        isinstance(k, ast.Constant) and
+                        isinstance(k.value, str)
+                        for k in sub.keys if k is not None):
+                    keys = {k.value for k in sub.keys if k is not None}
+                    if emit_keys is None or len(keys) > len(emit_keys):
+                        emit_keys = keys
+            break
+    if emit_keys is None:
+        findings.append(Finding(
+            "telem-layout", KERNEL_FILE, emit_line, 0,
+            "_telem_cols (the one telemetry emission site) is missing "
+            "or no longer builds its columns from a name-keyed dict — "
+            "the table-to-kernel parity check has nothing to compare",
+            hint="keep _telem_cols' values in a dict literal keyed by "
+                 "TELEM_COLS names"))
+    elif emit_keys != set(table):
+        missing = sorted(set(table) - emit_keys)
+        extra = sorted(emit_keys - set(table))
+        findings.append(Finding(
+            "telem-layout", KERNEL_FILE, emit_line, 0,
+            f"TELEM_COLS and the _telem_cols emission dict disagree "
+            f"(declared but never emitted: {missing}; emitted but "
+            f"undeclared: {extra})",
+            hint="add/remove the column in BOTH the table and the "
+                 "emission dict"))
+
+    # 2. worst-case budget: every kernel's full column stack must fit
+    prop, _, _ = _table(project, KERNEL_FILE, "PROP_PARTIAL_LAYOUT")
+    vote, _, _ = _table(project, KERNEL_FILE, "VOTE_PARTIAL_LAYOUT")
+    vrec, _, _ = _table(project, KERNEL_FILE, "VOTE_RECORD_LAYOUT")
+    pf = literal_assign(src, "WITNESS_PROP_FIELDS")
+    vf = literal_assign(src, "WITNESS_VOTE_FIELDS")
+    pc = literal_assign(src, "PARTIAL_COLS")
+    csrc = project.source(CONFIG_FILE)
+    max_nodes = literal_assign(csrc, "WITNESS_MAX_NODES") \
+        if csrc is not None else None
+    if None not in (prop, vote, vrec, pf, vf, pc, max_nodes):
+        telem_w = max(b + w for b, w in table.values())
+
+        def extent(*tabs):
+            return max(b + w for t in tabs for b, w in t.values())
+
+        prop_need = extent(prop) + len(pf) * max_nodes + telem_w
+        vote_need = extent(vote, vrec) + len(vf) * max_nodes + telem_w
+        for label, need in (("proposal", prop_need), ("vote", vote_need)):
+            if need > pc:
+                findings.append(Finding(
+                    "telem-layout", KERNEL_FILE, line, 0,
+                    f"the {label} kernel needs {need} partial columns "
+                    f"with telemetry armed at WITNESS_MAX_NODES="
+                    f"{max_nodes} but PARTIAL_COLS is {pc}: the "
+                    f"TELEM_COLS block would run off the buffer",
+                    hint="shrink the telemetry block (or "
+                         "WITNESS_MAX_NODES) — or widen PARTIAL_COLS "
+                         "and re-check VMEM cost"))
+
+    # 3. no hand-numbered telemetry column constants
+    for node in src.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                "TELEM" in node.targets[0].id and \
+                isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, int):
+            findings.append(Finding(
+                "telem-layout", KERNEL_FILE, node.lineno, 0,
+                f"hand-numbered telemetry constant "
+                f"{node.targets[0].id} = {node.value.value}: telemetry "
+                f"column indices must derive from the TELEM_COLS table",
+                hint="derive the value from TELEM_COLS (see "
+                     "TELEM_WIDTH / _telem_base)"))
+    return findings
+
+
 @rule("layout-outspec", "layout",
       "kernel out_specs must be sized by PARTIAL_COLS, not a literal")
 def check_layout_outspec(project: Project) -> List[Finding]:

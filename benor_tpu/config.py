@@ -272,6 +272,29 @@ class SimConfig:
     # ``record``).  0 (default) = off.
     heartbeat_rounds: int = 0
 
+    # --- in-kernel stage counters (benor_tpu/kernelscope) ----------------
+    # kernel_telemetry=True arms the TILE-LEVEL observability plane of
+    # the fused pallas round (ops/pallas_round.py): every kernel stage
+    # (proposal pass, vote/commit pass) appends a block of telemetry
+    # COLUMNS — laid out by the declarative ops/pallas_round.TELEM_COLS
+    # name -> (base, width) table, the same discipline as REC_LAYOUT /
+    # WIT_LAYOUT / PACK_LAYOUT — to its existing [tiles, T, PARTIAL_COLS]
+    # per-tile partial buffer, counting per-tile/per-stage work: sampler
+    # lanes touched, histogram scatter visits, quorum-gate passes, coin
+    # draws, active vs pad lanes (the padding waste), and plane-stack HBM
+    # hops on the two-kernel path.  Functions whose docstrings say so
+    # return one extra int32 [stages, tiles, TELEM_WIDTH] accumulator
+    # (summed over rounds and trials) AFTER the recorder/witness tail;
+    # benor_tpu/kernelscope assembles it into the per-stage, per-tile
+    # attribution report behind `python -m benor_tpu profile --kernels`.
+    # Costs only extra partial COLUMNS inside buffers that already exist
+    # (zero extra HBM buffers); off (the default) leaves every executable
+    # bit-identical in results AND compile counts — the house rule,
+    # pinned by tests/test_kernelscope.py.  Inert (no extra output, no
+    # cost) on regimes that run no pallas round kernels: the XLA loop
+    # has no kernel interior to count.
+    kernel_telemetry: bool = False
+
     # --- witness traces (per-node forensics; see benor_tpu/audit.py) -----
     # witness_trials=(t0, t1, ...) + witness_nodes=k arm the WITNESS
     # recorder: a preallocated [max_rounds + 1, W, k, state.WIT_WIDTH]
@@ -474,6 +497,20 @@ class SimConfig:
             raise ValueError(
                 "witness_nodes requires witness_trials (which trials to "
                 "watch); set both or neither")
+        if self.kernel_telemetry:
+            if self.backend != "tpu":
+                raise ValueError(
+                    "kernel_telemetry counts work inside the tpu "
+                    "backend's pallas kernels; the event-loop oracles "
+                    "have no kernel interior to observe — a silent "
+                    "no-op would fake tile-level attribution, so use "
+                    "backend='tpu'")
+            if self.mesh_shape is not None:
+                raise ValueError(
+                    "kernel_telemetry is single-device: the per-tile "
+                    "accumulator is indexed by this device's tile grid "
+                    "and the sharded runners do not thread it; drop "
+                    "mesh_shape or kernel_telemetry")
         if self.record and self.backend != "tpu":
             raise ValueError(
                 "record=True fills the on-device flight recorder inside "

@@ -1,0 +1,51 @@
+"""kernelscope — tile-level observability for the pallas kernel interior.
+
+Every host-side plane is instrumented (perfscope, meshscope, servescope,
+sweepscope); the pallas kernel INTERIOR — the flagship fast path of the
+TPU-scale claim — was the last black box: perfscope reports
+whole-executable ``cost_analysis`` numbers, but nothing said which STAGE
+of which TILE burns the bytes.  kernelscope is that instrument:
+
+  * **In-kernel stage counters** (``SimConfig(kernel_telemetry=True)``):
+    the fused round kernels (ops/pallas_round.py) append a block of
+    telemetry columns — laid out by the declarative ``TELEM_COLS``
+    name -> (base, width) table, the same discipline as REC_LAYOUT /
+    WIT_LAYOUT / PACK_LAYOUT — to their existing per-tile partial
+    buffers, counting per-tile/per-stage work: sampler lanes touched,
+    histogram scatter visits, quorum-gate passes, coin draws, active vs
+    pad lanes (the padding waste), and plane-stack HBM hops.  Zero extra
+    HBM buffers; off (the default) is bit-identical in results AND
+    compile counts (tests/test_kernelscope.py).
+  * **Layout-derived traffic model** (perfscope/roofline.py
+    ``traffic_report``): predicted HBM bytes per kernel stage priced
+    straight from the PACK_LAYOUT / PARTIAL_COLS tables and grid
+    geometry, telescoped against the executable's ``cost_analysis``
+    ``bytes_accessed`` — "fused loses" becomes "fused loses because
+    stage X moves Y predicted-vs-measured bytes".
+  * **Manifest + gate**: ``python -m benor_tpu profile --kernels`` (and
+    bench.py's ``kernelscope`` blob / ``kernel_obs_ok`` headline bool)
+    emit the pinned-schema ``kind: kernel_manifest`` document
+    (tools/kernel_manifest_schema.json, cross-field-recomputed by
+    check_metrics_schema.check_kernel_manifest), gated against the
+    committed KERNEL_BASELINE.json by the stdlib-only
+    tools/check_kernel_regression.py (exit 0/2/3).
+
+``gate``/``manifest``/``report`` are stdlib-importable (the regression
+tool file-path-loads ``gate.py`` with no jax on its path); ``capture``
+pulls jax and is imported lazily.
+"""
+
+from .gate import (KernelFinding, IncomparableKernels,  # noqa: F401
+                   compare_kernels)
+from .manifest import (KERNEL_MANIFEST_KIND,  # noqa: F401
+                       build_kernel_manifest, load_kernel_manifest,
+                       save_kernel_manifest)
+from .report import (KERNEL_TELEM_KIND, pad_waste_frac,  # noqa: F401
+                     stage_report)
+
+
+def capture_kernels(**kw):
+    """Lazy front door for the jax-heavy capture (see capture.py)."""
+    from .capture import capture_kernels as _capture
+
+    return _capture(**kw)

@@ -1,0 +1,58 @@
+"""The pinned-schema ``kind: kernel_manifest`` document.
+
+Emitted by ``python -m benor_tpu profile --kernels`` and bench.py's
+``kernelscope`` blob, validated (schema + cross-field recomputation) by
+``tools/check_metrics_schema.py:check_kernel_manifest`` against
+``tools/kernel_manifest_schema.json``, and gated against the committed
+``KERNEL_BASELINE.json`` by ``tools/check_kernel_regression.py``
+(file-path-loading gate.py).  Stdlib-only: capture hands plain dicts in.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+#: The manifest's ``kind`` tag — registered in
+#: check_metrics_schema.MANIFEST_CHECKERS (benorlint's
+#: manifest-kind-parity rule fails the build if that row vanishes).
+KERNEL_MANIFEST_KIND = "kernel_manifest"
+
+SCHEMA_VERSION = 1
+
+
+def build_kernel_manifest(kernels: Dict[str, dict], scale: dict,
+                          platform: str, device_kind: str,
+                          interpret: bool,
+                          telem_columns: List[str],
+                          fused_vs_xla: Optional[dict] = None) -> dict:
+    """Assemble the manifest from per-kernel capture blobs
+    (capture.capture_kernels builds them; tests may hand-roll).  The
+    cross-field facts the checker recomputes — pad-waste fraction,
+    predicted-byte sums, byte ratio, per-tile totals — are all already
+    inside ``kernels``; this function only pins the envelope."""
+    return {
+        "kind": KERNEL_MANIFEST_KIND,
+        "schema_version": SCHEMA_VERSION,
+        "platform": platform,
+        "device_kind": device_kind,
+        "interpret": bool(interpret),
+        "scale": dict(scale),
+        "telem_columns": list(telem_columns),
+        "kernels": kernels,
+        "fused_vs_xla": fused_vs_xla,
+    }
+
+
+def save_kernel_manifest(path: str, manifest: dict) -> None:
+    with open(path, "w") as fh:
+        json.dump(manifest, fh, indent=1, sort_keys=True)
+
+
+def load_kernel_manifest(path: str) -> dict:
+    with open(path) as fh:
+        doc = json.load(fh)
+    if doc.get("kind") != KERNEL_MANIFEST_KIND:
+        raise ValueError(
+            f"{path}: kind={doc.get('kind')!r} is not a kernel manifest")
+    return doc

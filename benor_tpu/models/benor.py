@@ -125,8 +125,12 @@ def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
         cr = (pr._pad_cr(faults, np_total)
               if cfg.fault_model == "crash_at_round" else None)
         hist1 = pr.sent_hist_from_pack(cfg, pack, cr, r, ctx)
+        # [:5] — under cfg.kernel_telemetry packed_round appends the
+        # per-tile stage counters; this per-round wrapper has no run
+        # accumulator to add them to (the packed loop carries one), so
+        # the per-round increment is dropped here by design
         new_pack, _, _, row, wrow = pr.packed_round(
-            cfg, pack, faults, base_key, r, hist1, ctx, N)
+            cfg, pack, faults, base_key, r, hist1, ctx, N)[:5]
         new_state = pr.unpack_state(new_pack, N)
         extras = []
         if recorder is not None:

@@ -151,6 +151,55 @@ VOTE_RECORD_LAYOUT = {
 WITNESS_PROP_FIELDS = ("p0", "p1")
 WITNESS_VOTE_FIELDS = ("x", "decided", "killed", "coined", "v0", "v1")
 
+#: In-kernel stage-counter columns (SimConfig.kernel_telemetry;
+#: benor_tpu/kernelscope) — name -> (base, width) OFFSETS within the
+#: telemetry block each kernel appends after its base / recorder /
+#: witness columns (absolute base: _telem_base).  Same pure-literal
+#: discipline as every other layout table: the kernels derive their
+#: emission order from it (``_telem_cols``), the host-side assembly
+#: (kernelscope/report.py) labels columns from it, and the static
+#: checker (analysis/rules_layout.py, rule ``telem-layout``) re-parses
+#: it — overlap-free, dense, kernel emission keys exactly equal to the
+#: table's, and the worst-case column budget (base + recorder + witness
+#: blocks at WITNESS_MAX_NODES + this block) still inside PARTIAL_COLS.
+#: Hand-numbered telemetry constants are a lint failure.
+#:
+#: Per tile, per trial, per round:
+#:   active_lanes   real (non-pad) lanes this tile carries
+#:   pad_lanes      padding-waste lanes (TILE - active; every one runs
+#:                  the full vectorized stage for nothing)
+#:   sampler_draws  lanes the stage's CF sampler touched (0 under the
+#:                  closed-form 'delivered'/'camps' adversaries, which
+#:                  run no sampler at all)
+#:   hist_visits    histogram scatter visits — lanes contributing to
+#:                  the stage's vote-class histogram (honest live
+#:                  senders)
+#:   quorum_passes  lanes that passed the quorum gate and ran the
+#:                  decide/adopt/coin chain (vote stage; 0 in proposal)
+#:   coin_draws     lanes that committed a coin flip (vote stage)
+#:   plane_hops     plane-stack HBM round trips this stage performs —
+#:                  the two-kernel pipeline's read / read+write vs the
+#:                  single-pass kernel's read + write (2 vs 3 per round
+#:                  summed over stages: the inter-kernel traffic the
+#:                  fusion exists to remove, now measured per tile)
+TELEM_COLS = {
+    "active_lanes": (0, 1),
+    "pad_lanes": (1, 1),
+    "sampler_draws": (2, 1),
+    "hist_visits": (3, 1),
+    "quorum_passes": (4, 1),
+    "coin_draws": (5, 1),
+    "plane_hops": (6, 1),
+}
+
+#: Telemetry block width + column names in base order, derived from the
+#: table (never hand-counted — the telem-layout rule enforces it).
+TELEM_WIDTH = max(b + w for b, w in TELEM_COLS.values())
+TELEM_COLUMNS = tuple(sorted(TELEM_COLS, key=lambda c: TELEM_COLS[c][0]))
+
+#: Stage axis of the telemetry accumulator (kernelscope report rows).
+TELEM_STAGES = ("proposal", "vote")
+
 
 def _extent(*layouts) -> int:
     """One-past-the-last column of the union of layout tables."""
@@ -183,6 +232,15 @@ def _witb_base(record: bool) -> int:
     return _extent(VOTE_PARTIAL_LAYOUT)
 
 
+def _telem_base(stage: str, record: bool, n_witness: int) -> int:
+    """First TELEM_COLS column for one kernel stage: after everything
+    else that stage emits — so unarmed executables keep their historical
+    layout bit-for-bit.  Derived from the tables, never hand-numbered."""
+    if stage == "proposal":
+        return _WITA_BASE + _WITA_PER_NODE * n_witness
+    return _witb_base(record) + _WITB_PER_NODE * n_witness
+
+
 def fused_one_pass_eligible(cfg, trials: int, n_nodes: int) -> bool:
     """True iff packed_round would take the SINGLE-PASS kernel for this
     (config, shape) on a single device: sampled counts (the closed-form
@@ -198,6 +256,18 @@ def fused_one_pass_eligible(cfg, trials: int, n_nodes: int) -> bool:
     np_total = n_nodes + (-n_nodes) % TILE_N
     return (np_total <= FUSED_ONE_PASS_MAX_NODES
             and trials * np_total <= FUSED_ONE_PASS_MAX_LANES)
+
+
+def telemetry_tiles(cfg, trials: int, n_nodes: int) -> int:
+    """Tile count of the telemetry accumulator's middle axis for this
+    (config, shape) on a single device — 1 when the single-pass kernel
+    engages (its grid sees the whole padded node axis in one step),
+    np_total / TILE_N on the two-kernel plane pipeline.  Kept next to
+    fused_one_pass_eligible so the accumulator shape can never drift
+    from the dispatch that fills it."""
+    if fused_one_pass_eligible(cfg, trials, n_nodes):
+        return 1
+    return (n_nodes + (-n_nodes) % TILE_N) // TILE_N
 
 
 def partial_dtype(m: int, tile_nodes: int):
@@ -248,6 +318,54 @@ def _witness_cols(scal_ref, shape, witness_ids, n_local, fields):
                 v = jnp.sum(jnp.where(sel, f, 0), axis=1)
             cols.append(v.astype(jnp.int32))
     return cols
+
+
+def _telem_cols(shape, n_local, sampled, hops, hon=None, quorum=None,
+                coined=None):
+    """The TELEM_COLS block for one kernel stage -> [T] int32 columns in
+    table order (SimConfig.kernel_telemetry).
+
+    ``shape`` is the stage's per-lane block (T, tile); pad lanes are
+    classified by LOCAL lane index against ``n_local`` exactly as
+    ``_witness_cols`` masks them, so the active/pad split is the real
+    padding waste of this tile, per trial, per round.  ``sampled`` is
+    static (the closed-form adversaries run no sampler — their
+    sampler_draws column is honestly zero); ``hops`` is the static
+    plane-stack round-trip count of this stage.  ``hon``/``quorum``/
+    ``coined`` are the stage's own masks (None emits 0 — e.g. the
+    proposal stage never reaches the quorum gate or the coin)."""
+    t, tile = shape
+    lidx = (jax.lax.broadcasted_iota(jnp.uint32, shape, 1)
+            + jnp.uint32(pl.program_id(0) * tile))
+    real = lidx < jnp.uint32(n_local)
+    active = jnp.sum(real, axis=1, dtype=jnp.int32)
+    zeros = jnp.zeros((t,), jnp.int32)
+
+    def count(mask):
+        if mask is None:
+            return zeros
+        return jnp.sum(mask, axis=1, dtype=jnp.int32)
+
+    vals = {
+        "active_lanes": active,
+        "pad_lanes": jnp.int32(tile) - active,
+        "sampler_draws": (jnp.full((t,), tile, jnp.int32) if sampled
+                          else zeros),
+        "hist_visits": count(hon),
+        "quorum_passes": count(quorum),
+        "coin_draws": count(coined),
+        "plane_hops": jnp.full((t,), hops, jnp.int32),
+    }
+    return [vals[name] for name in TELEM_COLUMNS]
+
+
+def _telem_slice(parts, base):
+    """Per-tile telemetry block from a kernel's RAW [tiles, T,
+    PARTIAL_COLS] partial buffer -> int32 [tiles, TELEM_WIDTH], summed
+    over the trial axis (the accumulator aggregates trials and rounds;
+    per-tile, per-stage resolution is what the attribution needs)."""
+    block = parts.astype(jnp.int32)[:, :, base:base + TELEM_WIDTH]
+    return jnp.sum(block, axis=1)
 
 
 # --------------------------------------------------------------------------
@@ -541,10 +659,11 @@ def _decide_commit(n_faulty, rule, coin_mode, eps, shape, coin_scal,
 
 def _vote_partial_cols(fault_model, record, witness_ids, n_local,
                        vote_scal, shape, new_x, new_dec, killed, faulty,
-                       alive, active, coined, v0, v1):
+                       alive, active, coined, v0, v1,
+                       telemetry=False, telem_sampled=True, telem_hops=2):
     """The vote pass's per-tile partial columns (VOTE_PARTIAL_LAYOUT +
-    optional VOTE_RECORD_LAYOUT + witness blocks) — shared by the
-    two-kernel and single-pass paths."""
+    optional VOTE_RECORD_LAYOUT + witness blocks + optional TELEM_COLS
+    stage counters) — shared by the two-kernel and single-pass paths."""
     sent_next = _sent(fault_model, new_x, faulty)
     settled = (new_dec == 1) | (killed == 1)
     hon = _honest(fault_model, alive, faulty)
@@ -572,12 +691,16 @@ def _vote_partial_cols(fault_model, record, witness_ids, n_local,
         cols = cols + _witness_cols(
             vote_scal, shape, witness_ids, n_local,
             [new_x, new_dec, killed, coined.astype(jnp.int32), v0, v1])
+    if telemetry:
+        cols = cols + _telem_cols(shape, n_local, telem_sampled,
+                                  telem_hops, hon=hon, quorum=active,
+                                  coined=coined)
     return cols
 
 
 def _prop_hist_kernel(m, fault_model, freeze, has_cr, counts_mode,
                       camp_b0, camp_b1, witness_ids, n_local, kbits,
-                      *refs):
+                      telemetry, *refs):
     """One lane-tile of the two-kernel path's PROPOSAL phase.
 
     Per-lane tallies -> phase-1 majority/tie (node.ts:63-69) -> each
@@ -637,13 +760,17 @@ def _prop_hist_kernel(m, fault_model, freeze, has_cr, counts_mode,
     if witness_ids:
         cols += _witness_cols(scal_ref, shape, witness_ids, n_local,
                               [p0, p1])
+    if telemetry:
+        # proposal stage: one plane-stack read, no quorum gate, no coin
+        cols += _telem_cols(shape, n_local, counts_mode == "sampled", 1,
+                            hon=hon)
     out_ref[...] = _partial_cols(t, cols, out_ref.dtype)
 
 
 def _vote_commit_kernel(m, n_faulty, rule, coin_mode, eps, freeze,
                         fault_model, has_cr, counts_mode, camp_b0,
                         camp_b1, record, witness_ids, n_local, kbits,
-                        *refs):
+                        telemetry, *refs):
     """One lane-tile of the two-kernel path's VOTE phase + commit.
 
     Per-lane vote tallies (by counts_mode, as in _prop_hist_kernel) ->
@@ -715,13 +842,15 @@ def _vote_commit_kernel(m, n_faulty, rule, coin_mode, eps, freeze,
     cols = _vote_partial_cols(fault_model, record, witness_ids, n_local,
                               vote_scal_ref, shape, new_x, new_dec,
                               killed, faulty, alive, active, coined, v0,
-                              v1)
+                              v1, telemetry=telemetry,
+                              telem_sampled=counts_mode == "sampled",
+                              telem_hops=2)
     part_ref[...] = _partial_cols(shape[0], cols, part_ref.dtype)
 
 
 def _fused_round_kernel(m, n_faulty, rule, coin_mode, eps, freeze,
                         fault_model, has_cr, record, witness_ids, n_local,
-                        kbits, *refs):
+                        kbits, telemetry, *refs):
     """The SINGLE-PASS fused round: both phases of one Ben-Or round over
     the whole (padded) node axis in one kernel invocation.
 
@@ -780,6 +909,11 @@ def _fused_round_kernel(m, n_faulty, rule, coin_mode, eps, freeze,
     if witness_ids:
         colsA += _witness_cols(prop_scal, shape, witness_ids, n_local,
                                [p0, p1])
+    if telemetry:
+        # single-pass proposal stage: the one plane-stack READ (the
+        # write is the vote stage's hop — 2 total per round, vs the
+        # two-kernel pipeline's 3)
+        colsA += _telem_cols(shape, n_local, True, 1, hon=hon)
     partA_ref[...] = _partial_cols(t, colsA, partA_ref.dtype)
 
     # --- the vote-phase GLOBAL histogram + quorum gate, in-register ------
@@ -804,7 +938,9 @@ def _fused_round_kernel(m, n_faulty, rule, coin_mode, eps, freeze,
                   coined)
     colsB = _vote_partial_cols(fault_model, record, witness_ids, n_local,
                                vote_scal, shape, new_x, new_dec, killed,
-                               faulty, alive, active, coined, v0, v1)
+                               faulty, alive, active, coined, v0, v1,
+                               telemetry=telemetry, telem_sampled=True,
+                               telem_hops=1)
     partB_ref[...] = _partial_cols(t, colsB, partB_ref.dtype)
 
 
@@ -849,14 +985,14 @@ def _count_vecs(hist, counts_mode):
 
 @instrumented_jit(static_argnames=(
     "m", "fault_model", "freeze", "interpret", "counts_mode", "camp_b0",
-    "camp_b1", "witness_ids", "n_local"))
+    "camp_b1", "witness_ids", "n_local", "telemetry"))
 def proposal_hist_pallas(base_key, r, phase, hist, pack, crash_round,
                          m: int, fault_model: str, freeze: bool,
                          interpret: bool = False, node_offset=0,
                          trial_offset=0, n_equiv=None,
                          counts_mode: str = "sampled", camp_b0: int = 0,
                          camp_b1: int = 0, witness_ids: tuple = (),
-                         n_local: int = 0):
+                         n_local: int = 0, telemetry: bool = False):
     """Fused proposal phase over the plane stack -> partials
     [T, PARTIAL_COLS] (partial_dtype-narrowed; cast to int32 before
     summing): cols 0-2 this shard's LOCAL vote histogram, col 3 its alive
@@ -905,7 +1041,7 @@ def proposal_hist_pallas(base_key, r, phase, hist, pack, crash_round,
     parts = pl.pallas_call(
         functools.partial(_prop_hist_kernel, m, fault_model, freeze,
                           has_cr, counts_mode, camp_b0, camp_b1,
-                          witness_ids, n_local, kbits),
+                          witness_ids, n_local, kbits, telemetry),
         out_shape=jax.ShapeDtypeStruct((np_total // TILE_N, T,
                                         PARTIAL_COLS), pdtype),
         grid=(np_total // TILE_N,),
@@ -913,13 +1049,19 @@ def proposal_hist_pallas(base_key, r, phase, hist, pack, crash_round,
         out_specs=_part(T),
         interpret=interpret,
     )(*args)
-    return jnp.sum(parts.astype(jnp.int32), axis=0)
+    summed = jnp.sum(parts.astype(jnp.int32), axis=0)
+    if telemetry:
+        # per-tile stage counters ride back next to the summed partials
+        # (SimConfig.kernel_telemetry; off = the historical return)
+        return summed, _telem_slice(
+            parts, _telem_base("proposal", False, len(witness_ids)))
+    return summed
 
 
 @instrumented_jit(static_argnames=(
     "m", "n_faulty", "rule", "coin_mode", "eps", "freeze", "fault_model",
     "interpret", "counts_mode", "camp_b0", "camp_b1", "record",
-    "witness_ids", "n_local"))
+    "witness_ids", "n_local", "telemetry"))
 def vote_commit_pallas(base_key, r, phase, hist, pack, crash_round,
                        quorum_ok, shared, m: int, n_faulty: int, rule: str,
                        coin_mode: str, eps: float, freeze: bool,
@@ -927,7 +1069,8 @@ def vote_commit_pallas(base_key, r, phase, hist, pack, crash_round,
                        node_offset=0, trial_offset=0, n_equiv=None,
                        counts_mode: str = "sampled", camp_b0: int = 0,
                        camp_b1: int = 0, record: bool = False,
-                       witness_ids: tuple = (), n_local: int = 0):
+                       witness_ids: tuple = (), n_local: int = 0,
+                       telemetry: bool = False):
     """Fused vote phase + commit -> (new plane stack, partials
     [T, PARTIAL_COLS] int32).
 
@@ -977,7 +1120,7 @@ def vote_commit_pallas(base_key, r, phase, hist, pack, crash_round,
         functools.partial(_vote_commit_kernel, m, n_faulty, rule,
                           coin_mode, eps, freeze, fault_model, has_cr,
                           counts_mode, camp_b0, camp_b1, record,
-                          witness_ids, n_local, kbits),
+                          witness_ids, n_local, kbits, telemetry),
         out_shape=[jax.ShapeDtypeStruct((T, n_planes,
                                          np_total // PACK_NODES_PER_WORD),
                                         jnp.uint32),
@@ -994,18 +1137,21 @@ def vote_commit_pallas(base_key, r, phase, hist, pack, crash_round,
         # the margin partial is a per-tile MAX, not a sum
         summed = summed.at[:, _RP_MARGIN].set(
             jnp.max(parts[:, :, _RP_MARGIN], axis=0))
+    if telemetry:
+        return new_pack, summed, _telem_slice(
+            parts, _telem_base("vote", record, len(witness_ids)))
     return new_pack, summed
 
 
 @instrumented_jit(static_argnames=(
     "m", "n_faulty", "rule", "coin_mode", "eps", "freeze", "fault_model",
-    "interpret", "record", "witness_ids", "n_local"))
+    "interpret", "record", "witness_ids", "n_local", "telemetry"))
 def fused_round_pallas(base_key, r, hist1, pack, crash_round, shared,
                        m: int, n_faulty: int, rule: str, coin_mode: str,
                        eps: float, freeze: bool, fault_model: str,
                        interpret: bool = False, n_equiv=None,
                        record: bool = False, witness_ids: tuple = (),
-                       n_local: int = 0):
+                       n_local: int = 0, telemetry: bool = False):
     """ONE pallas pass for a whole Ben-Or round (single device,
     counts_mode='sampled', within the FUSED_ONE_PASS_* caps) ->
     (new plane stack, partsA, partsB) with partsA/partsB int32
@@ -1067,7 +1213,7 @@ def fused_round_pallas(base_key, r, hist1, pack, crash_round, shared,
     new_pack, partsA, partsB = pl.pallas_call(
         functools.partial(_fused_round_kernel, m, n_faulty, rule,
                           coin_mode, eps, freeze, fault_model, has_cr,
-                          record, witness_ids, n_local, kbits),
+                          record, witness_ids, n_local, kbits, telemetry),
         out_shape=[jax.ShapeDtypeStruct((T, n_planes, n_w), jnp.uint32),
                    jax.ShapeDtypeStruct((1, T, PARTIAL_COLS), pdtype),
                    jax.ShapeDtypeStruct((1, T, PARTIAL_COLS), pdtype)],
@@ -1076,8 +1222,15 @@ def fused_round_pallas(base_key, r, hist1, pack, crash_round, shared,
         out_specs=[whole_planes, whole_part, whole_part],
         interpret=interpret,
     )(*args)
-    return (new_pack, jnp.sum(partsA.astype(jnp.int32), axis=0),
-            jnp.sum(partsB.astype(jnp.int32), axis=0))
+    out = (new_pack, jnp.sum(partsA.astype(jnp.int32), axis=0),
+           jnp.sum(partsB.astype(jnp.int32), axis=0))
+    if telemetry:
+        k = len(witness_ids)
+        return out + (_telem_slice(partsA, _telem_base("proposal", False,
+                                                       k)),
+                      _telem_slice(partsB, _telem_base("vote", record,
+                                                       k)))
+    return out
 
 
 def _pad_cr(faults, np_total):
@@ -1138,7 +1291,11 @@ def packed_round(cfg, pack, faults, base_key, r, hist1, ctx, n_local,
     pmax'd over nodes then summed over trials) and None otherwise;
     ``wrow`` is the witness row int32 [W, k, state.WIT_WIDTH] when
     cfg.witness (assembled from the kernels' per-tile witness partials,
-    psum-globalized over both mesh axes) and None otherwise.
+    psum-globalized over both mesh axes) and None otherwise.  With
+    cfg.kernel_telemetry a SIXTH element rides the return: this round's
+    per-tile stage counters int32 [2, tiles, TELEM_WIDTH] (TELEM_STAGES
+    order — telemetry_tiles gives the tile count the dispatch will
+    produce).
 
     Dispatch: counts_mode='sampled' on a single device within the
     FUSED_ONE_PASS_* caps takes the SINGLE-PASS kernel
@@ -1191,34 +1348,45 @@ def packed_round(cfg, pack, faults, base_key, r, hist1, ctx, n_local,
         shared = rng.coin_flips(base_key, r, ctx.trial_ids(T),
                                 rng.ids(1), common=True)[:, 0]
 
+    telem = bool(cfg.kernel_telemetry)
+    telemA = telemB = None
     one_pass = (ctx is SINGLE
                 and fused_one_pass_eligible(cfg, T, n_local))
     if one_pass:
-        new_pack, partsA, partsB = fused_round_pallas(
+        out = fused_round_pallas(
             base_key, r, hist1, pack, cr, shared, m, cfg.n_faulty,
             cfg.rule, cfg.coin_mode, float(cfg.coin_eps),
             bool(cfg.freeze_decided), cfg.fault_model, interpret=interp,
             n_equiv=n_equiv, record=bool(cfg.record), witness_ids=wids,
-            n_local=n_local)
+            n_local=n_local, telemetry=telem)
+        new_pack, partsA, partsB = out[:3]
+        if telem:
+            telemA, telemB = out[3:]
     else:
-        partsA = proposal_hist_pallas(
+        out = proposal_hist_pallas(
             base_key, r, rng.PHASE_PROPOSAL, kernel_counts(hist1), pack,
             cr, m, cfg.fault_model, bool(cfg.freeze_decided),
             interpret=interp, node_offset=node_off,
             trial_offset=trial_off, n_equiv=n_equiv, counts_mode=mode,
             camp_b0=camp_b0, camp_b1=camp_b1, witness_ids=wids,
-            n_local=n_local)
+            n_local=n_local, telemetry=telem)
+        partsA = out[0] if telem else out
+        if telem:
+            telemA = out[1]
         hist2 = ctx.psum_nodes(partsA[:, :3])
         n_alive = ctx.psum_nodes(partsA[:, 3])
         quorum_ok = n_alive >= m
-        new_pack, partsB = vote_commit_pallas(
+        out = vote_commit_pallas(
             base_key, r, rng.PHASE_VOTE, kernel_counts(hist2), pack, cr,
             quorum_ok, shared, m, cfg.n_faulty, cfg.rule, cfg.coin_mode,
             float(cfg.coin_eps), bool(cfg.freeze_decided),
             cfg.fault_model, interpret=interp, node_offset=node_off,
             trial_offset=trial_off, n_equiv=n_equiv, counts_mode=mode,
             camp_b0=camp_b0, camp_b1=camp_b1, record=bool(cfg.record),
-            witness_ids=wids, n_local=n_local)
+            witness_ids=wids, n_local=n_local, telemetry=telem)
+        new_pack, partsB = out[:2]
+        if telem:
+            telemB = out[2]
     hist1_next = (None if cfg.fault_model == "crash_at_round"
                   else ctx.psum_nodes(partsB[:, :3]))
     unsettled = ctx.psum_nodes(partsB[:, 4])
@@ -1272,6 +1440,12 @@ def packed_round(cfg, pack, faults, base_key, r, hist1, ctx, n_local,
                 .at[:, :, WIT_V0].set(wb_sel[:, 4::6])
                 .at[:, :, WIT_V1].set(wb_sel[:, 5::6])
                 .at[:, :, WIT_WRITTEN].set(1))
+    if telem:
+        # stage-major per-tile stage counters int32 [2, tiles,
+        # TELEM_WIDTH] (TELEM_STAGES order) — this round's increment of
+        # the run accumulator run_packed_slice carries
+        return (new_pack, hist1_next, unsettled, row, wrow,
+                jnp.stack([telemA, telemB]))
     return new_pack, hist1_next, unsettled, row, wrow
 
 
@@ -1300,6 +1474,15 @@ def run_packed_slice(cfg, state, faults, base_key, from_round, until_round,
     ``witness`` identically (appended after the recorder when both ride):
     the kernels' per-tile witness partials land in the same buffer the
     XLA regimes fill, with no demotion.
+
+    cfg.kernel_telemetry appends LAST (after recorder and witness) the
+    per-tile stage-counter accumulator int32 [2, tiles, TELEM_WIDTH]
+    (TELEM_STAGES x telemetry_tiles x TELEM_COLS), summed over this
+    CALL's executed rounds and trials.  Fresh per call — a sliced run's
+    per-slice accumulators ADD UP to the one-shot run's, so resume
+    needs no threading (tests/test_kernelscope.py pins the identity).
+    Positional consumers that predate the flag never index past the
+    tails they know, so the extra element is inert for them.
     """
     from .collectives import SINGLE
     from ..state import (new_recorder, new_witness, recorder_write,
@@ -1311,6 +1494,12 @@ def run_packed_slice(cfg, state, faults, base_key, from_round, until_round,
         recorder = new_recorder(cfg, state, ctx)
     if cfg.witness and witness is None:
         witness = new_witness(cfg, state, ctx)
+    telem0 = None
+    if cfg.kernel_telemetry:
+        telem0 = jnp.zeros((len(TELEM_STAGES),
+                            telemetry_tiles(cfg, state.x.shape[0],
+                                            n_local), TELEM_WIDTH),
+                           jnp.int32)
     pack = pack_state(cfg, state, faults.faulty)
     np_total = pack.shape[2] * PACK_NODES_PER_WORD
     cr = (_pad_cr(faults, np_total)
@@ -1331,9 +1520,9 @@ def run_packed_slice(cfg, state, faults, base_key, from_round, until_round,
         r, pack, hist1 = carry[0], carry[1], carry[2]
         if cfg.fault_model == "crash_at_round":
             hist1 = sent_hist_from_pack(cfg, pack, cr, r, ctx)
-        new_pack, hist1_next, unsettled, row, wrow = packed_round(
-            cfg, pack, faults, base_key, r, hist1, ctx, n_local,
-            n_equiv=n_equiv)
+        rout = packed_round(cfg, pack, faults, base_key, r, hist1, ctx,
+                            n_local, n_equiv=n_equiv)
+        new_pack, hist1_next, unsettled, row, wrow = rout[:5]
         if hist1_next is None:
             hist1_next = hist1              # recomputed next iteration
         out = (r + 1, new_pack, hist1_next,
@@ -1344,6 +1533,9 @@ def run_packed_slice(cfg, state, faults, base_key, from_round, until_round,
             i += 1
         if cfg.witness:
             out = out + (witness_write(carry[i], r, wrow),)
+            i += 1
+        if cfg.kernel_telemetry:
+            out = out + (carry[i] + rout[5],)
         return out
 
     carry = (jnp.asarray(from_round, jnp.int32), pack, hist1, unsettled0)
@@ -1351,6 +1543,8 @@ def run_packed_slice(cfg, state, faults, base_key, from_round, until_round,
         carry = carry + (recorder,)
     if cfg.witness:
         carry = carry + (witness,)
+    if cfg.kernel_telemetry:
+        carry = carry + (telem0,)
     out = jax.lax.while_loop(cond, body, carry)
     r, pack = out[0], out[1]
     return (r, unpack_state(pack, n_local), *out[4:])
@@ -1360,7 +1554,9 @@ def run_packed(cfg, state, faults, base_key):
     """Single-device fast path for sim.run_consensus: run_packed_slice
     from /start with an unbounded slice.  Bit-identical to the generic
     loop.  With cfg.record / cfg.witness, returns the filled flight
-    recorder / witness buffer too."""
+    recorder / witness buffer too; with cfg.kernel_telemetry the
+    per-tile stage-counter accumulator rides last (the kernelscope
+    capture's raw material)."""
     from ..sim import start_state
 
     state = start_state(cfg, state)

@@ -103,6 +103,105 @@ def packing_report(max_rounds: int) -> dict:
     }
 
 
+def kernel_geometry(cfg, trials: Optional[int] = None,
+                    n_nodes: Optional[int] = None) -> dict:
+    """The fused round's grid/layout geometry, priced straight off the
+    declarative tables — state.PACK_LAYOUT (plane count), the kernels'
+    PARTIAL_COLS / partial_dtype (partial rows) and TILE_N (the lane
+    tile).  One dict the per-stage traffic model below and the
+    kernel_manifest's cross-field recomputation
+    (check_metrics_schema.check_kernel_manifest) both consume, so the
+    predicted bytes can always be re-derived from the committed
+    numbers."""
+    import numpy as np
+
+    from ..ops.pallas_hist import TILE_N
+    from ..ops.pallas_round import (PARTIAL_COLS, fused_one_pass_eligible,
+                                    partial_dtype)
+    from ..state import pack_width
+
+    t = cfg.trials if trials is None else trials
+    n = cfg.n_nodes if n_nodes is None else n_nodes
+    np_total = n + (-n) % TILE_N
+    one_pass = fused_one_pass_eligible(cfg, t, n)
+    tiles = 1 if one_pass else np_total // TILE_N
+    pdtype = partial_dtype(cfg.quorum,
+                           np_total if one_pass else TILE_N)
+    return {
+        "trials": t,
+        "n_nodes": n,
+        "np_total": np_total,
+        "tiles": tiles,
+        "tile_nodes": np_total if one_pass else TILE_N,
+        "planes": pack_width(cfg),
+        "partial_cols": PARTIAL_COLS,
+        "partial_dtype_bytes": int(np.dtype(pdtype).itemsize),
+        "one_pass": bool(one_pass),
+    }
+
+
+def stage_traffic(geom: dict) -> dict:
+    """Predicted HBM bytes PER ROUND per kernel stage, from a
+    ``kernel_geometry`` dict alone (pure arithmetic — the stdlib-only
+    manifest checker replays exactly this formula):
+
+      plane_bytes    one pass over the packed plane stack:
+                     T x planes x (np_total / 32) x 4
+      partial_bytes  one per-tile partial buffer write:
+                     tiles x T x partial_cols x dtype_bytes
+      count_bytes    the [T]-vector count operands (3 classes, f32)
+
+    Stage composition: the proposal stage reads the stack and writes its
+    partials; the vote stage writes the new stack (plus, on the
+    two-kernel pipeline, its own READ of the stack — the inter-kernel
+    round trip the single-pass kernel deletes) and writes its partials;
+    ``reduce`` is the XLA read-back of both partial buffers for the
+    cross-tile sums.  O(T)-sized operands dwarfed by the O(N) terms are
+    priced, not dropped, so the totals telescope."""
+    t = geom["trials"]
+    plane = t * geom["planes"] * (geom["np_total"] // 32) * 4
+    partial = (geom["tiles"] * t * geom["partial_cols"]
+               * geom["partial_dtype_bytes"])
+    counts = t * 3 * 4
+    # one-pass: the vote stage only WRITES the stack (the proposal
+    # stage's read is still resident); two-kernel: a fresh read + the
+    # write — the inter-kernel hop the fusion removes
+    vote_plane_passes = 1 if geom["one_pass"] else 2
+    stages = {
+        "proposal": plane + partial + counts,
+        "vote": vote_plane_passes * plane + partial + counts,
+        "reduce": 2 * partial,
+    }
+    stages["total"] = sum(stages.values())
+    return stages
+
+
+def traffic_report(cfg, trials: Optional[int] = None,
+                   n_nodes: Optional[int] = None,
+                   measured_bytes_per_round: Optional[float] = None
+                   ) -> dict:
+    """The layout-derived HBM traffic model for one fused-round config:
+    geometry + per-stage predicted bytes per round, plus — when the
+    caller hands over the executable's ``cost_analysis``
+    ``bytes_accessed`` for one round — the predicted/measured
+    ``byte_ratio`` that telescopes the model against XLA's own cost
+    accounting (the kernel_manifest's cross-check band).  This is the
+    instrument ROADMAP item 2's relayout work reads: 'fused loses'
+    becomes 'fused loses because stage X moves Y predicted-vs-measured
+    bytes'."""
+    geom = kernel_geometry(cfg, trials=trials, n_nodes=n_nodes)
+    stages = stage_traffic(geom)
+    ratio = None
+    if measured_bytes_per_round:
+        ratio = round(stages["total"] / measured_bytes_per_round, 6)
+    return {
+        "geometry": geom,
+        "predicted_bytes_per_round": stages,
+        "measured_bytes_per_round": measured_bytes_per_round,
+        "byte_ratio": ratio,
+    }
+
+
 def roofline(flops: float, bytes_accessed: float, exec_s: float,
              device_kind: str) -> dict:
     """Place one executed program on the device roofline.

@@ -57,7 +57,19 @@ def warn_structured_demotes_pallas(cfg: SimConfig) -> None:
     the per-round XLA loop instead.  That demotion is STRUCTURAL (the
     kernels implement the complete graph only) — but silent flag-
     swallowing is how perf cliffs hide, so announce it once per
-    process, the debug-demotion policy's sibling."""
+    process, the debug-demotion policy's sibling.
+
+    Tooling visibility (PR 14): the one-shot warning is invisible to
+    anything but a human tail of stderr, so every CALL of this
+    announcer also ticks the ``sim.demotion.structured`` counter in
+    the unified metrics registry.  Callers sit inside jitted entry
+    points, so one tick = one TRACED demoted executable build — a warm
+    jit cache re-runs the executable without re-entering this Python
+    body, so the counter counts distinct demoted builds, not executions
+    (tests/test_kernelscope.py pins both halves).  bench.py surfaces
+    the family in its topo blob."""
+    from .utils.metrics import REGISTRY
+    REGISTRY.counter("sim.demotion.structured").inc()
     global _structured_demotion_warned
     if _structured_demotion_warned:
         return
@@ -80,7 +92,14 @@ def warn_debug_demotes_pallas(cfg: SimConfig) -> None:
     pallas-eligible config is demoted, so 'zero-cost tracing' is never
     read as covering the fused regime.  cfg.record is the
     non-perturbing alternative (the flight recorder runs INSIDE the
-    fused loop)."""
+    fused loop).
+
+    Every call of this announcer ticks ``sim.demotion.debug`` in the
+    metrics registry (the warning itself fires once per process).  As
+    with the structured twin, callers are jitted entry points: one tick
+    = one traced demoted executable build, not one execution."""
+    from .utils.metrics import REGISTRY
+    REGISTRY.counter("sim.demotion.debug").inc()
     global _debug_demotion_warned
     if _debug_demotion_warned:
         return
@@ -193,10 +212,12 @@ def run_consensus(cfg: SimConfig, state: NetState, faults: FaultSpec,
     ``cfg.record`` (the flight recorder) is the observation mechanism
     that does NOT change which code runs.
     """
-    from .ops.tally import pallas_requested, pallas_round_active
+    from .ops.tally import pallas_round_active
 
-    if pallas_requested(cfg) and delivery_plane(cfg) != "complete":
-        warn_structured_demotes_pallas(cfg)
+    # NOTE: the structured-plane demotion is announced (and counted —
+    # sim.demotion.structured) by run_consensus_traced, which every
+    # structured config reaches below (the pallas gates reject them);
+    # announcing here too would double-tick the counter per run
     if pallas_round_active(cfg):
         if cfg.debug:
             warn_debug_demotes_pallas(cfg)

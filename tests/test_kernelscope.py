@@ -1,0 +1,521 @@
+"""kernelscope (benor_tpu/kernelscope) — tile-level pallas observability.
+
+Four layers, mirroring the instrument's contract:
+
+  * HOUSE RULE: ``kernel_telemetry=False`` (the default) is bit-identical
+    to pre-PR behavior in results AND backend-compile counts on every
+    pallas regime — the fused one-pass kernel, the two-kernel plane
+    pipeline, sliced/resume, and the batched sweep's static pallas
+    bucket; telemetry ON changes no science bit either.
+  * ORACLE: the pad-lane waste / active-lane / hop counters are exact
+    against a NumPy recomputation from the geometry (they are
+    deterministic integers, not samples).
+  * MANIFEST: the capture's ``kind: kernel_manifest`` is schema-valid,
+    its cross-field recomputations (pad waste, predicted bytes, byte
+    ratio, per-tile sums) reject a tamper matrix, and the predicted-byte
+    arithmetic in tools/check_metrics_schema.py stays column-for-column
+    equal to perfscope/roofline.stage_traffic.
+  * GATE: tools/check_kernel_regression.py exits 0 on the self-gate,
+    2 on injected pad-waste / byte-ratio / counter regressions, 3 on a
+    scale mismatch.
+
+CPU runs the pallas kernels in interpret mode (the only mode XLA:CPU
+has); the manifest records ``interpret`` so compiled-mode captures are
+distinguishable, and the counter/byte logic under test is mode-
+independent (the same kernel python runs either way).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from benor_tpu.config import SimConfig
+from benor_tpu.ops import pallas_round as pr
+from benor_tpu.ops import sampling, tally
+from benor_tpu.sim import (run_consensus, run_consensus_slice,
+                           start_state, warn_debug_demotes_pallas,
+                           warn_structured_demotes_pallas)
+from benor_tpu.state import FaultSpec, init_state
+from benor_tpu.sweep import balanced_inputs
+from benor_tpu.utils.compile_counter import count_backend_compiles
+from benor_tpu.utils.metrics import REGISTRY
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+TILE = 512  # pallas_hist.TILE_N — the lane tile every oracle reckons in
+
+
+def _cms():
+    spec = importlib.util.spec_from_file_location(
+        "_cms_for_kernelscope",
+        os.path.join(TOOLS, "check_metrics_schema.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _science(out):
+    r, fin = out[0], out[1]
+    return (int(r), np.asarray(fin.x), np.asarray(fin.decided),
+            np.asarray(fin.k), np.asarray(fin.killed))
+
+
+def _assert_bit_equal(a, b):
+    assert a[0] == b[0]
+    for x, y, name in zip(a[1:], b[1:], ("x", "decided", "k", "killed")):
+        np.testing.assert_array_equal(x, y, err_msg=name)
+
+
+def _one_pass_cfg(n, t, seed, **kw):
+    kw.setdefault("n_faulty", 2 * n // 5)
+    kw.setdefault("max_rounds", 8)
+    return SimConfig(n_nodes=n, trials=t, delivery="quorum",
+                     scheduler="uniform", path="histogram",
+                     use_pallas_hist=True, use_pallas_round=True,
+                     seed=seed, **kw)
+
+
+def _two_kernel_cfg(n, t, seed, **kw):
+    kw.setdefault("n_faulty", n // 4 + (n - n // 4) % 2)
+    kw.setdefault("max_rounds", 8)
+    return SimConfig(n_nodes=n, trials=t, delivery="quorum",
+                     scheduler="adversarial", coin_mode="common",
+                     path="histogram", use_pallas_round=True, seed=seed,
+                     **kw)
+
+
+@pytest.fixture
+def cf_regime(monkeypatch):
+    """Lower the exact-table bound so the CF regime (and with it the
+    one-pass kernel gate) engages at test scale — the established
+    CPU-smoke trick (tests/test_packed_state.py)."""
+    monkeypatch.setattr(sampling, "EXACT_TABLE_MAX", 4)
+
+
+def _inputs(cfg):
+    faults = FaultSpec.none(cfg.trials, cfg.n_nodes)
+    state = init_state(cfg, balanced_inputs(cfg.trials, cfg.n_nodes),
+                       faults)
+    return state, faults, jax.random.key(cfg.seed)
+
+
+# --------------------------------------------------------------------------
+# house rule: off == pre-PR, on == off in science bits, compile parity
+# --------------------------------------------------------------------------
+
+
+def test_one_pass_off_on_bit_identical_and_compile_parity(cf_regime):
+    n, t = 64, 4
+    counts = []
+    outs = []
+    for telem, seed in ((False, 31), (True, 31)):
+        cfg = _one_pass_cfg(n, t, seed=seed, kernel_telemetry=telem)
+        assert tally.pallas_round_active(cfg)
+        assert pr.fused_one_pass_eligible(cfg, t, n)
+        state, faults, key = _inputs(cfg)
+        with count_backend_compiles() as cc:
+            out = run_consensus(cfg, state, faults, key)
+            int(out[0])
+        counts.append(cc.count)
+        outs.append(_science(out))
+        if telem:
+            assert len(out) == 3, "telemetry accumulator must ride last"
+        else:
+            assert len(out) == 2, "telemetry off must not change arity"
+    _assert_bit_equal(outs[0], outs[1])
+    # off and on are DIFFERENT executables (extra output) but must cost
+    # the same NUMBER of backend compiles — one each
+    assert counts[0] == counts[1] == 1, counts
+
+
+def test_two_kernel_off_on_bit_identical_and_compile_parity():
+    n, t = 600, 4              # np_total = 1024 -> 2 tiles
+    counts = []
+    outs = []
+    for telem, seed in ((False, 7), (True, 7)):
+        cfg = _two_kernel_cfg(n, t, seed=seed, kernel_telemetry=telem)
+        assert tally.pallas_round_active(cfg)
+        assert tally.pallas_round_counts_mode(cfg) == "delivered"
+        assert not pr.fused_one_pass_eligible(cfg, t, n)
+        state, faults, key = _inputs(cfg)
+        with count_backend_compiles() as cc:
+            out = run_consensus(cfg, state, faults, key)
+            int(out[0])
+        counts.append(cc.count)
+        outs.append(_science(out))
+    _assert_bit_equal(outs[0], outs[1])
+    assert counts[0] == counts[1] == 1, counts
+
+
+def test_telemetry_rides_after_recorder_and_witness(cf_regime):
+    """Tail order contract: recorder, witness, telemetry — positional
+    consumers that predate the flag keep working."""
+    n, t = 64, 4
+    cfg = _one_pass_cfg(n, t, seed=5, kernel_telemetry=True,
+                        record=True, witness_trials=(0,),
+                        witness_nodes=2)
+    state, faults, key = _inputs(cfg)
+    out = run_consensus(cfg, state, faults, key)
+    assert len(out) == 5
+    rec, wit, telem = (np.asarray(out[2]), np.asarray(out[3]),
+                       np.asarray(out[4]))
+    assert rec.shape == (cfg.max_rounds + 1, 7)
+    assert wit.shape == (cfg.max_rounds + 1, 1, 2, 9)
+    assert telem.shape == (2, 1, pr.TELEM_WIDTH)
+    # and the science bits still match a bare run
+    bare = run_consensus(_one_pass_cfg(n, t, seed=5), state, faults, key)
+    _assert_bit_equal(_science(bare), _science(out))
+
+
+# --------------------------------------------------------------------------
+# oracle: pad-lane waste and friends, exact vs NumPy recomputation
+# --------------------------------------------------------------------------
+
+
+def test_pad_waste_exact_oracle_two_kernel():
+    n, t = 600, 4              # tiles: [512 real | 88 real + 424 pad]
+    cfg = _two_kernel_cfg(n, t, seed=7, kernel_telemetry=True)
+    state, faults, key = _inputs(cfg)
+    out = run_consensus(cfg, state, faults, key)
+    rounds = int(out[0])
+    telem = np.asarray(out[2])
+    assert rounds > 0
+    cols = {c: i for i, c in enumerate(pr.TELEM_COLUMNS)}
+    np_total = n + (-n) % TILE
+    tiles = np_total // TILE
+    assert telem.shape == (2, tiles, pr.TELEM_WIDTH)
+    for stage in range(2):
+        for ti in range(tiles):
+            real = min(TILE, max(0, n - ti * TILE))
+            exp_active = rounds * t * real
+            exp_pad = rounds * t * (TILE - real)
+            assert telem[stage, ti, cols["active_lanes"]] == exp_active
+            assert telem[stage, ti, cols["pad_lanes"]] == exp_pad
+    # delivered counts run NO sampler; hops: proposal reads (1), vote
+    # reads+writes (2) — per tile, per trial, per round
+    assert (telem[:, :, cols["sampler_draws"]] == 0).all()
+    assert (telem[0, :, cols["plane_hops"]] == rounds * t).all()
+    assert (telem[1, :, cols["plane_hops"]] == 2 * rounds * t).all()
+
+
+def test_counters_exact_oracle_one_pass(cf_regime):
+    n, t = 100, 4              # np_total = 512, pad = 412
+    cfg = _one_pass_cfg(n, t, seed=3, kernel_telemetry=True)
+    state, faults, key = _inputs(cfg)
+    out = run_consensus(cfg, state, faults, key)
+    rounds = int(out[0])
+    telem = np.asarray(out[2])
+    assert rounds > 0 and telem.shape == (2, 1, pr.TELEM_WIDTH)
+    cols = {c: i for i, c in enumerate(pr.TELEM_COLUMNS)}
+    np_total = n + (-n) % TILE
+    for stage in range(2):
+        assert telem[stage, 0, cols["active_lanes"]] == rounds * t * n
+        assert telem[stage, 0, cols["pad_lanes"]] == \
+            rounds * t * (np_total - n)
+        # the CF regime samples: every lane of the padded tile is
+        # touched by the vectorized sampler
+        assert telem[stage, 0, cols["sampler_draws"]] == \
+            rounds * t * np_total
+        # one-pass: ONE plane hop per stage (read, then write)
+        assert telem[stage, 0, cols["plane_hops"]] == rounds * t
+    # no crashes in FaultSpec.none + quorum == every-trial-pass: the
+    # vote stage's quorum_passes count the live non-frozen lanes, which
+    # never exceed the active lanes
+    assert 0 < telem[1, 0, cols["quorum_passes"]] <= rounds * t * n
+    assert telem[0, 0, cols["quorum_passes"]] == 0
+    assert telem[0, 0, cols["coin_draws"]] == 0
+
+
+# --------------------------------------------------------------------------
+# sliced / resume and the batched static bucket
+# --------------------------------------------------------------------------
+
+
+def test_sliced_telemetry_adds_up_to_one_shot(cf_regime):
+    n, t = 96, 8
+    cfg = _one_pass_cfg(n, t, seed=2, n_faulty=40, max_rounds=16,
+                        kernel_telemetry=True)
+    state, faults, key = _inputs(cfg)
+    one_shot = run_consensus(cfg, state, faults, key)
+    assert int(one_shot[0]) > 1, "needs multi-round to pin slicing"
+    telem_ref = np.asarray(one_shot[2])
+
+    st, r = start_state(cfg, state), 1
+    acc = np.zeros_like(telem_ref)
+    while True:
+        out = run_consensus_slice(cfg, st, faults, key, jnp.int32(r),
+                                  jnp.int32(r + 3))
+        rn, st = int(out[0]), out[1]
+        acc += np.asarray(out[2])
+        done = bool(np.asarray((st.decided | st.killed).all()))
+        if rn == r or rn > cfg.max_rounds or done:
+            break
+        r = rn
+    np.testing.assert_array_equal(acc, telem_ref)
+    # and the sliced science bits equal the one-shot's
+    _assert_bit_equal(_science(one_shot), _science((jnp.int32(rn - 1),
+                                                    st)))
+
+
+def test_batched_static_bucket_off_on_bit_identical(cf_regime):
+    from benor_tpu.sweep import run_points_batched
+
+    n, t = 64, 4
+    curves = []
+    compiles = []
+    for telem in (False, True):
+        base = _one_pass_cfg(n, t, seed=11, kernel_telemetry=telem)
+        cb = run_points_batched(base, [base, base.replace(n_faulty=20)])
+        curves.append(cb)
+        compiles.append(cb.compile_count)
+    assert compiles[0] == compiles[1], compiles
+    for a, b in zip(curves[0].points, curves[1].points):
+        assert a.rounds_executed == b.rounds_executed
+        assert a.decided_frac == b.decided_frac
+        assert a.mean_k == b.mean_k
+        assert a.ones_frac == b.ones_frac
+        assert a.disagree_frac == b.disagree_frac
+        np.testing.assert_array_equal(a.k_hist, b.k_hist)
+
+
+# --------------------------------------------------------------------------
+# traffic model: roofline.stage_traffic == the checker's replay
+# --------------------------------------------------------------------------
+
+
+def test_traffic_model_matches_checker_replay(cf_regime):
+    from benor_tpu.perfscope.roofline import kernel_geometry, stage_traffic
+
+    cms = _cms()
+    for cfg in (_one_pass_cfg(64, 4, seed=0),
+                _two_kernel_cfg(600, 4, seed=0),
+                _two_kernel_cfg(2048, 2, seed=0)):
+        geom = kernel_geometry(cfg)
+        assert stage_traffic(geom) == cms._predicted_stage_bytes(geom), \
+            f"traffic-model drift for {cfg.scheduler} at {cfg.n_nodes}"
+
+
+# --------------------------------------------------------------------------
+# capture -> manifest -> schema checker -> gate
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def manifest():
+    from benor_tpu.kernelscope import capture_kernels
+
+    return capture_kernels()
+
+
+def test_capture_manifest_schema_valid(manifest):
+    errs = _cms().check_kernel_manifest(manifest)
+    assert errs == []
+    ks = manifest["kernels"]
+    assert set(ks) == {"fused_one_pass", "two_kernel"}
+    assert ks["fused_one_pass"]["dispatch"] == "one_pass"
+    assert ks["two_kernel"]["dispatch"] == "two_kernel"
+    # the measured hop counts match the dispatch story: 2 vs 3
+    assert ks["fused_one_pass"]["plane_hops_per_round"] == 2.0
+    assert ks["two_kernel"]["plane_hops_per_round"] == 3.0
+    for k in ks.values():
+        assert k["bit_equal_off_on"] is True
+        assert k["rounds_executed"] > 0
+        if k["measured_bytes_per_round"]:
+            assert k["byte_ratio"] is not None
+    fvx = manifest["fused_vs_xla"]
+    assert fvx["bit_equal"] is True
+    assert abs(sum(fvx["stage_attribution"].values()) - 1.0) < 1e-3
+
+
+@pytest.mark.parametrize("tamper", [
+    ("pad_waste", lambda m: m["kernels"]["two_kernel"].update(
+        pad_waste_frac=0.01)),
+    ("per_tile_sum", lambda m: m["kernels"]["two_kernel"]["stages"]
+        ["vote"]["counters"].update(coin_draws=1)),
+    ("byte_ratio", lambda m: m["kernels"]["fused_one_pass"].update(
+        byte_ratio=42.0)),
+    ("predicted", lambda m: m["kernels"]["fused_one_pass"]
+        ["predicted_bytes_per_round"].update(total=1)),
+    ("stage_names", lambda m: m["kernels"]["two_kernel"]["stages"].update(
+        rogue={"counters": {}, "per_tile": []})),
+    ("dispatch", lambda m: m["kernels"]["fused_one_pass"].update(
+        dispatch="two_kernel")),
+    ("attribution", lambda m: m["fused_vs_xla"]["stage_attribution"]
+        .update(proposal=0.9, vote=0.9)),
+    ("gap", lambda m: m["fused_vs_xla"].update(gap_bytes=123456.0)),
+    ("counter_keys", lambda m: m["kernels"]["two_kernel"]["stages"]
+        ["proposal"]["counters"].pop("pad_lanes")),
+    # a stage block missing its whole counters dict must come back as
+    # an error LIST, never a KeyError out of the checker itself
+    ("missing_counters", lambda m: m["kernels"]["two_kernel"]["stages"]
+        ["proposal"].pop("counters")),
+])
+def test_manifest_tamper_matrix(manifest, tamper):
+    name, mutate = tamper
+    doc = json.loads(json.dumps(manifest))
+    mutate(doc)
+    errs = _cms().check_kernel_manifest(doc)
+    assert errs, f"tamper {name!r} survived the checker"
+
+
+def test_gate_exit_codes(manifest, tmp_path):
+    from benor_tpu.kernelscope import save_kernel_manifest
+
+    base = tmp_path / "KERNEL_BASELINE.json"
+    save_kernel_manifest(str(base), manifest)
+    tool = os.path.join(TOOLS, "check_kernel_regression.py")
+
+    def run(man_path):
+        return subprocess.run([sys.executable, tool, str(man_path),
+                               str(base)], capture_output=True,
+                              text=True)
+
+    # 0: self-gate
+    r = run(base)
+    assert r.returncode == 0, r.stderr
+
+    # 2: injected pad-waste AND byte-ratio regression fixture
+    bad = json.loads(json.dumps(manifest))
+    bad["kernels"]["two_kernel"]["pad_waste_frac"] = 0.99
+    if bad["kernels"]["fused_one_pass"]["byte_ratio"]:
+        bad["kernels"]["fused_one_pass"]["byte_ratio"] *= 10.0
+    p_bad = tmp_path / "bad.json"
+    p_bad.write_text(json.dumps(bad))
+    r = run(p_bad)
+    assert r.returncode == 2, (r.returncode, r.stderr)
+    assert "pad-waste-regression" in r.stderr
+
+    # 2: counter drift at the same scale
+    drift = json.loads(json.dumps(manifest))
+    drift["kernels"]["two_kernel"]["stages"]["vote"]["counters"][
+        "coin_draws"] += 1
+    p_drift = tmp_path / "drift.json"
+    p_drift.write_text(json.dumps(drift))
+    r = run(p_drift)
+    assert r.returncode == 2 and "counter-drift" in r.stderr
+
+    # 3: scale mismatch is incomparable, never silently passed
+    other = json.loads(json.dumps(manifest))
+    other["scale"]["n_nodes"] = 999
+    p_other = tmp_path / "other.json"
+    p_other.write_text(json.dumps(other))
+    r = run(p_other)
+    assert r.returncode == 3 and "INCOMPARABLE" in r.stderr
+
+
+def test_gate_missing_kernel_is_a_regression(manifest):
+    from benor_tpu.kernelscope import compare_kernels
+
+    m2 = json.loads(json.dumps(manifest))
+    del m2["kernels"]["fused_one_pass"]
+    findings = compare_kernels(m2, manifest)
+    assert any(f.kind == "missing-kernel" for f in findings)
+
+
+# --------------------------------------------------------------------------
+# satellites: demotion counters, watch renderer, config validation
+# --------------------------------------------------------------------------
+
+
+def test_demotion_counters_tick_every_announcer_call():
+    # every CALL of the announcer ticks, unlike the once-per-process
+    # warning it wraps (the counter semantics sim.py documents)
+    c_struct = REGISTRY.counter("sim.demotion.structured")
+    c_debug = REGISTRY.counter("sim.demotion.debug")
+    cfg = SimConfig(n_nodes=16, n_faulty=2, topology="ring:2",
+                    use_pallas_round=True)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        v0 = c_struct.value
+        warn_structured_demotes_pallas(cfg)
+        warn_structured_demotes_pallas(cfg)
+        assert c_struct.value == v0 + 2, \
+            "the counter must tick on every call, not once per process"
+        v0 = c_debug.value
+        warn_debug_demotes_pallas(cfg)
+        assert c_debug.value == v0 + 1
+
+
+def test_structured_run_ticks_demotion_counter_per_traced_build():
+    # the announcers live inside jitted bodies: one tick per TRACED
+    # demoted executable build — and a warm jit cache re-runs the
+    # executable without re-ticking (both halves of the documented
+    # semantic)
+    c = REGISTRY.counter("sim.demotion.structured")
+    v0 = c.value
+    cfg = SimConfig(n_nodes=16, n_faulty=2, trials=2, topology="ring:2",
+                    max_rounds=4, use_pallas_round=True,
+                    use_pallas_hist=True)
+    state, faults, key = _inputs(cfg)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        run_consensus(cfg, state, faults, key)
+        assert c.value == v0 + 1
+        run_consensus(cfg, state, faults, key)   # jit-cache hit
+    assert c.value == v0 + 1, \
+        "a cached execution must not re-tick (counts builds, not calls)"
+
+
+def test_watch_renders_kernel_telemetry(tmp_path):
+    from benor_tpu.__main__ import _format_kernel_telem
+    from benor_tpu.kernelscope.report import (KERNEL_TELEM_KIND,
+                                              telemetry_record)
+
+    stages = {"proposal": {"counters": {"hist_visits": 7,
+                                        "quorum_passes": 0,
+                                        "coin_draws": 0,
+                                        "plane_hops": 4},
+                           "per_tile": [[7, 0, 0, 4]]},
+              "vote": {"counters": {"hist_visits": 7,
+                                    "quorum_passes": 7, "coin_draws": 2,
+                                    "plane_hops": 8},
+                       "per_tile": [[7, 7, 2, 8]]}}
+    rec = telemetry_record("kernelscope", "two_kernel", stages, 2, 0.5)
+    assert rec["kind"] == KERNEL_TELEM_KIND
+    line = _format_kernel_telem(rec)
+    assert "kernel=two_kernel" in line
+    assert "pad_waste=0.500" in line
+    assert "coins=2" in line
+
+    # end-to-end through the watch CLI (interleaved with a heartbeat;
+    # the done-beat LAST — watch stops at the first done record)
+    from benor_tpu.utils.metrics import append_jsonl
+    path = tmp_path / "mixed.jsonl"
+    append_jsonl(str(path), rec)
+    append_jsonl(str(path), {"kind": "heartbeat", "label": "x",
+                             "done": True})
+    r = subprocess.run(
+        [sys.executable, "-m", "benor_tpu", "watch", str(path),
+         "--no-follow"],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=REPO)
+    assert r.returncode == 0, r.stderr
+    assert "kernel=two_kernel" in r.stdout
+
+
+def test_kernel_telemetry_config_validation():
+    with pytest.raises(ValueError, match="backend='tpu'"):
+        SimConfig(n_nodes=8, n_faulty=0, backend="express",
+                  kernel_telemetry=True)
+    with pytest.raises(ValueError, match="single-device"):
+        SimConfig(n_nodes=8, n_faulty=0, mesh_shape=(1, 2),
+                  kernel_telemetry=True)
+
+
+def test_manifest_kind_registered():
+    from benor_tpu.kernelscope.manifest import KERNEL_MANIFEST_KIND
+
+    cms = _cms()
+    assert cms.MANIFEST_CHECKERS[KERNEL_MANIFEST_KIND] == \
+        "check_kernel_manifest"
+    assert hasattr(cms, "check_kernel_manifest")

@@ -386,6 +386,88 @@ def test_witness_budget_pinned_to_partial_cols(tmp_path):
 
 
 # --------------------------------------------------------------------------
+# telem-layout: mutations of the REAL TELEM_COLS table (PR 14)
+# --------------------------------------------------------------------------
+
+
+_TELEM_COLUMNS = ["active_lanes", "pad_lanes", "sampler_draws",
+                  "hist_visits", "quorum_passes", "coin_draws",
+                  "plane_hops"]
+
+
+def test_telem_layout_clean_on_shipped_table(tmp_path):
+    root = _layout_tree(tmp_path)
+    active, _ = _findings(root, rules=["telem-layout"])
+    assert active == []
+
+
+@pytest.mark.parametrize("column", _TELEM_COLUMNS)
+def test_removing_any_telem_column_fails(tmp_path, column):
+    # acceptance: removing ANY single column from TELEM_COLS (including
+    # the last, which density alone cannot see — the emission-dict
+    # parity catches it) must fail the linter
+    root = _layout_tree(tmp_path)
+    idx = _TELEM_COLUMNS.index(column)
+    _edit(root, "ops/pallas_round.py",
+          f'    "{column}": ({idx}, 1),\n', "", count=1)
+    active, _ = _findings(root, rules=["telem-layout"])
+    assert any(f.rule == "telem-layout" for f in active), \
+        f"dropping telemetry column {column} went unnoticed"
+
+
+def test_telem_overlap_fails(tmp_path):
+    root = _layout_tree(tmp_path)
+    _edit(root, "ops/pallas_round.py",
+          '    "pad_lanes": (1, 1),', '    "pad_lanes": (0, 1),',
+          count=1)
+    active, _ = _findings(root, rules=["telem-layout"])
+    assert any("overlaps" in f.message for f in active)
+
+
+def test_telem_emission_without_declaration_fails(tmp_path):
+    # a column emitted by _telem_cols but missing from the table is
+    # "emitted but undeclared" even when the table stays dense
+    root = _layout_tree(tmp_path)
+    _edit(root, "ops/pallas_round.py",
+          '        "plane_hops": jnp.full((t,), hops, jnp.int32),',
+          '        "plane_hops": jnp.full((t,), hops, jnp.int32),\n'
+          '        "rogue_counter": zeros,', count=1)
+    active, _ = _findings(root, rules=["telem-layout"])
+    assert any("rogue_counter" in f.message for f in active)
+
+
+def test_telem_budget_pinned_to_partial_cols(tmp_path):
+    # widening the telemetry block past the worst-case witness budget
+    # must fail: 108 base+record+witness columns leave only 20
+    root = _layout_tree(tmp_path)
+    _edit(root, "ops/pallas_round.py",
+          '    "plane_hops": (6, 1),', '    "plane_hops": (6, 40),',
+          count=1)
+    active, _ = _findings(root, rules=["telem-layout"])
+    assert any("PARTIAL_COLS" in f.message and "telemetry" in f.message
+               for f in active)
+
+
+def test_telem_hand_constant_is_a_finding(tmp_path):
+    root = _layout_tree(tmp_path)
+    _edit(root, "ops/pallas_round.py",
+          "TELEM_STAGES = (\"proposal\", \"vote\")",
+          "TELEM_STAGES = (\"proposal\", \"vote\")\n_TELEM_PAD_COL = 1",
+          count=1)
+    active, _ = _findings(root, rules=["telem-layout"])
+    assert any("hand-numbered" in f.message
+               and "_TELEM_PAD_COL" in f.message for f in active)
+
+
+def test_deleting_telem_table_is_itself_a_finding(tmp_path):
+    root = _layout_tree(tmp_path)
+    _edit(root, "ops/pallas_round.py", "TELEM_COLS = {",
+          "TELEM_COLS_RENAMED = {", count=1)
+    active, _ = _findings(root, rules=["telem-layout"])
+    assert any("missing" in f.message for f in active)
+
+
+# --------------------------------------------------------------------------
 # pack rules: mutations of the REAL bit-field layout table (PR 8)
 # --------------------------------------------------------------------------
 
@@ -823,6 +905,42 @@ def test_removing_sweep_checker_registration_fails(tmp_path):
     f = active[0]
     assert f.path == "sweepscope/manifest.py"
     assert "'sweep_manifest'" in f.message
+
+
+def _kernel_manifest_tree(tmp_path) -> str:
+    """The real kernelscope manifest builder + the real checker registry
+    in the sibling tools/ dir (the PR-14 twin of _manifest_tree)."""
+    root = tmp_path / "pkg"
+    (root / "kernelscope").mkdir(parents=True)
+    shutil.copy(os.path.join(PKG_DIR, "kernelscope", "manifest.py"),
+                root / "kernelscope" / "manifest.py")
+    (tmp_path / "tools").mkdir()
+    shutil.copy(os.path.join(REPO, "tools", "check_metrics_schema.py"),
+                tmp_path / "tools" / "check_metrics_schema.py")
+    return str(root)
+
+
+def test_kernel_manifest_kind_clean_on_shipped_registry(tmp_path):
+    active, _ = _findings(_kernel_manifest_tree(tmp_path),
+                          rules=["manifest-kind-parity"])
+    assert active == []
+
+
+def test_removing_kernel_checker_registration_fails(tmp_path):
+    """The PR-14 acceptance mutation: un-registering
+    check_kernel_manifest makes the (unchanged) kernelscope emission an
+    unvalidated kind — the manifest-kind-parity lint is what turns the
+    satellite requirement 'register the new kind' into a hard
+    failure."""
+    root = _kernel_manifest_tree(tmp_path)
+    _edit(str(tmp_path), "tools/check_metrics_schema.py",
+          '    "kernel_manifest": "check_kernel_manifest",\n', "",
+          count=1)
+    active, _ = _findings(root, rules=["manifest-kind-parity"])
+    assert len(active) == 1
+    f = active[0]
+    assert f.path == "kernelscope/manifest.py"
+    assert "'kernel_manifest'" in f.message
 
 
 def test_stale_manifest_checker_row_is_a_finding(tmp_path):

@@ -689,6 +689,168 @@ def check_sweep_manifest(manifest: dict,
     return errors
 
 
+KERNEL_SCHEMA_PATH = os.path.join(HERE, "kernel_manifest_schema.json")
+
+#: Stage names every kernel report must carry, in TELEM_STAGES order.
+KERNEL_STAGES = ("proposal", "vote")
+
+
+def _predicted_stage_bytes(geom: dict) -> dict:
+    """Replay perfscope/roofline.stage_traffic's arithmetic from a
+    manifest's committed geometry — pure stdlib, so a hand-edited
+    predicted-bytes block cannot survive this checker (the same
+    recompute-don't-trust discipline as the sweep manifest's headroom).
+    Keep column-for-column in sync with roofline.stage_traffic; the
+    tier-1 parity test (tests/test_kernelscope.py) pins the two equal
+    on a live capture."""
+    t = geom["trials"]
+    plane = t * geom["planes"] * (geom["np_total"] // 32) * 4
+    partial = (geom["tiles"] * t * geom["partial_cols"]
+               * geom["partial_dtype_bytes"])
+    counts = t * 3 * 4
+    vote_plane_passes = 1 if geom["one_pass"] else 2
+    stages = {
+        "proposal": plane + partial + counts,
+        "vote": vote_plane_passes * plane + partial + counts,
+        "reduce": 2 * partial,
+    }
+    stages["total"] = sum(stages.values())
+    return stages
+
+
+def check_kernel_manifest(manifest: dict,
+                          schema_path: str = KERNEL_SCHEMA_PATH
+                          ) -> List[str]:
+    """Validate a kernel manifest (`python -m benor_tpu profile
+    --kernels`, KERNEL_BASELINE.json, bench.py's kernelscope sidecar
+    blob) against tools/kernel_manifest_schema.json; returns the error
+    list (empty = ok).
+
+    ``kernels`` is keyed by kernel name (the perf manifest's dynamic-map
+    indirection), each value validated against the schema file's
+    ``kernel_report`` entry plus the cross-field facts the regression
+    gate relies on: stage blocks exactly {proposal, vote} with counter
+    keys == telem_columns and per-tile rows that SUM to the counters
+    (tiles x columns shape pinned by the geometry); pad_waste_frac
+    recomputed from the proposal counters; predicted bytes recomputed
+    from the geometry via the traffic-model arithmetic; byte_ratio ==
+    predicted total / measured; dispatch consistent with
+    geometry.one_pass; and the fused_vs_xla block's gap == xla - fused
+    with a stage attribution that sums to 1."""
+    errors: List[str] = []
+    with open(schema_path) as fh:
+        schema = json.load(fh)
+    _validate(manifest, schema, "$", errors)
+    if errors:
+        return errors
+    cols = manifest["telem_columns"]
+    report_schema = schema["kernel_report"]
+    stage_schema = schema["stage_block"]
+    if not manifest["kernels"]:
+        return ["$.kernels: a kernel manifest must carry at least one "
+                "kernel report"]
+    for name, rep in manifest["kernels"].items():
+        path = f"$.kernels.{name}"
+        before = len(errors)
+        _validate(rep, report_schema, path, errors)
+        if len(errors) > before:
+            continue    # this kernel's cross-field checks would be noise
+        if rep["kernel"] != name:
+            errors.append(f"{path}: kernel key {name!r} but report says "
+                          f"{rep['kernel']!r}")
+        geom = rep["geometry"]
+        want_dispatch = "one_pass" if geom["one_pass"] else "two_kernel"
+        if rep["dispatch"] != want_dispatch:
+            errors.append(f"{path}: dispatch {rep['dispatch']!r} "
+                          f"contradicts geometry.one_pass="
+                          f"{geom['one_pass']}")
+        stages = rep["stages"]
+        if sorted(stages) != sorted(KERNEL_STAGES):
+            errors.append(f"{path}.stages: {sorted(stages)} != "
+                          f"{sorted(KERNEL_STAGES)}")
+            continue
+        for stage in KERNEL_STAGES:
+            spath = f"{path}.stages.{stage}"
+            blk = stages[stage]
+            before = len(errors)
+            _validate(blk, stage_schema, spath, errors)
+            if len(errors) > before:
+                continue
+            if sorted(blk["counters"]) != sorted(cols):
+                errors.append(f"{spath}.counters: keys "
+                              f"{sorted(blk['counters'])} != "
+                              f"telem_columns {sorted(cols)}")
+                continue
+            if len(blk["per_tile"]) != geom["tiles"]:
+                errors.append(f"{spath}.per_tile: {len(blk['per_tile'])} "
+                              f"rows != geometry.tiles {geom['tiles']}")
+                continue
+            if any(len(row) != len(cols) for row in blk["per_tile"]):
+                errors.append(f"{spath}.per_tile: a row's width != "
+                              f"{len(cols)} telem_columns")
+                continue
+            for j, c in enumerate(cols):
+                want = sum(row[j] for row in blk["per_tile"])
+                if blk["counters"][c] != want:
+                    errors.append(f"{spath}.counters.{c}: "
+                                  f"{blk['counters'][c]} != per-tile "
+                                  f"sum {want}")
+        # the pad-waste recompute reads the proposal counters; when that
+        # stage block failed its own schema validation above, the errors
+        # are already recorded — skip the cross-check instead of
+        # crashing on the malformed block (a checker must always return
+        # its error list, never traceback on the document it indicts)
+        pc = (stages["proposal"].get("counters")
+              if isinstance(stages["proposal"], dict) else None)
+        if isinstance(pc, dict) and \
+                isinstance(pc.get("active_lanes"), int) and \
+                isinstance(pc.get("pad_lanes"), int):
+            tot = pc["active_lanes"] + pc["pad_lanes"]
+            waste = rep["pad_waste_frac"]
+            if tot == 0:
+                if waste is not None:
+                    errors.append(f"{path}.pad_waste_frac: {waste} with "
+                                  f"zero lanes counted")
+            elif waste is None or not _near(waste, pc["pad_lanes"] / tot,
+                                            floor=1e-5):
+                errors.append(f"{path}.pad_waste_frac: {waste} != "
+                              f"pad/(pad+active) "
+                              f"({pc['pad_lanes'] / tot:.6f})")
+        want_pred = _predicted_stage_bytes(geom)
+        if rep["predicted_bytes_per_round"] != want_pred:
+            errors.append(f"{path}.predicted_bytes_per_round: "
+                          f"{rep['predicted_bytes_per_round']} != "
+                          f"recomputed from geometry ({want_pred})")
+        measured = rep["measured_bytes_per_round"]
+        ratio = rep["byte_ratio"]
+        if measured:
+            want_ratio = want_pred["total"] / measured
+            if ratio is None or not _near(ratio, want_ratio, floor=1e-5):
+                errors.append(f"{path}.byte_ratio: {ratio} != "
+                              f"predicted/measured ({want_ratio:.6f})")
+        elif ratio is not None:
+            errors.append(f"{path}.byte_ratio: {ratio} without a "
+                          f"measured_bytes_per_round")
+    fvx = manifest["fused_vs_xla"]
+    if fvx is not None:
+        attr = fvx["stage_attribution"]
+        ssum = sum(v for v in attr.values()
+                   if isinstance(v, (int, float)))
+        if attr and not _near(ssum, 1.0, floor=1e-3):
+            errors.append(f"$.fused_vs_xla.stage_attribution: sums to "
+                          f"{ssum:.4f}, not 1")
+        fb, xb, gap = (fvx["fused_run_bytes"], fvx["xla_run_bytes"],
+                       fvx["gap_bytes"])
+        if fb is not None and xb is not None:
+            if gap is None or not _near(gap, xb - fb, floor=0.5):
+                errors.append(f"$.fused_vs_xla.gap_bytes: {gap} != "
+                              f"xla - fused ({xb - fb})")
+        elif gap is not None:
+            errors.append("$.fused_vs_xla.gap_bytes: present without "
+                          "both run-byte measurements")
+    return errors
+
+
 WITNESS_SCHEMA_PATH = os.path.join(HERE, "witness_bundle_schema.json")
 
 
@@ -736,6 +898,7 @@ def check_witness_bundle(bundle: dict,
 #: below dispatches through the same registry, so "registered" always
 #: means "actually runnable".
 MANIFEST_CHECKERS = {
+    "kernel_manifest": "check_kernel_manifest",
     "perf_manifest": "check_perf_manifest",
     "scaling_manifest": "check_scaling_manifest",
     "serve_manifest": "check_serve_manifest",
