@@ -86,7 +86,7 @@ _DETAIL_KEYS = ("curve", "pallas_check", "pallas_hist_check",
                 "pallas_round_check", "pallas_demoted",
                 "batched_sweep_check", "flight_recorder", "perfscope",
                 "meshscope", "serve", "topo", "sweepscope",
-                "kernelscope", "lint")
+                "kernelscope", "faults", "lint")
 
 
 def _split_headline(out: dict) -> tuple[dict, dict]:
@@ -176,6 +176,15 @@ def _split_headline(out: dict) -> tuple[dict, dict]:
         # audited clean under the relaxed neighborhood invariants; the
         # curves live in the sidecar's topo blob
         head["topo_ok"] = bool(tp.get("ok"))
+    fl = out.get("faults")
+    if isinstance(fl, dict):
+        # ONE compact bool: injection off bit-identical (results +
+        # compile counts) + the rounds-vs-drop_prob curve ran as ONE
+        # bucket executable + the churn/omission/partition points
+        # audited clean under the new invariants (down_silence,
+        # partition-epoch quorum bound); the curves live in the
+        # sidecar's faults blob (kind: faults_manifest)
+        head["faults_ok"] = bool(fl.get("ok"))
     head["detail_file"] = "BENCH_DETAIL.json"
     return head, detail
 
@@ -1093,6 +1102,27 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
     except Exception as e:  # noqa: BLE001
         recorder_check = {"error": f"{type(e).__name__}: {e}"}
     log(f"bench: flight recorder check {recorder_check}")
+    # The serve load test runs BEFORE the heavyweight observatory
+    # captures (perfscope/meshscope/sweepscope AOT-compile dozens of
+    # executables): its 1000-client latency-ATTRIBUTION window is the
+    # one wall-clock-sensitive measurement in the bench, and on slower
+    # hosts the accumulated allocator/GC state of those captures pushes
+    # the unattributed ingress share past gate.ATTRIBUTION_BAND — a
+    # measurement-hygiene artifact, not a serve regression (the same
+    # window run early passes with the committed-baseline coverage).
+    try:
+        serve_check = _serve_check()
+    except Exception as e:  # noqa: BLE001 — accounting must not kill the run
+        serve_check = {"ok": False,
+                       "error": f"{type(e).__name__}: {e}"}
+    m = serve_check.get("manifest", {})
+    log(f"bench: serve check ok={serve_check.get('ok')} "
+        f"clients={m.get('clients')} "
+        f"jobs_per_launch={m.get('jobs_per_launch')} "
+        f"p99_ms={(m.get('latency_ms') or {}).get('p99')} "
+        f"attribution_coverage="
+        f"{(m.get('attribution') or {}).get('coverage')} "
+        f"baseline_comparable={serve_check.get('baseline_comparable')}")
     try:
         perfscope_check = _perfscope_check()
     except Exception as e:  # noqa: BLE001 — accounting must not kill the run
@@ -1109,19 +1139,6 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
     log(f"bench: meshscope check ok={meshscope_check.get('ok')} "
         f"straggler_max={meshscope_check.get('straggler_max')} "
         f"baseline_comparable={meshscope_check.get('baseline_comparable')}")
-    try:
-        serve_check = _serve_check()
-    except Exception as e:  # noqa: BLE001 — accounting must not kill the run
-        serve_check = {"ok": False,
-                       "error": f"{type(e).__name__}: {e}"}
-    m = serve_check.get("manifest", {})
-    log(f"bench: serve check ok={serve_check.get('ok')} "
-        f"clients={m.get('clients')} "
-        f"jobs_per_launch={m.get('jobs_per_launch')} "
-        f"p99_ms={(m.get('latency_ms') or {}).get('p99')} "
-        f"attribution_coverage="
-        f"{(m.get('attribution') or {}).get('coverage')} "
-        f"baseline_comparable={serve_check.get('baseline_comparable')}")
     try:
         sweepscope_check = _sweepscope_check()
     except Exception as e:  # noqa: BLE001 — accounting must not kill the run
@@ -1140,6 +1157,17 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
     except Exception as e:  # noqa: BLE001 — accounting must not kill the run
         topo_check = {"ok": False,
                       "error": f"{type(e).__name__}: {e}"}
+    try:
+        faults_check = _faults_check(seed)
+    except Exception as e:  # noqa: BLE001 — accounting must not kill the run
+        faults_check = {"ok": False,
+                        "error": f"{type(e).__name__}: {e}"}
+    log(f"bench: faults check ok={faults_check.get('ok')} "
+        f"identity={faults_check.get('off_identity')} "
+        f"drop_rows={len(faults_check.get('drop_curve', []))} "
+        f"drop_compiles={faults_check.get('drop_compile_count')} "
+        f"churn_rows={len(faults_check.get('churn_curve', []))} "
+        f"audits={ {k: v.get('ok') for k, v in (faults_check.get('audits') or {}).items()} }")
     log(f"bench: topo check ok={topo_check.get('ok')} "
         f"identity={topo_check.get('complete_identity')} "
         f"degree_rows={len(topo_check.get('degree_curve', []))} "
@@ -1214,6 +1242,7 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
         "meshscope": meshscope_check,
         "serve": serve_check,
         "topo": topo_check,
+        "faults": faults_check,
         "sweepscope": sweepscope_check,
         "kernelscope": kernelscope_check,
         "pallas_demoted": demoted,
@@ -1558,6 +1587,93 @@ def _topo_check(seed: int) -> dict:
             "audit_ok": bool(report.ok),
             "audit_checks": sum(report.checks.values()),
             "audit_violations": len(report.violations)}
+
+
+def _faults_check(seed: int) -> dict:
+    """The faultlab workloads' embedded proof (PR 15, benor_tpu/faults)
+    at a fixed CPU-safe geometry — the ``kind: faults_manifest`` blob
+    (faults/report.py) behind the ``faults_ok`` headline:
+
+      * injection-off identity — a config with every faultlab field at
+        its default IS the pre-faultlab config (same dataclass, same
+        hash), so re-running it must be bit-identical in the science
+        fields AND cost zero new backend compiles (the jit cache hits);
+      * the rounds-vs-drop_prob curve through the batched engine with
+        drop_prob riding DynParams — the whole curve in ONE bucket
+        executable (compile count pinned) — plus the churn curve;
+      * witnessed crash_recover (amnesia churn) and partition points
+        audited CLEAN under the new invariants (down_silence + the
+        partition-epoch quorum-evidence bound, benor_tpu/audit.py).
+
+    Cross-field facts (stall threshold, row ordering, the recomputed ok
+    verdict) are pinned by check_metrics_schema.check_faults_manifest.
+    """
+    from benor_tpu import audit, results
+    from benor_tpu.config import SimConfig
+    from benor_tpu.faults.report import faults_manifest
+    from benor_tpu.sweep import run_point
+    from benor_tpu.utils.compile_counter import count_backend_compiles
+
+    n_f, trials, max_rounds = 64, 16, 24
+    base = SimConfig(n_nodes=n_f, n_faulty=8, trials=trials,
+                     max_rounds=max_rounds, seed=seed, delivery="quorum",
+                     scheduler="uniform", path="histogram")
+    pt0 = run_point(base)
+    with count_backend_compiles() as cc:
+        pt1 = run_point(base.replace(drop_prob=0.0, recovery=None,
+                                     partition=None))
+    identity = {
+        "bit_equal": bool(
+            pt0.rounds_executed == pt1.rounds_executed
+            and pt0.decided_frac == pt1.decided_frac
+            and pt0.mean_k == pt1.mean_k
+            and pt0.ones_frac == pt1.ones_frac
+            and pt0.disagree_frac == pt1.disagree_frac
+            and (pt0.k_hist == pt1.k_hist).all()),
+        "extra_compiles": cc.count,
+    }
+
+    curves = results.faults_curves(n_f, trials, seed=seed,
+                                   max_rounds=max_rounds)
+
+    from benor_tpu.state import FaultSpec
+
+    audits = {}
+    # crash at round 1 so the down intervals BIND (full delivery decides
+    # in round ~1; a later crash would witness an already-settled net)
+    churn_cfg = SimConfig(
+        n_nodes=n_f, n_faulty=8, trials=trials, max_rounds=max_rounds,
+        seed=seed, fault_model="crash_recover",
+        recovery="stagger:1:4:amnesia", witness_trials=(0, 1),
+        witness_nodes=12)
+    rep, _ = audit.audit_point(churn_cfg, label="bench churn amnesia")
+    audits["crash_recover"] = {"ok": bool(rep.ok),
+                               "checks": sum(rep.checks.values()),
+                               "violations": len(rep.violations)}
+    part_cfg = SimConfig(
+        n_nodes=n_f, n_faulty=8, trials=trials, max_rounds=max_rounds,
+        seed=seed, partition="halves:4", witness_trials=(0, 1),
+        witness_nodes=12)
+    rep2, _ = audit.audit_point(part_cfg, label="bench partition halves")
+    audits["partition"] = {"ok": bool(rep2.ok),
+                           "checks": sum(rep2.checks.values()),
+                           "violations": len(rep2.violations)}
+    # zero crashes: the quorum slack F is what absorbs the thinning
+    # (crash faults would pin the live population to N - F exactly and
+    # every receiver would stall — the stall cliff, not omission)
+    drop_cfg = SimConfig(
+        n_nodes=n_f, n_faulty=16, trials=trials, max_rounds=max_rounds,
+        seed=seed, drop_prob=0.05, witness_trials=(0, 1),
+        witness_nodes=12)
+    rep3, _ = audit.audit_point(drop_cfg,
+                                faults=FaultSpec.none(trials, n_f),
+                                label="bench omission")
+    audits["omission"] = {"ok": bool(rep3.ok),
+                          "checks": sum(rep3.checks.values()),
+                          "violations": len(rep3.violations)}
+    blob = faults_manifest(identity, curves, audits)
+    blob.update(n=n_f, trials=trials)
+    return blob
 
 
 def _sweepscope_check() -> dict:

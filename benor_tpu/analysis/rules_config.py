@@ -96,6 +96,58 @@ PARITY_ALLOWLIST = {
     ("committee_cap", "parallel/multihost.py"):
         "multihost delegates the whole loop to sharded._local_slice, "
         "which reaches the same kernel-level committee dispatch",
+    # --- faultlab: the dynamic fault-injection plane (PR 15) -------------
+    # sim.injection_plane consumes fault_model/drop_prob/partition/
+    # recovery.  crash_recover runs INSIDE the shared round kernel
+    # (models/benor.py derives the down mask; ops/pallas_round.py
+    # re-derives it in-kernel and reads cfg.fault_model/cfg.recovery
+    # itself); omission and partitions live in tally.receiver_counts'
+    # delivery='all' branch, reached identically by every regime via
+    # benor_round — the sharded/multihost runners need no plane-specific
+    # code (tests/test_faults.py pins the sharded bit-identity), and the
+    # fused kernels structurally never see the delivery='all' planes
+    # (sim.warn_faults_demote_pallas announces the demotion).
+    ("fault_model", "parallel/sharded.py"):
+        "the crash_recover down mask derives inside the shared round "
+        "kernel (models/benor.py) and the packed slice "
+        "(pallas_round._load_fields) from the FaultSpec bounds — the "
+        "sharded runner passes faults through untouched",
+    ("fault_model", "parallel/multihost.py"):
+        "multihost delegates the whole loop to sharded._local_slice, "
+        "which reaches the same kernel-level fault dispatch",
+    ("drop_prob", "ops/pallas_round.py"):
+        "omission requires delivery='all', which every pallas gate in "
+        "ops/tally.py rejects — the structural demotion "
+        "sim.warn_faults_demote_pallas announces; the thinning lives in "
+        "tally.omission_thin_counts on the XLA loop",
+    ("drop_prob", "parallel/sharded.py"):
+        "the binomial thinning runs inside the shared round kernel "
+        "(tally.receiver_counts) on psum'd global histograms keyed on "
+        "global ids — no sharded-specific code "
+        "(tests/test_faults.py pins the mesh bit-identity)",
+    ("drop_prob", "parallel/multihost.py"):
+        "multihost delegates the whole loop to sharded._local_slice, "
+        "which reaches the same kernel-level omission dispatch",
+    ("partition", "ops/pallas_round.py"):
+        "same structural demotion as drop_prob: partitions require "
+        "delivery='all', rejected by every pallas gate and announced "
+        "by sim.warn_faults_demote_pallas",
+    ("partition", "parallel/sharded.py"):
+        "partition group histograms are per-shard masked sums psum'd "
+        "over the node axis inside tally.partition_counts (and the "
+        "topo gather masks in topo/deliver.py) — no sharded-specific "
+        "code",
+    ("partition", "parallel/multihost.py"):
+        "multihost delegates the whole loop to sharded._local_slice, "
+        "which reaches the same kernel-level partition dispatch",
+    ("recovery", "parallel/sharded.py"):
+        "the recovery schedule is realized into FaultSpec.recover_round "
+        "at the harness boundary (sweep.default_crash_faults); the "
+        "compiled regimes read the bounds, and the amnesia rejoin mode "
+        "is read where it compiles (models/benor.py, ops/pallas_round)",
+    ("recovery", "parallel/multihost.py"):
+        "same as the sharded runner: the schedule travels as "
+        "FaultSpec.recover_round built at the harness boundary",
 }
 
 

@@ -81,8 +81,13 @@ from .state import (WIT_COINED, WIT_COLUMNS, WIT_DECIDED, WIT_KILLED,
 
 #: The audited invariants, in check order — the single source of truth
 #: for reports, the metrics counters and the witness-bundle schema.
+#: ``down_silence`` (PR 15, the faultlab plane): a crash_recover lane
+#: inside its down-interval [crash_round, recover_round) participates in
+#: NOTHING — no decide, no coin commit, no state change — until it
+#: rejoins; irrevocability (above) then keeps holding ACROSS the
+#: recovery, amnesia or not (decisions are durable).
 INVARIANTS = ("agreement", "validity", "irrevocability",
-              "quorum_evidence", "killed_silence")
+              "quorum_evidence", "killed_silence", "down_silence")
 
 
 # --------------------------------------------------------------------------
@@ -121,6 +126,17 @@ class WitnessBundle:
     #: pre-topology bundle) disables the bound — the global quorum
     #: bound stays implied by the decide-bar checks, exactly as before.
     tally_bound: Optional[int] = None
+    #: Faultlab evidence (PR 15).  ``partition``: the run's partition
+    #: spec string (faults/partitions.py grammar) — during the epoch
+    #: (1 <= round < heal_round) every witnessed tally is additionally
+    #: bounded by the watched node's GROUP size (quorum evidence judged
+    #: within the partition epoch); None = no partition, no bound.
+    #: ``down_crash`` / ``down_recover`` (int [W, k] or None): the
+    #: watched lanes' crash_recover down-interval bounds, arming the
+    #: down_silence check; None = no churn schedule.
+    partition: Optional[str] = None
+    down_crash: Optional[np.ndarray] = None
+    down_recover: Optional[np.ndarray] = None
     label: str = ""
 
     @classmethod
@@ -151,11 +167,19 @@ class WitnessBundle:
         if cfg.topology is not None:
             from .topo.graphs import parse_topology
             bound = parse_topology(cfg.topology).degree + 1
+        down_crash = down_recover = None
+        if cfg.fault_model == "crash_recover" and faults is not None \
+                and faults.recover_round is not None:
+            sel = np.ix_(trial_ids, node_ids)
+            down_crash = np.asarray(faults.crash_round)[sel]
+            down_recover = np.asarray(faults.recover_round)[sel]
         return cls(buffer=np.asarray(buffer), trial_ids=trial_ids,
                    node_ids=node_ids, rule=cfg.rule,
                    n_faulty=cfg.n_faulty, n_nodes=cfg.n_nodes,
                    freeze_decided=cfg.freeze_decided, faulty=faulty,
-                   unanimous=unanimous, tally_bound=bound, label=label)
+                   unanimous=unanimous, tally_bound=bound,
+                   partition=cfg.partition, down_crash=down_crash,
+                   down_recover=down_recover, label=label)
 
     def to_dict(self) -> Dict:
         return {
@@ -170,6 +194,13 @@ class WitnessBundle:
                           else int(self.unanimous)),
             "tally_bound": (None if self.tally_bound is None
                             else int(self.tally_bound)),
+            "partition": self.partition,
+            "down_crash": (None if self.down_crash is None
+                           else np.asarray(self.down_crash)
+                           .astype(int).tolist()),
+            "down_recover": (None if self.down_recover is None
+                             else np.asarray(self.down_recover)
+                             .astype(int).tolist()),
             "faulty": (None if self.faulty is None
                        else np.asarray(self.faulty).astype(bool).tolist()),
             "columns": list(WIT_COLUMNS),
@@ -292,6 +323,10 @@ def audit_witness(bundle: WitnessBundle) -> AuditReport:
     violations: List[Violation] = []
     checks = {name: 0 for name in INVARIANTS}
     written = np.nonzero(buf[:, 0, 0, WIT_WRITTEN] > 0)[0]
+    part_spec = None
+    if bundle.partition is not None:
+        from .faults.partitions import parse_partition
+        part_spec = parse_partition(bundle.partition)
 
     # validity ground truth: caller-asserted, or derivable when the
     # witness covers EVERY node (k == n_nodes) and row 0 is unanimous —
@@ -352,6 +387,75 @@ def audit_witness(bundle: WitnessBundle) -> AuditReport:
                         f"(p0+p1={int(p0[oi] + p1[oi])}, "
                         f"v0+v1={int(v0[oi] + v1[oi])}) — forged "
                         "evidence under the topology-relative quorum"))
+
+            # --- partition-epoch tally bound (faultlab, PR 15) ----------
+            # During the epoch (1 <= round < heal_round) a receiver can
+            # tally at most its GROUP: any witnessed phase tally beyond
+            # the group size is forged cross-partition quorum evidence.
+            # Filed under quorum_evidence like the neighborhood bound —
+            # the structural half of the same claim.  Row 0 is the
+            # pre-round snapshot (no tallies) and rounds >= heal_round
+            # see the whole network again.
+            if part_spec is not None:
+                from .faults.partitions import group_size_of
+                checks["quorum_evidence"] += 1
+                gsize = group_size_of(node, bundle.n_nodes, part_spec)
+                p0, p1 = series[:, WIT_P0], series[:, WIT_P1]
+                epoch = (rounds >= 1) & (rounds < part_spec.heal_round)
+                over = np.nonzero(epoch & ((p0 + p1 > gsize) |
+                                           (v0 + v1 > gsize)))[0]
+                for oi in over:
+                    rd = int(rounds[oi])
+                    violations.append(Violation(
+                        "quorum_evidence", trial, rd, [node],
+                        {"round": rd, "p0": int(p0[oi]), "p1": int(p1[oi]),
+                         "v0": int(v0[oi]), "v1": int(v1[oi]),
+                         "group_size": int(gsize),
+                         "heal_round": int(part_spec.heal_round)},
+                        f"trial {trial} node {node} tallied more "
+                        f"messages than its partition group of "
+                        f"{int(gsize)} can deliver at round {rd} "
+                        f"(p0+p1={int(p0[oi] + p1[oi])}, "
+                        f"v0+v1={int(v0[oi] + v1[oi])}; epoch heals at "
+                        f"round {int(part_spec.heal_round)}) — forged "
+                        "cross-partition quorum evidence"))
+
+            # --- down-interval silence (faultlab, PR 15) ----------------
+            # A crash_recover lane inside [crash_round, recover_round)
+            # participates in NOTHING: no coin commit, no decide flip,
+            # no state change — its witnessed rows must equal the last
+            # pre-crash row until the rejoin.
+            if bundle.down_crash is not None:
+                cr_b = int(bundle.down_crash[wi, ki])
+                rv_b = int(bundle.down_recover[wi, ki])
+                if cr_b > 0:
+                    checks["down_silence"] += 1
+                    interval = rounds >= cr_b
+                    if rv_b > 0:
+                        interval = interval & (rounds < rv_b)
+                    before = np.nonzero(rounds < cr_b)[0]
+                    idx = np.nonzero(interval)[0]
+                    if before.size and idx.size:
+                        b0 = int(before[-1])
+                        bad = ((coined[idx]) |
+                               (dec[idx] != dec[b0]) |
+                               (x[idx] != x[b0]))
+                        for oi in np.nonzero(bad)[0]:
+                            rd = int(rounds[idx[oi]])
+                            violations.append(Violation(
+                                "down_silence", trial, rd, [node],
+                                {"round": rd, "crash_round": cr_b,
+                                 "recover_round": rv_b,
+                                 "x_before": int(x[b0]),
+                                 "x": int(x[idx[oi]]),
+                                 "decided_before": bool(dec[b0]),
+                                 "decided": bool(dec[idx[oi]]),
+                                 "coined": bool(coined[idx[oi]])},
+                                f"trial {trial} node {node} "
+                                f"participated at round {rd} inside "
+                                f"its down interval "
+                                f"[{cr_b}, {rv_b if rv_b > 0 else '∞'})"
+                                " — a down lane must be silent"))
 
             # --- irrevocability (node.ts:100,103,147-157) ---------------
             checks["irrevocability"] += 1
@@ -522,9 +626,9 @@ def audit_point(cfg: SimConfig, initial_values=None, faults=None,
     """
     import jax
 
-    from .state import FaultSpec, init_state
+    from .state import init_state
     from .sim import run_consensus
-    from .sweep import random_inputs
+    from .sweep import default_crash_faults, random_inputs
 
     if not cfg.witness:
         raise ValueError(
@@ -533,7 +637,10 @@ def audit_point(cfg: SimConfig, initial_values=None, faults=None,
     if initial_values is None:
         initial_values = random_inputs(cfg.seed, cfg.trials, cfg.n_nodes)
     if faults is None:
-        faults = FaultSpec.first_f(cfg)
+        # run_point's exact default policy (first-F-faulty; crash_recover
+        # realizes the cfg.recovery schedule) so an audited point IS the
+        # swept point
+        faults = default_crash_faults(cfg)
     state = init_state(cfg, initial_values, faults)
     out = run_consensus(cfg, state, faults, jax.random.key(cfg.seed))
     witness = out[-1]
@@ -568,4 +675,10 @@ def load_bundle(path: str) -> WitnessBundle:
         faulty=(None if doc.get("faulty") is None
                 else np.asarray(doc["faulty"], bool)),
         unanimous=doc.get("unanimous"),
-        tally_bound=doc.get("tally_bound"), label=doc.get("label", ""))
+        tally_bound=doc.get("tally_bound"),
+        partition=doc.get("partition"),
+        down_crash=(None if doc.get("down_crash") is None
+                    else np.asarray(doc["down_crash"], np.int64)),
+        down_recover=(None if doc.get("down_recover") is None
+                      else np.asarray(doc["down_recover"], np.int64)),
+        label=doc.get("label", ""))

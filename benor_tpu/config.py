@@ -197,6 +197,46 @@ class SimConfig:
     # --- Monte-Carlo ----------------------------------------------------
     trials: int = 1                   # T — independent MC trials (batch axis)
 
+    # --- dynamic fault-injection plane (benor_tpu/faults, PR 15) ---------
+    # Per-edge iid message OMISSION probability: each (receiver, live
+    # sender) edge independently drops its message with this probability,
+    # per phase per round.  A receiver that clears fewer than N - F
+    # delivered messages STALLS that round (its state freezes — the
+    # per-lane quorum gate in models/benor.py), so rounds-to-decide
+    # climbs with p (results/faults curves).  Folded into the dense
+    # delivery mask (ops/scheduler.py; exact per-edge Bernoulli) and a
+    # closed-form binomial-thinning counts path (ops/tally.py; histogram
+    # path, so N = 1M stays feasible).  A TRACED DynParams axis: a whole
+    # rounds-vs-drop_prob curve compiles as ONE bucket executable
+    # (sweep.run_points_batched).  Requires delivery='all' (omission IS
+    # the delivery adversary — the quorum-subset schedulers model a
+    # different, count-bounded one) on the tpu backend; 0 (default) = off
+    # and bit-identical to the pre-faultlab path in results AND compile
+    # counts.  The fused pallas kernels implement lossless delivery only
+    # (delivery='all' already keeps them off — the structural demotion
+    # sim.warn_faults_demote_pallas announces).
+    drop_prob: float = 0.0
+    # Crash-RECOVERY schedule spec for fault_model='crash_recover'
+    # (grammar in benor_tpu/faults/recovery.py):
+    # 'at:<crash>:<down>[:amnesia|durable]' or
+    # 'stagger:<crash>:<down>[:amnesia|durable]'.  Realized as per-node
+    # (crash_round, recover_round) bounds in FaultSpec; the rejoin
+    # suffix decides whether an undecided rejoiner keeps its volatile x
+    # (durable, the default) or restarts from "?" (amnesia) — decisions
+    # are durable either way, so irrevocability holds across recovery.
+    recovery: Optional[str] = None
+    # Epoch-structured network PARTITION spec (grammar in
+    # benor_tpu/faults/partitions.py): 'halves:<heal_round>' or
+    # 'groups:<g>:<heal_round>' — G contiguous node-id groups whose
+    # cross-group messages are lost until heal_round, realized as
+    # per-round group masks (O(N*G) group histograms / gather masks,
+    # never a dense N x N).  Composes with topology adjacency
+    # (cross-group neighbor edges go silent) and drop_prob (thinning
+    # applies to the group-confined counts).  Requires delivery='all'
+    # and the tpu backend; None (default) = off, bit-identical to the
+    # pre-faultlab path.
+    partition: Optional[str] = None
+
     # --- fault model (N5) -----------------------------------------------
     # 'crash':          faulty nodes dead from birth (reference node.ts:21-26)
     # 'byzantine':      faulty nodes alive but broadcast bit-flipped values
@@ -215,6 +255,14 @@ class SimConfig:
     #                   adversary keys delays on the carried value, which is
     #                   per-edge here).
     # 'crash_at_round': faulty node i dies at the start of round crash_round[i]
+    # 'crash_recover':  faulty node i is DOWN for rounds
+    #                   crash_round[i] <= r < recover_round[i] and then
+    #                   rejoins (recover_round <= 0: never — the
+    #                   crash_at_round limit, and the lane latches
+    #                   killed).  While down it neither sends nor
+    #                   tallies; its (x, decided, k) freeze.  The rejoin
+    #                   mode (durable x vs amnesia-to-"?") rides the
+    #                   ``recovery`` spec.  benor_tpu/faults/recovery.py.
     fault_model: str = "crash"
 
     # --- state-machine shape -------------------------------------------
@@ -355,8 +403,79 @@ class SimConfig:
         if self.path not in ("auto", "dense", "histogram"):
             raise ValueError(f"unknown path: {self.path}")
         if self.fault_model not in ("crash", "byzantine", "equivocate",
-                                    "crash_at_round"):
+                                    "crash_at_round", "crash_recover"):
             raise ValueError(f"unknown fault_model: {self.fault_model}")
+        if self.recovery is not None:
+            from .faults.recovery import parse_recovery
+            parse_recovery(self.recovery)     # ValueError if malformed
+            if self.fault_model != "crash_recover":
+                raise ValueError(
+                    "recovery schedules only apply to "
+                    "fault_model='crash_recover' (the static fault "
+                    f"models have no rejoin; got {self.fault_model!r})")
+        if self.fault_model == "crash_recover" and self.backend != "tpu":
+            raise ValueError(
+                "fault_model='crash_recover' re-derives liveness from "
+                "per-round down-intervals inside the device round loop; "
+                "the event-loop oracles only implement permanent "
+                "crashes — a silent downgrade would fake churn, so use "
+                "backend='tpu'")
+        if not (0.0 <= self.drop_prob < 1.0):
+            raise ValueError(
+                "drop_prob must be in [0, 1) — at 1.0 no message ever "
+                f"arrives and every round stalls forever (got "
+                f"{self.drop_prob})")
+        if self.drop_prob:
+            if self.delivery != "all":
+                raise ValueError(
+                    "drop_prob models omission on the deterministic "
+                    "full-delivery plane; the quorum-subset schedulers "
+                    "model a different (count-bounded) adversary and do "
+                    "not compose — use delivery='all'")
+            if self.backend != "tpu":
+                raise ValueError(
+                    "drop_prob thins the device delivery plane; the "
+                    "event-loop oracles deliver losslessly — a silent "
+                    "no-op would fake omission, so use backend='tpu'")
+            if self.fault_model == "equivocate":
+                raise ValueError(
+                    "drop_prob is not supported with "
+                    "fault_model='equivocate' (per-edge equivocator "
+                    "bits and per-edge drops would need a joint "
+                    "edge-level model the histogram path cannot thin "
+                    "in closed form)")
+            if self.topology is not None or self.committee_cap:
+                raise ValueError(
+                    "drop_prob composes with the complete graph (and "
+                    "the partition plane) only; the structured "
+                    "delivery planes carry their own edge semantics — "
+                    "drop topology/committee_* or drop_prob")
+        if self.partition is not None:
+            from .faults.partitions import parse_partition
+            pspec = parse_partition(self.partition)   # ValueError if bad
+            pspec.validate(self.n_nodes)
+            if self.delivery != "all":
+                raise ValueError(
+                    "partition replaces full delivery with per-epoch "
+                    "group masks; the quorum-subset delivery model has "
+                    "no meaning on it — use delivery='all'")
+            if self.backend != "tpu":
+                raise ValueError(
+                    "partition runs the device delivery plane "
+                    "(benor_tpu/faults); the event-loop oracles only "
+                    "implement the whole network — a silent no-op "
+                    "would fake the split, so use backend='tpu'")
+            if self.fault_model == "equivocate":
+                raise ValueError(
+                    "partition is not supported with "
+                    "fault_model='equivocate' (per-edge equivocator "
+                    "bits are complete-graph / topology machinery and "
+                    "do not compose with group masks)")
+            if self.committee_cap:
+                raise ValueError(
+                    "partition and committee delivery are mutually "
+                    "exclusive planes (committees already sample WHO "
+                    "tallies whom per round); arm one")
         if self.fault_model == "equivocate" and self.scheduler == "biased":
             raise ValueError(
                 "fault_model='equivocate' is not supported with "
@@ -455,14 +574,15 @@ class SimConfig:
                 "event-loop oracles run to termination in one drain — a "
                 "silent no-op would fake mid-run observability, so use "
                 "backend='tpu'")
-        if self.use_pallas_round and self.max_rounds + 1 >= (1 << 26):
+        if self.use_pallas_round and self.max_rounds + 1 >= (1 << 25):
             # the packed bit-plane layout (state.PACK_LAYOUT) caps the
-            # round counter k at 26 planes; k reaches max_rounds + 1, so
-            # its bit length must fit the declared width
+            # round counter k at 25 planes (PR 15 spent one plane on the
+            # crash-recovery down bit); k reaches max_rounds + 1, so its
+            # bit length must fit the declared width
             raise ValueError(
                 "use_pallas_round packs the round counter k into at most "
-                "26 bit-planes (state.PACK_LAYOUT['k']); max_rounds must "
-                f"be < 2**26 - 1 (got {self.max_rounds})")
+                "25 bit-planes (state.PACK_LAYOUT['k']); max_rounds must "
+                f"be < 2**25 - 1 (got {self.max_rounds})")
         if self.witness_trials is not None:
             # normalize to a sorted unique tuple: the config must stay
             # hashable (jit-static) and the witness row layout deterministic

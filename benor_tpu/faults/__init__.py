@@ -1,0 +1,32 @@
+"""faultlab: the dynamic fault-injection plane (PR 15).
+
+Three fault families the static models ('crash' / 'crash_at_round' /
+'byzantine' / 'equivocate') cannot express, each a first-class,
+sweepable, AUDITED axis of every compiled regime:
+
+  * crash-recovery churn — ``SimConfig(fault_model='crash_recover',
+    recovery='stagger:2:3:amnesia')``: per-node down-intervals with
+    durable-vs-amnesia rejoin (``recovery.py``; the packed pallas path
+    re-derives liveness from the round bounds in-kernel);
+  * per-edge message omission — ``SimConfig(drop_prob=p)``: iid drops
+    folded into the dense delivery mask / binomial-thinned counts on
+    the histogram path, with ``drop_prob`` a traced DynParams axis so a
+    whole rounds-vs-p curve is ONE bucket executable (``curves.py``);
+  * healing partitions — ``SimConfig(partition='halves:<heal_round>')``:
+    epoch-structured group masks composing with topology adjacency,
+    never a dense N x N (``partitions.py``).
+
+Injection off is bit-identical in results AND compile counts across all
+five regimes (the house rule, pinned by tests/test_faults.py), and
+benor_tpu/audit.py machine-checks the matching invariants (down-interval
+silence, irrevocability across recovery, partition-epoch tally bounds).
+"""
+
+from .partitions import (PartitionSpec, group_of, group_size_of,
+                         parse_partition)
+from .recovery import (REJOIN_MODES, RecoverySpec, crash_recover_faults,
+                       parse_recovery, rejoin_mode)
+
+__all__ = ["PartitionSpec", "group_of", "group_size_of",
+           "parse_partition", "REJOIN_MODES", "RecoverySpec",
+           "crash_recover_faults", "parse_recovery", "rejoin_mode"]

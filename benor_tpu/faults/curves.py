@@ -1,0 +1,93 @@
+"""The faultlab science rows: rounds-to-decide vs drop_prob / churn.
+
+Ben-Or's headline claim is probabilistic termination UNDER ADVERSITY;
+these curves stress it along the two dynamic-fault axes PR 15 adds:
+
+  * ``drop_curve`` — rounds-to-decide vs per-edge omission probability.
+    ``drop_prob`` is a traced DynParams axis, so the WHOLE curve
+    compiles as ONE bucket executable through sweep.run_points_batched
+    (the coalescing proof bench's ``faults`` blob pins via the returned
+    compile count): as p grows, receivers clear the N - F bar less
+    often, stall more rounds, and mean rounds-to-decide climbs until
+    the round cap truncates it.
+  * ``churn_curve`` — rounds-to-decide vs crash-recovery churn: a
+    ``stagger:<crash>:<down>`` schedule per point with growing down
+    length.  The recovery spec is STATIC config (it shapes the fault
+    masks), so each point is its own bucket — the engine still batches
+    the list in one call and the per-point oracle bit-equality holds.
+
+Both run the batched engine end to end, so journal/heartbeat/sweepscope
+all apply unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+from ..config import SimConfig
+
+
+def drop_curve(base: SimConfig, drop_probs: Sequence[float],
+               verbose: bool = False) -> Tuple[List[Dict], object]:
+    """Rounds-to-decide vs omission probability -> (json rows, the
+    BatchedCurve).  Every point must arm the omission plane
+    (drop_prob > 0): p = 0 is the injection-off config, which buckets
+    separately by design (the off path must stay bit-identical to the
+    pre-faultlab executable) — callers wanting the baseline run it as
+    its own point.
+
+    Runs with ZERO crashes (FaultSpec.none — the coin_comparison
+    pattern): crash-from-birth faults pin the live population to the
+    quorum N - F exactly, so ANY drop would stall every receiver and
+    the curve would measure the stall cliff, not omission.  With all N
+    alive the quorum slack F absorbs the thinning, and the delivered
+    count crosses the bar at the sharp threshold p ~ F/N."""
+    from ..state import FaultSpec
+    from ..sweep import run_points_batched
+
+    ps = [float(p) for p in drop_probs]
+    if any(p <= 0.0 for p in ps):
+        raise ValueError(
+            "drop_curve sweeps the ARMED omission plane (drop_prob > 0); "
+            "p = 0 is the injection-off config and buckets separately — "
+            "run it as its own baseline point")
+    cfgs = [base.replace(drop_prob=p) for p in ps]
+    T, N = base.trials, base.n_nodes
+    cb = run_points_batched(base.replace(drop_prob=ps[0]), cfgs,
+                            faults_for=lambda c: FaultSpec.none(T, N),
+                            verbose=verbose)
+    rows = [{"drop_prob": p, "n_nodes": pt.n_nodes,
+             "n_faulty": pt.n_faulty, "trials": pt.trials,
+             "mean_k": pt.mean_k, "decided_frac": pt.decided_frac,
+             "rounds_executed": pt.rounds_executed}
+            for p, pt in zip(ps, cb.points)]
+    return rows, cb
+
+
+def churn_curve(base: SimConfig, down_lengths: Sequence[int],
+                crash_round: int = 2,
+                verbose: bool = False) -> Tuple[List[Dict], object]:
+    """Rounds-to-decide vs churn severity -> (json rows, BatchedCurve).
+
+    Each point runs ``fault_model='crash_recover'`` under a rolling
+    ``stagger:<crash_round>:<down>`` schedule; the down length is the
+    severity axis (0 rounds down = the static crash_at_round limit is
+    EXCLUDED — it never rejoins and measures a different plane)."""
+    from ..sweep import run_points_batched
+
+    downs = [int(d) for d in down_lengths]
+    if any(d < 1 for d in downs):
+        raise ValueError("churn_curve needs down lengths >= 1 (a lane "
+                         "that never rejoins is crash_at_round, not "
+                         "churn)")
+    cfgs = [base.replace(fault_model="crash_recover",
+                         recovery=f"stagger:{int(crash_round)}:{d}")
+            for d in downs]
+    cb = run_points_batched(cfgs[0], cfgs, verbose=verbose)
+    rows = [{"down_rounds": d, "recovery": c.recovery,
+             "n_nodes": pt.n_nodes, "n_faulty": pt.n_faulty,
+             "trials": pt.trials, "mean_k": pt.mean_k,
+             "decided_frac": pt.decided_frac,
+             "rounds_executed": pt.rounds_executed}
+            for d, c, pt in zip(downs, cfgs, cb.points)]
+    return rows, cb

@@ -1,0 +1,160 @@
+"""Crash-recovery schedules: the ``fault_model='crash_recover'`` plane.
+
+The static fault models ('crash' / 'crash_at_round') only ever SUBTRACT
+nodes: a lane that dies stays dead, so every run's live population is
+monotone non-increasing and the quorum gate can only stall harder over
+time.  Real deployments churn — nodes crash, reboot and REJOIN, with or
+without their volatile state ("Simulating BFT Protocol Implementations
+at Scale" makes exactly this scenario breadth the point of simulating at
+scale).  ``crash_recover`` adds per-node DOWN-INTERVALS:
+
+  * lane i is down for rounds ``crash_round[i] <= r < recover_round[i]``
+    (``recover_round <= 0`` means it never rejoins — exactly
+    'crash_at_round' semantics, and the lane latches ``killed``);
+  * while down the lane neither sends nor tallies: its (x, decided, k)
+    freeze, it drops out of the alive count (churn below the quorum
+    stalls the whole trial's round, like the reference's receivers
+    waiting for fetches that never come), and the auditor's
+    ``down_silence`` invariant (benor_tpu/audit.py) machine-checks that
+    no decide or coin commit is ever witnessed inside the interval;
+  * at ``r == recover_round`` the lane is back: under the ``durable``
+    rejoin mode it resumes with the x it crashed with (stable storage);
+    under ``amnesia`` an UNDECIDED rejoiner forgets its volatile value
+    and restarts from "?" — decisions are always durable (written before
+    the decide is announced), so irrevocability holds ACROSS recovery
+    and the auditor keeps checking it.
+
+The schedule is a SPEC STRING (``SimConfig.recovery``) so every entry
+path — sweep.default_crash_faults, the serve plane's job documents, the
+CLI — derives the identical FaultSpec from the config alone:
+
+    at:<crash>:<down>[:amnesia|durable]
+        every faulty lane crashes at round <crash> and rejoins <down>
+        rounds later (<down> = 0: never — the crash_at_round limit).
+    stagger:<crash>:<down>[:amnesia|durable]
+        rolling churn: the j-th faulty lane (j = 0..F-1 in id order)
+        crashes at round <crash> + j and rejoins <down> rounds later —
+        at any instant ~min(down, F) lanes are down, a moving hole in
+        the quorum.
+
+Parsing is stdlib-only (like topo/graphs.py) so jax-free tools can
+re-derive schedules; the FaultSpec builder imports jax lazily.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+#: The two rejoin modes.  'durable': state survives the crash; 'amnesia':
+#: the volatile x restarts at "?" (decisions are durable either way).
+REJOIN_MODES = ("durable", "amnesia")
+
+
+@dataclasses.dataclass(frozen=True)
+class RecoverySpec:
+    """One parsed recovery schedule."""
+
+    kind: str       # 'at' | 'stagger'
+    crash: int      # first crash round (1-based, like message k)
+    down: int       # rounds down before rejoin; 0 = never rejoins
+    rejoin: str     # 'durable' | 'amnesia'
+    spec: str       # the original spec string (bucket keys, reports)
+
+    def validate(self) -> None:
+        if self.crash < 1:
+            raise ValueError(
+                f"recovery spec {self.spec!r}: crash round must be >= 1 "
+                "(round indices are 1-based, like the message k)")
+        if self.down < 0:
+            raise ValueError(
+                f"recovery spec {self.spec!r}: down length must be >= 0 "
+                "(0 = the lane never rejoins)")
+
+    def rounds(self, n_faulty: int) -> Tuple[list, list]:
+        """(crash_rounds, recover_rounds) for the F faulty lanes in id
+        order — plain ints, the schedule every harness realizes."""
+        if self.kind == "at":
+            crash = [self.crash] * n_faulty
+        else:                                   # stagger
+            crash = [self.crash + j for j in range(n_faulty)]
+        recover = [(c + self.down) if self.down > 0 else 0 for c in crash]
+        return crash, recover
+
+
+def parse_recovery(spec: Optional[str]) -> Optional[RecoverySpec]:
+    """Spec string -> RecoverySpec; None passes through (no schedule).
+
+    Raises ValueError on malformed specs — the same fail-loudly contract
+    as topo/graphs.parse_topology, so SimConfig validation (and the serve
+    plane's structured 400s) surface the grammar error verbatim.
+    """
+    if spec is None:
+        return None
+    parts = str(spec).split(":")
+    kind = parts[0]
+    if kind not in ("at", "stagger"):
+        raise ValueError(
+            f"unknown recovery spec {spec!r}: grammar is "
+            "'at:<crash>:<down>[:amnesia|durable]' or "
+            "'stagger:<crash>:<down>[:amnesia|durable]'")
+    rejoin = "durable"
+    body = parts[1:]
+    if body and body[-1] in REJOIN_MODES:
+        rejoin = body[-1]
+        body = body[:-1]
+    if len(body) != 2:
+        raise ValueError(
+            f"recovery spec {spec!r}: expected "
+            f"'{kind}:<crash>:<down>[:amnesia|durable]'")
+    try:
+        crash, down = int(body[0]), int(body[1])
+    except ValueError:
+        raise ValueError(
+            f"recovery spec {spec!r}: <crash> and <down> must be "
+            "integers") from None
+    out = RecoverySpec(kind=kind, crash=crash, down=down, rejoin=rejoin,
+                       spec=str(spec))
+    out.validate()
+    return out
+
+
+def rejoin_mode(spec: Optional[str]) -> str:
+    """The (static) rejoin mode a config's recovery spec declares —
+    'durable' when no spec is set.  The one switch the compiled regimes
+    (models/benor.py, ops/pallas_round.py) key the amnesia reset on."""
+    parsed = parse_recovery(spec)
+    return parsed.rejoin if parsed is not None else "durable"
+
+
+def crash_recover_faults(cfg):
+    """The default fault policy for ``fault_model='crash_recover'``: the
+    first F lanes faulty (the canonical mask — lanes are exchangeable
+    under the uniform scheduler), with down-intervals realized from
+    ``cfg.recovery``.  The single policy sweep.default_crash_faults and
+    the serve plane's job inputs share, so "same SimConfig" means the
+    same churn schedule on every entry path."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..state import FaultSpec
+
+    spec = parse_recovery(cfg.recovery)
+    if spec is None:
+        raise ValueError(
+            "fault_model='crash_recover' needs a recovery schedule: set "
+            "SimConfig(recovery='at:<crash>:<down>[:amnesia|durable]') "
+            "or pass an explicit FaultSpec with recover_round")
+    f = cfg.n_faulty
+    mask = np.zeros(cfg.n_nodes, bool)
+    mask[:f] = True
+    crash, recover = spec.rounds(f)
+    cr = np.zeros(cfg.n_nodes, np.int32)
+    rr = np.zeros(cfg.n_nodes, np.int32)
+    cr[:f] = crash
+    rr[:f] = recover
+    shape = (cfg.trials, cfg.n_nodes)
+    return FaultSpec(
+        faulty=jnp.broadcast_to(jnp.asarray(mask), shape),
+        crash_round=jnp.broadcast_to(jnp.asarray(cr), shape),
+        recover_round=jnp.broadcast_to(jnp.asarray(rr), shape))

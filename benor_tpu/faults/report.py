@@ -1,0 +1,51 @@
+"""The faultlab proof document: bench.py's ``faults`` sidecar blob.
+
+One pinned-schema ``kind: faults_manifest`` dict assembling the three
+facts the ``faults_ok`` headline rests on — injection-off bit-identity
+(results AND compile counts: the off config IS the pre-faultlab config,
+so the jit cache must simply hit), the one-bucket omission-curve
+coalescing claim (drop_prob rides DynParams), and clean audits across
+the new fault families (down_silence + the partition-epoch quorum
+bound, benor_tpu/audit.py).  ``tools/check_metrics_schema.py``
+registers ``check_faults_manifest`` for this kind in its
+MANIFEST_CHECKERS dispatch — the PR 13 manifest-kind-parity lint
+(analysis/rules_manifest.py) fails the build if this emission ever
+loses its checker — and recomputes the stall threshold, curve ordering
+and the ok verdict from the parts.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+#: The manifest kind (MANIFEST_CHECKERS key; the manifest-kind-parity
+#: lint re-parses this constant).
+FAULTS_KIND = "faults_manifest"
+
+
+def faults_manifest(identity: Dict, curves: Dict, audits: Dict) -> Dict:
+    """Assemble the blob from its measured parts.
+
+    ``identity``: {'bit_equal': bool, 'extra_compiles': int} — the
+    injection-off rerun vs the plain config; ``curves``: the
+    results.faults_curves dict (drop/churn rows + compile counts);
+    ``audits``: label -> {'ok', 'checks', 'violations'} per audited
+    fault family.  ``ok`` is derived here and re-derived by the checker,
+    so a hand-edited verdict cannot survive.
+    """
+    ok = (bool(identity.get("bit_equal"))
+          and identity.get("extra_compiles") == 0
+          and len(curves.get("drop_curve", [])) > 0
+          and len(curves.get("churn_curve", [])) > 0
+          and curves.get("drop_compile_count") == 1
+          and all(bool(a.get("ok")) for a in audits.values())
+          and len(audits) > 0)
+    return {
+        "kind": FAULTS_KIND,
+        "ok": bool(ok),
+        "off_identity": dict(identity),
+        **{k: curves[k] for k in ("drop_curve", "drop_compile_count",
+                                  "drop_buckets", "churn_curve",
+                                  "churn_compile_count")},
+        "audits": {k: dict(v) for k, v in audits.items()},
+    }

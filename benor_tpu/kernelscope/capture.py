@@ -125,9 +125,8 @@ def _one_round_bytes(cfg, state, faults, key) -> Optional[float]:
     st = start_state(cfg, state)
     pack = pr.pack_state(cfg, st, faults.faulty)
     np_total = pack.shape[2] * pr.PACK_NODES_PER_WORD
-    cr = (pr._pad_cr(faults, np_total)
-          if cfg.fault_model == "crash_at_round" else None)
-    hist1 = pr.sent_hist_from_pack(cfg, pack, cr, 1, SINGLE)
+    cr, rec = pr.pad_fault_rounds(cfg, faults, np_total)
+    hist1 = pr.sent_hist_from_pack(cfg, pack, cr, rec, 1, SINGLE)
     n_local = cfg.n_nodes
 
     def one_round(pack, hist1, key):

@@ -122,9 +122,8 @@ def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
         from ..ops import pallas_round as pr
         pack = pr.pack_state(cfg, state, faults.faulty)
         np_total = pack.shape[2] * pr.PACK_NODES_PER_WORD
-        cr = (pr._pad_cr(faults, np_total)
-              if cfg.fault_model == "crash_at_round" else None)
-        hist1 = pr.sent_hist_from_pack(cfg, pack, cr, r, ctx)
+        cr, rec = pr.pad_fault_rounds(cfg, faults, np_total)
+        hist1 = pr.sent_hist_from_pack(cfg, pack, cr, rec, r, ctx)
         # [:5] — under cfg.kernel_telemetry packed_round appends the
         # per-tile stage counters; this per-round wrapper has no run
         # accumulator to add them to (the packed loop carries one), so
@@ -143,12 +142,46 @@ def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
 
     # --- crash-at-round fault injection (start of round) -----------------
     killed = state.killed
+    x_cur = state.x
+    down = None
     if cfg.fault_model == "crash_at_round":
         crashing = faults.faulty & (faults.crash_round > 0) & \
             (r >= faults.crash_round)
         killed = killed | crashing
+    elif cfg.fault_model == "crash_recover":
+        # Down-intervals (benor_tpu/faults/recovery.py): a lane whose
+        # schedule never rejoins (recover_round <= 0) latches ``killed``
+        # exactly like crash_at_round; a lane inside
+        # [crash_round, recover_round) is DOWN for this round only —
+        # liveness re-derives from the bounds every round (never loop
+        # history), so sliced/resumed runs stay bit-identical.
+        if faults.recover_round is None:
+            raise ValueError(
+                "fault_model='crash_recover' needs FaultSpec."
+                "recover_round (build the spec via "
+                "faults.recovery.crash_recover_faults or "
+                "FaultSpec.from_faulty_list(..., recover_rounds=...))")
+        cr, rr = faults.crash_round, faults.recover_round
+        started = faults.faulty & (cr > 0) & (r >= cr)
+        killed = killed | (started & (rr <= 0))          # never rejoins
+        down = started & (rr > 0) & (r < rr)
+        if cfg.recovery is not None:
+            from ..faults.recovery import rejoin_mode
+            if rejoin_mode(cfg.recovery) == "amnesia":
+                # the volatile x did not survive the crash: an UNDECIDED
+                # rejoiner restarts from "?" at its first round back
+                # (decisions are durable, written before the decide is
+                # announced — irrevocability holds across recovery).
+                # cr > 0 guards lanes with a recover bound but no crash
+                # (a spec hand-built past from_faulty_list): no crash,
+                # nothing to forget
+                rejoin_now = faults.faulty & (cr > 0) & (rr > 0) & \
+                    (r == rr) & ~state.decided
+                x_cur = jnp.where(rejoin_now, jnp.int8(VALQ), x_cur)
 
     alive = ~killed                                          # senders this round
+    if down is not None:
+        alive = alive & ~down
     n_alive = ctx.psum_nodes(
         jnp.sum(alive, axis=-1, dtype=jnp.int32))            # [T] global
     # Quorum gate: a tally only ever fires if >= N-F messages can arrive
@@ -192,7 +225,7 @@ def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
     n_equiv = ctx.psum_nodes(
         jnp.sum(equiv & alive, axis=-1, dtype=jnp.int32)) \
         if equiv is not None else None
-    sent1 = _sent_values(cfg, state.x, faults)
+    sent1 = _sent_values(cfg, x_cur, faults)
     if member is not None:
         cnt1 = committees.committee_counts(cfg, sent1, alive & member,
                                            com_id, ctx)
@@ -210,7 +243,7 @@ def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
     # keeps vouching for its decided value (the reference's decided nodes keep
     # broadcasting forever, node.ts:147-157 — freezing the lane must not
     # starve its peers' quorums).
-    vote_val = jnp.where(frozen, state.x, x1)
+    vote_val = jnp.where(frozen, x_cur, x1)
     sent2 = _sent_values(cfg, vote_val, faults)
     if member is not None:
         cnt2 = committees.committee_counts(cfg, sent2, alive & member,
@@ -220,6 +253,37 @@ def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
                                      sent2, alive, ctx, alive_g,
                                      equiv, equiv_g, n_equiv, dyn)
     v0, v1 = cnt2[..., 0], cnt2[..., 1]
+
+    # --- faultlab per-lane quorum gate (benor_tpu/faults, PR 15) ---------
+    # Omission / partitions make the DELIVERED count per-receiver random
+    # (thinned) or group-bounded: a receiver that clears fewer than the
+    # quorum N - F messages in either phase stalls this round — the
+    # reference's node waiting on fetches that never arrive
+    # (node.ts:52,88), now per lane instead of per trial.  The gate
+    # governs COMMITS only: a stalled lane's phase-2 broadcast (built
+    # from its sub-quorum phase-1 tally above) still reaches its peers
+    # this round — the round-synchronous approximation the framework
+    # has ALWAYS made (under quorum delivery, too, every alive lane
+    # broadcasts both phases regardless of what its scheduler
+    # delivered; the reference's blocked node would stay silent).
+    # Modeling per-lane send-side coupling would make the phase-2
+    # histogram a per-receiver random variable with cross-lane
+    # dependencies — intractable in the O(N) closed forms — so the
+    # approximation is documented rather than hidden (README "Fault &
+    # adversary matrix").  Under an adjacency topology the wait bar
+    # relativizes like the decide rule: d + 1 - F of the d + 1
+    # neighborhood (the complete graph's N - F of N, degree-scaled).
+    # Static gate: injection off never traces this, so off stays
+    # bit-identical.
+    if cfg.drop_prob or cfg.partition is not None:
+        if cfg.topology is not None:
+            from ..topo.graphs import parse_topology
+            bar = parse_topology(cfg.topology).degree + 1 - F
+        else:
+            bar = m
+        cleared = (jnp.sum(cnt1, axis=-1) >= bar) & \
+            (jnp.sum(cnt2, axis=-1) >= bar)
+        active = active & cleared
 
     decide0 = v0 > F                                         # node.ts:99
     decide1 = v1 > F                                         # node.ts:102
@@ -273,7 +337,7 @@ def benor_round(cfg: SimConfig, state: NetState, faults: FaultSpec,
     newly_decided = active & (decide0 | decide1)
 
     # --- commit (node.ts:100-103, 147) -----------------------------------
-    new_x = jnp.where(active, x2, state.x)
+    new_x = jnp.where(active, x2, x_cur)
     new_decided = state.decided | newly_decided
     # k <- k+1 after the vote tally, unconditionally for lanes that ran the
     # round — including the round in which they decide (node.ts:147 runs

@@ -73,8 +73,8 @@ from .pallas_hist import (_COIN_SALT, _EQUIV_SALT_OFFSET, TILE_N,
                           _bits_to_uniform, _cf_draw, _lane_ids,
                           _ndtri_as241, _stream_scal, _threefry2x32)
 from ..config import VAL0, VAL1, VALQ
-from ..state import (NetState, PACK_COINED, PACK_DECIDED, PACK_FAULTY,
-                     PACK_K, PACK_KILLED, PACK_LAYOUT,
+from ..state import (NetState, PACK_COINED, PACK_DECIDED, PACK_DOWN,
+                     PACK_FAULTY, PACK_K, PACK_KILLED, PACK_LAYOUT,
                      PACK_NODES_PER_WORD, PACK_STATIC_WIDTH, PACK_X,
                      pack_k_bits)
 from ..perfscope.instrument import instrumented_jit
@@ -409,6 +409,10 @@ def pack_state(cfg, state: NetState, faulty: jax.Array) -> jax.Array:
     planes[PACK_KILLED] = kil
     planes[PACK_COINED] = jnp.zeros_like(dec)
     planes[PACK_FAULTY] = fau
+    # forensic down-interval bit (crash_recover): no round has run, so
+    # the stored plane starts clear — the kernels re-derive liveness
+    # from the (crash_round, recover_round) bounds, never from this bit
+    planes[PACK_DOWN] = jnp.zeros_like(dec)
     for b in range(kb):
         planes[PACK_K + b] = (k >> b) & 1
     j = jnp.arange(PACK_NODES_PER_WORD, dtype=jnp.uint32)
@@ -459,30 +463,56 @@ def _kfield(w, base, width):
     return val.reshape(t, tw * PACK_NODES_PER_WORD).astype(jnp.int32)
 
 
-def _load_fields(p, kbits, rr, cr, fault_model, freeze):
-    """Loaded plane block + the crash-at-round update, in-kernel.
+def _load_fields(p, kbits, rr, cr, rcv, fault_model, freeze,
+                 rejoin="durable"):
+    """Loaded plane block + the crash-at-round / crash-recover update,
+    in-kernel.
 
-    Returns (x, decided, killed_now, faulty, k, alive, frozen) — all
-    per-lane int32 [T, TILE] except the bool masks (the same contract the
-    old int32-word ``_fields`` had, so the phase logic is unchanged)."""
+    Returns (x, decided, killed_now, faulty, k, alive, frozen, down) —
+    all per-lane int32 [T, TILE] except the bool masks (the same
+    contract the old int32-word ``_fields`` had, so the phase logic is
+    unchanged).  ``killed_now`` is the STORED killed bit (latched
+    permanent deaths only); under 'crash_recover' the per-round
+    down-interval membership is the separate ``down`` mask, re-derived
+    from the (cr, rcv) round bounds — never read back from the stack —
+    so sliced/resumed runs are bit-identical to one-shot runs.  With
+    ``rejoin='amnesia'`` an UNDECIDED lane at its first round back
+    (rr == rcv) restarts x from "?" before any phase logic (decisions
+    are durable: irrevocability holds across recovery)."""
     x = _kfield(p, PACK_X, _X_BITS)
     decided = _kfield(p, PACK_DECIDED, 1)
     killed = _kfield(p, PACK_KILLED, 1)
     faulty = _kfield(p, PACK_FAULTY, 1)
     k = _kfield(p, PACK_K, kbits)
+    down = None
     if fault_model == "crash_at_round":
         crashing = (faulty == 1) & (cr > 0) & (rr >= cr)
         killed = jnp.where(crashing, 1, killed)
+    elif fault_model == "crash_recover":
+        started = (faulty == 1) & (cr > 0) & (rr >= cr)
+        killed = jnp.where(started & (rcv <= 0), 1, killed)
+        down = started & (rcv > 0) & (rr < rcv)
+        if rejoin == "amnesia":
+            # cr > 0: no crash, nothing to forget (mirrors the XLA path)
+            rj = (faulty == 1) & (cr > 0) & (rcv > 0) & (rr == rcv) & \
+                (decided == 0)
+            x = jnp.where(rj, VALQ, x)
     alive = killed == 0
+    if down is not None:
+        alive = alive & ~down
     frozen = (decided == 1) if freeze else jnp.zeros_like(alive)
-    return x, decided, killed, faulty, k, alive, frozen
+    return x, decided, killed, faulty, k, alive, frozen, down
 
 
 def _store_planes(np_ref, kbits, new_x, new_dec, killed, faulty, new_k,
-                  coined):
+                  coined, down=None):
     """Commit the per-lane fields back to the plane layout -> the new
     uint32 [T, P, TW] block.  Pad lanes arrive with the killed bit and
-    inert values, so the stored words keep the pad invariants."""
+    inert values, so the stored words keep the pad invariants.  ``down``
+    (crash_recover) stores this round's down-interval membership for
+    forensic unpacking — the protocol re-derives liveness from the
+    round bounds, so the plane is write-only evidence (None clears it,
+    like the coined plane before any round)."""
     t, tile = new_x.shape
     tw = tile // PACK_NODES_PER_WORD
     jj = jax.lax.broadcasted_iota(jnp.uint32, (t, tw, PACK_NODES_PER_WORD),
@@ -500,6 +530,8 @@ def _store_planes(np_ref, kbits, new_x, new_dec, killed, faulty, new_k,
     planes[PACK_KILLED] = fold(killed, 0)
     planes[PACK_COINED] = fold(coined, 0)
     planes[PACK_FAULTY] = fold(faulty, 0)
+    planes[PACK_DOWN] = (fold(down, 0) if down is not None
+                         else jnp.zeros_like(planes[PACK_KILLED]))
     for b in range(kbits):
         planes[PACK_K + b] = fold(new_k, b)
     np_ref[...] = jnp.stack(planes, axis=1)
@@ -700,7 +732,7 @@ def _vote_partial_cols(fault_model, record, witness_ids, n_local,
 
 def _prop_hist_kernel(m, fault_model, freeze, has_cr, counts_mode,
                       camp_b0, camp_b1, witness_ids, n_local, kbits,
-                      telemetry, *refs):
+                      telemetry, rejoin, *refs):
     """One lane-tile of the two-kernel path's PROPOSAL phase.
 
     Per-lane tallies -> phase-1 majority/tie (node.ts:63-69) -> each
@@ -731,10 +763,11 @@ def _prop_hist_kernel(m, fault_model, freeze, has_cr, counts_mode,
     ne_ref = refs.pop(0) if has_eq else None
     p_ref = refs.pop(0)
     cr = refs.pop(0)[...] if has_cr else None
+    rcv = refs.pop(0)[...] if fault_model == "crash_recover" else None
     (out_ref,) = refs
     p = p_ref[...]
-    x, decided, killed, faulty, k, alive, frozen = _load_fields(
-        p, kbits, rr_ref[0], cr, fault_model, freeze)
+    x, decided, killed, faulty, k, alive, frozen, down = _load_fields(
+        p, kbits, rr_ref[0], cr, rcv, fault_model, freeze, rejoin)
     shape = x.shape
 
     if counts_mode == "delivered":
@@ -770,7 +803,7 @@ def _prop_hist_kernel(m, fault_model, freeze, has_cr, counts_mode,
 def _vote_commit_kernel(m, n_faulty, rule, coin_mode, eps, freeze,
                         fault_model, has_cr, counts_mode, camp_b0,
                         camp_b1, record, witness_ids, n_local, kbits,
-                        telemetry, *refs):
+                        telemetry, rejoin, *refs):
     """One lane-tile of the two-kernel path's VOTE phase + commit.
 
     Per-lane vote tallies (by counts_mode, as in _prop_hist_kernel) ->
@@ -809,11 +842,12 @@ def _vote_commit_kernel(m, n_faulty, rule, coin_mode, eps, freeze,
     qok_ref, shared_ref, p_ref = refs[:3]
     refs = refs[3:]
     cr = refs.pop(0)[...] if has_cr else None
+    rcv = refs.pop(0)[...] if fault_model == "crash_recover" else None
     np_ref, part_ref = refs
     p = p_ref[...]
     rr = rk_ref[0] - 1
-    x, decided, killed, faulty, k, alive, frozen = _load_fields(
-        p, kbits, rr, cr, fault_model, freeze)
+    x, decided, killed, faulty, k, alive, frozen, down = _load_fields(
+        p, kbits, rr, cr, rcv, fault_model, freeze, rejoin)
     shape = x.shape
 
     # --- the vote tallies ------------------------------------------------
@@ -838,7 +872,7 @@ def _vote_commit_kernel(m, n_faulty, rule, coin_mode, eps, freeze,
         shared_ref[...], qok_ref[...], rk_ref[0], x, decided, killed, k,
         alive, frozen, v0, v1)
     _store_planes(np_ref, kbits, new_x, new_dec, killed, faulty, new_k,
-                  coined)
+                  coined, down=down)
     cols = _vote_partial_cols(fault_model, record, witness_ids, n_local,
                               vote_scal_ref, shape, new_x, new_dec,
                               killed, faulty, alive, active, coined, v0,
@@ -850,7 +884,7 @@ def _vote_commit_kernel(m, n_faulty, rule, coin_mode, eps, freeze,
 
 def _fused_round_kernel(m, n_faulty, rule, coin_mode, eps, freeze,
                         fault_model, has_cr, record, witness_ids, n_local,
-                        kbits, telemetry, *refs):
+                        kbits, telemetry, rejoin, *refs):
     """The SINGLE-PASS fused round: both phases of one Ben-Or round over
     the whole (padded) node axis in one kernel invocation.
 
@@ -883,11 +917,12 @@ def _fused_round_kernel(m, n_faulty, rule, coin_mode, eps, freeze,
     shared_ref = refs.pop(0)
     p_ref = refs.pop(0)
     cr = refs.pop(0)[...] if has_cr else None
+    rcv = refs.pop(0)[...] if fault_model == "crash_recover" else None
     np_ref, partA_ref, partB_ref = refs
     p = p_ref[...]
     rr = rk_ref[0] - 1
-    x, decided, killed, faulty, k, alive, frozen = _load_fields(
-        p, kbits, rr, cr, fault_model, freeze)
+    x, decided, killed, faulty, k, alive, frozen, down = _load_fields(
+        p, kbits, rr, cr, rcv, fault_model, freeze, rejoin)
     shape = x.shape
     t = shape[0]
 
@@ -935,7 +970,7 @@ def _fused_round_kernel(m, n_faulty, rule, coin_mode, eps, freeze,
         shared_ref[...], qok, rk_ref[0], x, decided, killed, k, alive,
         frozen, v0, v1)
     _store_planes(np_ref, kbits, new_x, new_dec, killed, faulty, new_k,
-                  coined)
+                  coined, down=down)
     colsB = _vote_partial_cols(fault_model, record, witness_ids, n_local,
                                vote_scal, shape, new_x, new_dec, killed,
                                faulty, alive, active, coined, v0, v1,
@@ -985,14 +1020,15 @@ def _count_vecs(hist, counts_mode):
 
 @instrumented_jit(static_argnames=(
     "m", "fault_model", "freeze", "interpret", "counts_mode", "camp_b0",
-    "camp_b1", "witness_ids", "n_local", "telemetry"))
+    "camp_b1", "witness_ids", "n_local", "telemetry", "rejoin"))
 def proposal_hist_pallas(base_key, r, phase, hist, pack, crash_round,
                          m: int, fault_model: str, freeze: bool,
                          interpret: bool = False, node_offset=0,
                          trial_offset=0, n_equiv=None,
                          counts_mode: str = "sampled", camp_b0: int = 0,
                          camp_b1: int = 0, witness_ids: tuple = (),
-                         n_local: int = 0, telemetry: bool = False):
+                         n_local: int = 0, telemetry: bool = False,
+                         recover_round=None, rejoin: str = "durable"):
     """Fused proposal phase over the plane stack -> partials
     [T, PARTIAL_COLS] (partial_dtype-narrowed; cast to int32 before
     summing): cols 0-2 this shard's LOCAL vote histogram, col 3 its alive
@@ -1022,7 +1058,7 @@ def proposal_hist_pallas(base_key, r, phase, hist, pack, crash_round,
     r = jnp.asarray(r, jnp.int32)
     scal = _stream_scal(base_key, r, phase, node_offset, trial_offset)
     cvecs = _count_vecs(hist, counts_mode)
-    has_cr = fault_model == "crash_at_round"
+    has_cr = fault_model in ("crash_at_round", "crash_recover")
     has_eq = fault_model == "equivocate" and counts_mode == "sampled"
     pdtype = partial_dtype(m, TILE_N)
 
@@ -1038,10 +1074,14 @@ def proposal_hist_pallas(base_key, r, phase, hist, pack, crash_round,
     if has_cr:
         args.append(crash_round)
         specs.append(_lane(T))
+    if fault_model == "crash_recover":
+        args.append(recover_round)
+        specs.append(_lane(T))
     parts = pl.pallas_call(
         functools.partial(_prop_hist_kernel, m, fault_model, freeze,
                           has_cr, counts_mode, camp_b0, camp_b1,
-                          witness_ids, n_local, kbits, telemetry),
+                          witness_ids, n_local, kbits, telemetry,
+                          rejoin),
         out_shape=jax.ShapeDtypeStruct((np_total // TILE_N, T,
                                         PARTIAL_COLS), pdtype),
         grid=(np_total // TILE_N,),
@@ -1061,7 +1101,7 @@ def proposal_hist_pallas(base_key, r, phase, hist, pack, crash_round,
 @instrumented_jit(static_argnames=(
     "m", "n_faulty", "rule", "coin_mode", "eps", "freeze", "fault_model",
     "interpret", "counts_mode", "camp_b0", "camp_b1", "record",
-    "witness_ids", "n_local", "telemetry"))
+    "witness_ids", "n_local", "telemetry", "rejoin"))
 def vote_commit_pallas(base_key, r, phase, hist, pack, crash_round,
                        quorum_ok, shared, m: int, n_faulty: int, rule: str,
                        coin_mode: str, eps: float, freeze: bool,
@@ -1070,7 +1110,8 @@ def vote_commit_pallas(base_key, r, phase, hist, pack, crash_round,
                        counts_mode: str = "sampled", camp_b0: int = 0,
                        camp_b1: int = 0, record: bool = False,
                        witness_ids: tuple = (), n_local: int = 0,
-                       telemetry: bool = False):
+                       telemetry: bool = False, recover_round=None,
+                       rejoin: str = "durable"):
     """Fused vote phase + commit -> (new plane stack, partials
     [T, PARTIAL_COLS] int32).
 
@@ -1098,7 +1139,7 @@ def vote_commit_pallas(base_key, r, phase, hist, pack, crash_round,
     cvecs = _count_vecs(hist, counts_mode)
     qok = quorum_ok.astype(jnp.int32)[:, None]
     sh = shared.astype(jnp.int32)[:, None]
-    has_cr = fault_model == "crash_at_round"
+    has_cr = fault_model in ("crash_at_round", "crash_recover")
     has_eq = fault_model == "equivocate" and counts_mode == "sampled"
     pdtype = partial_dtype(m, TILE_N)
 
@@ -1116,11 +1157,15 @@ def vote_commit_pallas(base_key, r, phase, hist, pack, crash_round,
     if has_cr:
         args.append(crash_round)
         specs.append(_lane(T))
+    if fault_model == "crash_recover":
+        args.append(recover_round)
+        specs.append(_lane(T))
     new_pack, parts = pl.pallas_call(
         functools.partial(_vote_commit_kernel, m, n_faulty, rule,
                           coin_mode, eps, freeze, fault_model, has_cr,
                           counts_mode, camp_b0, camp_b1, record,
-                          witness_ids, n_local, kbits, telemetry),
+                          witness_ids, n_local, kbits, telemetry,
+                          rejoin),
         out_shape=[jax.ShapeDtypeStruct((T, n_planes,
                                          np_total // PACK_NODES_PER_WORD),
                                         jnp.uint32),
@@ -1145,13 +1190,15 @@ def vote_commit_pallas(base_key, r, phase, hist, pack, crash_round,
 
 @instrumented_jit(static_argnames=(
     "m", "n_faulty", "rule", "coin_mode", "eps", "freeze", "fault_model",
-    "interpret", "record", "witness_ids", "n_local", "telemetry"))
+    "interpret", "record", "witness_ids", "n_local", "telemetry",
+    "rejoin"))
 def fused_round_pallas(base_key, r, hist1, pack, crash_round, shared,
                        m: int, n_faulty: int, rule: str, coin_mode: str,
                        eps: float, freeze: bool, fault_model: str,
                        interpret: bool = False, n_equiv=None,
                        record: bool = False, witness_ids: tuple = (),
-                       n_local: int = 0, telemetry: bool = False):
+                       n_local: int = 0, telemetry: bool = False,
+                       recover_round=None, rejoin: str = "durable"):
     """ONE pallas pass for a whole Ben-Or round (single device,
     counts_mode='sampled', within the FUSED_ONE_PASS_* caps) ->
     (new plane stack, partsA, partsB) with partsA/partsB int32
@@ -1177,7 +1224,7 @@ def fused_round_pallas(base_key, r, hist1, pack, crash_round, shared,
     rk = (r + 1).reshape(1)
     cvecs = _count_vecs(hist1, "sampled")
     sh = shared.astype(jnp.int32)[:, None]
-    has_cr = fault_model == "crash_at_round"
+    has_cr = fault_model in ("crash_at_round", "crash_recover")
     has_eq = fault_model == "equivocate"
     pdtype = partial_dtype(m, np_total)
 
@@ -1210,10 +1257,14 @@ def fused_round_pallas(base_key, r, hist1, pack, crash_round, shared,
     if has_cr:
         args.append(crash_round)
         specs.append(whole_lane)
+    if fault_model == "crash_recover":
+        args.append(recover_round)
+        specs.append(whole_lane)
     new_pack, partsA, partsB = pl.pallas_call(
         functools.partial(_fused_round_kernel, m, n_faulty, rule,
                           coin_mode, eps, freeze, fault_model, has_cr,
-                          record, witness_ids, n_local, kbits, telemetry),
+                          record, witness_ids, n_local, kbits, telemetry,
+                          rejoin),
         out_shape=[jax.ShapeDtypeStruct((T, n_planes, n_w), jnp.uint32),
                    jax.ShapeDtypeStruct((1, T, PARTIAL_COLS), pdtype),
                    jax.ShapeDtypeStruct((1, T, PARTIAL_COLS), pdtype)],
@@ -1241,20 +1292,59 @@ def _pad_cr(faults, np_total):
     return cr
 
 
-def sent_hist_from_pack(cfg, pack, crash_round, r, ctx):
+def pad_fault_rounds(cfg, faults, np_total):
+    """(crash_round, recover_round) padded to the plane geometry — the
+    per-round-bound operands the kernels re-derive liveness from.
+    (None, None) for the statically-killed fault models, (cr, None)
+    under crash_at_round, (cr, rec) under crash_recover.  Pad lanes get
+    0 bounds (never crash, never rejoin) and carry the killed plane bit
+    anyway."""
+    if cfg.fault_model == "crash_at_round":
+        return _pad_cr(faults, np_total), None
+    if cfg.fault_model == "crash_recover":
+        if faults.recover_round is None:
+            raise ValueError(
+                "fault_model='crash_recover' needs FaultSpec."
+                "recover_round (faults.recovery.crash_recover_faults "
+                "builds it from the SimConfig.recovery spec)")
+        rec = faults.recover_round.astype(jnp.int32)
+        n_pad = np_total - rec.shape[-1]
+        if n_pad:
+            rec = jnp.pad(rec, ((0, 0), (0, n_pad)))
+        return _pad_cr(faults, np_total), rec
+    return None, None
+
+
+def sent_hist_from_pack(cfg, pack, crash_round, recover_round, r, ctx):
     """XLA fallback for the proposal histogram (round 1 of every run, and
-    every round under crash_at_round, whose future crashes invalidate the
-    vote kernel's emitted next-round partials).  Under 'equivocate' the
-    histogram spans HONEST live senders only (equivocator values are
-    drawn receiver-side)."""
+    every round under crash_at_round / crash_recover, whose per-round
+    liveness changes invalidate the vote kernel's emitted next-round
+    partials).  Under 'equivocate' the histogram spans HONEST live
+    senders only (equivocator values are drawn receiver-side); under
+    'crash_recover' it excludes this round's down-interval lanes and
+    applies the amnesia rejoin reset, mirroring the in-kernel
+    _load_fields exactly."""
     x = plane_field(pack, PACK_X, _X_BITS)
     killed = plane_field(pack, PACK_KILLED, 1)
     faulty = plane_field(pack, PACK_FAULTY, 1)
+    rr = jnp.asarray(r, jnp.int32)
     if cfg.fault_model == "crash_at_round":
-        rr = jnp.asarray(r, jnp.int32)
         crashing = (faulty == 1) & (crash_round > 0) & (rr >= crash_round)
         killed = jnp.where(crashing, 1, killed)
     alive = killed == 0
+    if cfg.fault_model == "crash_recover":
+        from ..faults.recovery import rejoin_mode
+        started = (faulty == 1) & (crash_round > 0) & (rr >= crash_round)
+        killed = jnp.where(started & (recover_round <= 0), 1, killed)
+        down = started & (recover_round > 0) & (rr < recover_round)
+        alive = (killed == 0) & ~down
+        if rejoin_mode(cfg.recovery) == "amnesia":
+            decided = plane_field(pack, PACK_DECIDED, 1)
+            # cr > 0: no crash, nothing to forget (mirrors _load_fields)
+            rj = (faulty == 1) & (crash_round > 0) & \
+                (recover_round > 0) & (rr == recover_round) & \
+                (decided == 0)
+            x = jnp.where(rj, VALQ, x)
     sent = _sent(cfg.fault_model, x, faulty)
     hon = _honest(cfg.fault_model, alive, faulty)
     cnt = [jnp.sum((sent == v) & hon, axis=-1, dtype=jnp.int32)
@@ -1313,8 +1403,9 @@ def packed_round(cfg, pack, faults, base_key, r, hist1, ctx, n_local,
     np_total = pack.shape[2] * PACK_NODES_PER_WORD
     interp = jax.default_backend() == "cpu"
     m = cfg.quorum
-    cr = (_pad_cr(faults, np_total)
-          if cfg.fault_model == "crash_at_round" else None)
+    cr, rec = pad_fault_rounds(cfg, faults, np_total)
+    from ..faults.recovery import rejoin_mode
+    rejoin = rejoin_mode(cfg.recovery)
     if n_equiv is None:
         n_equiv = n_equiv_from_pack(cfg, pack, ctx)
     node_off = ctx.node_ids(n_local)[0]
@@ -1358,7 +1449,8 @@ def packed_round(cfg, pack, faults, base_key, r, hist1, ctx, n_local,
             cfg.rule, cfg.coin_mode, float(cfg.coin_eps),
             bool(cfg.freeze_decided), cfg.fault_model, interpret=interp,
             n_equiv=n_equiv, record=bool(cfg.record), witness_ids=wids,
-            n_local=n_local, telemetry=telem)
+            n_local=n_local, telemetry=telem, recover_round=rec,
+            rejoin=rejoin)
         new_pack, partsA, partsB = out[:3]
         if telem:
             telemA, telemB = out[3:]
@@ -1369,7 +1461,8 @@ def packed_round(cfg, pack, faults, base_key, r, hist1, ctx, n_local,
             interpret=interp, node_offset=node_off,
             trial_offset=trial_off, n_equiv=n_equiv, counts_mode=mode,
             camp_b0=camp_b0, camp_b1=camp_b1, witness_ids=wids,
-            n_local=n_local, telemetry=telem)
+            n_local=n_local, telemetry=telem, recover_round=rec,
+            rejoin=rejoin)
         partsA = out[0] if telem else out
         if telem:
             telemA = out[1]
@@ -1383,11 +1476,18 @@ def packed_round(cfg, pack, faults, base_key, r, hist1, ctx, n_local,
             cfg.fault_model, interpret=interp, node_offset=node_off,
             trial_offset=trial_off, n_equiv=n_equiv, counts_mode=mode,
             camp_b0=camp_b0, camp_b1=camp_b1, record=bool(cfg.record),
-            witness_ids=wids, n_local=n_local, telemetry=telem)
+            witness_ids=wids, n_local=n_local, telemetry=telem,
+            recover_round=rec, rejoin=rejoin)
         new_pack, partsB = out[:2]
         if telem:
             telemB = out[2]
-    hist1_next = (None if cfg.fault_model == "crash_at_round"
+    # crash_at_round / crash_recover: the vote kernel's emitted
+    # next-round histogram is invalid (liveness — and under amnesia x —
+    # changes between rounds); the loop recomputes via
+    # sent_hist_from_pack instead
+    hist1_next = (None
+                  if cfg.fault_model in ("crash_at_round",
+                                         "crash_recover")
                   else ctx.psum_nodes(partsB[:, :3]))
     unsettled = ctx.psum_nodes(partsB[:, 4])
     row = None
@@ -1502,10 +1602,9 @@ def run_packed_slice(cfg, state, faults, base_key, from_round, until_round,
                            jnp.int32)
     pack = pack_state(cfg, state, faults.faulty)
     np_total = pack.shape[2] * PACK_NODES_PER_WORD
-    cr = (_pad_cr(faults, np_total)
-          if cfg.fault_model == "crash_at_round" else None)
+    cr, rec = pad_fault_rounds(cfg, faults, np_total)
     n_equiv = n_equiv_from_pack(cfg, pack, ctx)      # run-constant, hoisted
-    hist1 = sent_hist_from_pack(cfg, pack, cr, from_round, ctx)
+    hist1 = sent_hist_from_pack(cfg, pack, cr, rec, from_round, ctx)
     # unsettled lanes straight off the decided/killed planes (pads carry
     # the killed bit, so ~(dec | kill) is 0 on every pad word bit)
     unsett_bits = ~(pack[:, PACK_DECIDED, :] | pack[:, PACK_KILLED, :])
@@ -1518,8 +1617,8 @@ def run_packed_slice(cfg, state, faults, base_key, from_round, until_round,
 
     def body(carry):
         r, pack, hist1 = carry[0], carry[1], carry[2]
-        if cfg.fault_model == "crash_at_round":
-            hist1 = sent_hist_from_pack(cfg, pack, cr, r, ctx)
+        if cfg.fault_model in ("crash_at_round", "crash_recover"):
+            hist1 = sent_hist_from_pack(cfg, pack, cr, rec, r, ctx)
         rout = packed_round(cfg, pack, faults, base_key, r, hist1, ctx,
                             n_local, n_equiv=n_equiv)
         new_pack, hist1_next, unsettled, row, wrow = rout[:5]

@@ -185,6 +185,30 @@ def uniform_race_favored_count(u: jax.Array, nf: jax.Array, ns: jax.Array,
     return jnp.clip(draw, lo, hi).astype(jnp.int32)
 
 
+def binomial_keep(u: jax.Array, n: jax.Array, keep: jax.Array) -> jax.Array:
+    """Binomial(n, keep) via the clamped normal quantile — the
+    message-omission thinning draw (SimConfig.drop_prob;
+    tally.omission_thin_counts).
+
+    u: uniforms [...]; n: counts broadcastable to u (int or integral
+    f32); ``keep`` the survival probability, possibly TRACED (the
+    DynParams drop_prob axis rides through 1 - p) — everything here is
+    shape-generic elementwise VPU math, so one executable serves a whole
+    drop-probability curve.  Exact at the endpoints by construction
+    (keep -> 1 has zero variance and rounds to n); in between the normal
+    approximation sits within O(1/sqrt(n)) of the true binomial — the
+    same accuracy argument as the CF hypergeometric regime, with the
+    dense per-edge mask (scheduler.omission_delivery_mask) as the exact
+    oracle."""
+    nf = jnp.maximum(n.astype(jnp.float32), 0.0)
+    q = jnp.clip(jnp.asarray(keep, jnp.float32), 0.0, 1.0)
+    mean = nf * q
+    var = jnp.maximum(nf * q * (1.0 - q), 0.0)
+    z = jax.scipy.special.ndtri(jnp.clip(u, 1e-7, 1 - 1e-7))
+    draw = jnp.round(mean + z * jnp.sqrt(var))
+    return jnp.clip(draw, 0.0, nf).astype(jnp.int32)
+
+
 def binomial_half(u: jax.Array, n: jax.Array) -> jax.Array:
     """Binomial(n, 1/2) draws via the normal quantile, fully per-lane.
 

@@ -88,6 +88,48 @@ def quorum_delivery_mask(cfg: SimConfig, base_key: jax.Array, r: jax.Array,
     return _top_m_mask(delays, m) & alive[:, None, :]
 
 
+def omission_delivery_mask(cfg: SimConfig, base_key: jax.Array,
+                           r: jax.Array, phase: int, alive_g: jax.Array,
+                           drop_p: jax.Array, trial_ids=None,
+                           recv_ids=None, part=None) -> jax.Array:
+    """Full delivery minus per-edge iid omission (SimConfig.drop_prob),
+    intersected with the partition epoch's group mask when one is armed
+    -> bool [T, N_recv, N_send].
+
+    The DENSE-path realization of the faultlab omission plane
+    (benor_tpu/faults): each (receiver, live sender) edge — self
+    included; the reference's self-broadcast is a localhost fetch like
+    any other (node.ts:72) — survives with probability ``1 - drop_p``,
+    from a dedicated per-edge stream (salt ``phase + 8``, the same salt
+    family as the histogram path's thinning draws).  ``drop_p`` may be
+    traced (the DynParams sweep axis); the mask's shape never depends on
+    it.  The histogram path's closed-form binomial thinning
+    (tally.omission_thin_counts) is the O(N) twin; this mask is its
+    exact edge-level oracle (tests/test_faults.py compares the two
+    statistically, the dense/histogram duality every scheduler keeps).
+
+    ``part`` (faults.partitions.PartitionSpec or None): during the
+    epoch (r < heal_round) cross-group edges are additionally lost —
+    deterministically, before any omission randomness.
+    """
+    T, N = alive_g.shape
+    if trial_ids is None:
+        trial_ids = rng.ids(T)
+    if recv_ids is None:
+        recv_ids = rng.ids(N)
+    u = rng.edge_uniforms(base_key, r, phase + 8, trial_ids, recv_ids,
+                          rng.ids(N))                     # [T, n_recv, N]
+    mask = alive_g[:, None, :] & (u >= jnp.asarray(drop_p, jnp.float32))
+    if part is not None:
+        from ..faults.partitions import group_of
+        g_recv = group_of(recv_ids, cfg.n_nodes, part.groups)
+        g_send = group_of(rng.ids(N), cfg.n_nodes, part.groups)
+        same = (g_recv[:, None] == g_send[None, :])[None, :, :]
+        healed = jnp.asarray(r, jnp.int32) >= part.heal_round
+        mask = mask & (same | healed)
+    return mask
+
+
 def _top_m_mask(delays: jax.Array, m: int) -> jax.Array:
     """bool mask of the m smallest entries per receiver row.
 

@@ -109,7 +109,13 @@ def dense_gather_needed(cfg: SimConfig) -> bool:
     """True iff receiver_counts will take the dense masked path (and thus
     gather sender arrays).  Callers use this to prefetch the round-constant
     ``alive`` gather once for both phases — keep in sync with the dispatch
-    order in receiver_counts below."""
+    order in receiver_counts below.  The dense OMISSION path (PR 15:
+    delivery='all' + drop_prob on resolved_path='dense' — the per-edge
+    Bernoulli mask) gathers exactly like the quorum-delivery masks, so
+    it rides the same prefetch."""
+    if (cfg.delivery == "all" and cfg.drop_prob
+            and cfg.resolved_path == "dense"):
+        return True
     return (cfg.delivery == "quorum" and cfg.scheduler != "adversarial"
             and cfg.resolved_path == "dense")
 
@@ -199,9 +205,47 @@ def receiver_counts(cfg: SimConfig, base_key: jax.Array, r: jax.Array,
     # O(T*N), no mask, identical on both paths.  With equivocators, every
     # receiver additionally tallies every live equivocator's edge bit:
     # a Binomial(n_equiv, 1/2) class split per receiver lane.
+    # The faultlab planes (benor_tpu/faults, PR 15) modify THIS branch:
+    # a partition epoch confines each receiver to its GROUP's histogram
+    # ([T, G, 3] masked sums — O(N*G), never N x N), and drop_prob thins
+    # the delivered counts — per-edge Bernoulli on the dense path (the
+    # exact oracle, via scheduler.omission_delivery_mask) or a
+    # closed-form per-class binomial thinning on the histogram path (so
+    # N = 1M stays feasible).  drop_prob is a traced DynParams axis; all
+    # gates are static, so injection off never traces any of this.
     if cfg.delivery == "all":
-        hist = class_histogram(sent, honest, ctx)           # [T, 3]
-        counts = jnp.broadcast_to(hist[:, None, :], (T, N, 3))
+        drop_p = None
+        if cfg.drop_prob:
+            drop_p = jnp.float32(cfg.drop_prob) if dyn is None \
+                else dyn.drop_prob
+        part = None
+        if cfg.partition is not None:
+            from ..faults.partitions import parse_partition
+            part = parse_partition(cfg.partition)
+        if drop_p is not None and cfg.resolved_path == "dense":
+            # exact per-edge omission: every (receiver, live sender)
+            # edge — self included: the reference's self-broadcast is a
+            # localhost fetch like any other (node.ts:72) — survives
+            # with probability 1 - p, intersected with the partition
+            # epoch's group mask; the dense einsum tallies survivors.
+            # equivocate is rejected with drop_prob (config.py), so the
+            # honest population is just the live one.
+            sent_g = ctx.all_gather_nodes(sent)
+            if alive_g is None:
+                alive_g = ctx.all_gather_nodes(alive)
+            mask = scheduler.omission_delivery_mask(
+                cfg, base_key, r, phase, alive_g, drop_p, trial_ids,
+                node_ids, part=part)
+            return dense_counts(mask, sent_g, alive_g)
+        if part is not None:
+            counts = partition_counts(cfg, part, sent, honest, node_ids,
+                                      r, ctx)
+        else:
+            hist = class_histogram(sent, honest, ctx)       # [T, 3]
+            counts = jnp.broadcast_to(hist[:, None, :], (T, N, 3))
+        if drop_p is not None:
+            return omission_thin_counts(base_key, r, phase, counts,
+                                        drop_p, trial_ids, node_ids)
         if equiv is not None:
             u = rng.grid_uniforms(base_key, r, phase + 32,
                                   trial_ids, node_ids)
@@ -329,6 +373,64 @@ def receiver_counts(cfg: SimConfig, base_key: jax.Array, r: jax.Array,
                 cfg.adversary_strength, u0, u1, hist, m, node_ids)
         # strength 0: the dense scheduler adds no delay — plain uniform
     return sampling.multivariate_hypergeom_counts(u0, u1, hist, m)
+
+
+def partition_counts(cfg: SimConfig, part, sent: jax.Array,
+                     honest: jax.Array, node_ids: jax.Array, r: jax.Array,
+                     ctx: ShardCtx = SINGLE) -> jax.Array:
+    """Per-receiver counts under an epoch-structured partition
+    (benor_tpu/faults/partitions.py) -> int32 [T, N_local, 3].
+
+    During the epoch (r < heal_round) each receiver tallies its own
+    GROUP's class histogram — [T, G, 3] masked sums over global senders
+    (one psum under a mesh, like class_histogram), O(N * G) and never a
+    dense N x N.  From the heal round on, the whole-network histogram
+    (the sum over groups — free).  ``r`` is traced, so one executable
+    serves both epochs via a where-select.
+    """
+    from ..faults.partitions import group_of
+
+    T, n_loc = sent.shape
+    G = part.groups
+    grp = group_of(node_ids, cfg.n_nodes, G)                # [N_local]
+    # one contraction, not a G-way Python unroll: sender-group one-hots
+    # x class one-hots -> [T, G, 3] in O(1) traced ops (a large G would
+    # otherwise balloon the HLO G-fold)
+    g_oh = (grp[:, None] == jnp.arange(G)[None, :]).astype(jnp.int32)
+    cls = jnp.stack([((sent == v) & honest).astype(jnp.int32)
+                     for v in (VAL0, VAL1, VALQ)], axis=-1)  # [T, N, 3]
+    ghist = ctx.psum_nodes(jnp.einsum("tnv,ng->tgv", cls, g_oh))
+    whole = jnp.sum(ghist, axis=1)                          # [T, 3]
+    per_recv = jnp.take(ghist, grp, axis=1)                 # [T, N_loc, 3]
+    partitioned = jnp.asarray(r, jnp.int32) < part.heal_round
+    return jnp.where(partitioned, per_recv,
+                     jnp.broadcast_to(whole[:, None, :], per_recv.shape))
+
+
+def omission_thin_counts(base_key: jax.Array, r: jax.Array, phase: int,
+                         counts: jax.Array, drop_p: jax.Array,
+                         trial_ids: jax.Array,
+                         node_ids: jax.Array) -> jax.Array:
+    """Per-edge iid omission as closed-form binomial thinning (the
+    histogram path of ``SimConfig.drop_prob``) -> int32 [T, N, 3].
+
+    Each delivered message survives independently with probability
+    1 - p, so a receiver facing a class-v population of ``c_v`` tallies
+    Binomial(c_v, 1 - p) of them — three independent draws per
+    (trial, receiver, phase) from dedicated streams (salts phase + 8 /
+    + 24 / + 40; disjoint from the sampler/bias/coin/equivocator salt
+    families).  ``drop_p`` may be TRACED (the DynParams axis): the
+    normal-quantile draw (sampling.binomial_keep) is shape-generic, so a
+    whole drop_prob curve shares one bucket executable.  The dense path
+    (scheduler.omission_delivery_mask) is the exact per-edge oracle this
+    closed form is statistically checked against."""
+    keep = 1.0 - jnp.asarray(drop_p, jnp.float32)
+    cols = []
+    for i, salt in enumerate((8, 24, 40)):
+        u = rng.grid_uniforms(base_key, r, phase + salt, trial_ids,
+                              node_ids)
+        cols.append(sampling.binomial_keep(u, counts[..., i], keep))
+    return jnp.stack(cols, axis=-1)
 
 
 def biased_priority_counts(u0: jax.Array, hist: jax.Array,

@@ -111,7 +111,9 @@ def shard_inputs(state: NetState, faults: FaultSpec, mesh: Mesh):
     state = NetState(x=put(state.x), decided=put(state.decided),
                      k=put(state.k), killed=put(state.killed))
     faults = FaultSpec(faulty=put(faults.faulty),
-                       crash_round=put(faults.crash_round))
+                       crash_round=put(faults.crash_round),
+                       recover_round=(None if faults.recover_round is None
+                                      else put(faults.recover_round)))
     return state, faults
 
 

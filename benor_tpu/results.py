@@ -708,6 +708,47 @@ def topo_curves(n: int, trials: int, seed: int = 0,
             "committee_buckets": cb.n_buckets}
 
 
+def faults_curves(n: int, trials: int, seed: int = 0,
+                  max_rounds: int = 32, verbose: bool = False) -> Dict:
+    """The faultlab science rows (PR 15, benor_tpu/faults): the paper's
+    probabilistic-termination claim stress-tested along the two dynamic
+    fault axes —
+
+      * rounds-to-decide vs per-edge omission probability
+        (``drop_curve``): the whole p grid compiles as ONE bucket
+        executable (drop_prob rides DynParams; the compile count rides
+        the return as the coalescing proof bench's ``faults`` blob
+        pins).  The grid stays below the stall threshold p ~ F/N —
+        beyond it the expected delivered count drops under the quorum
+        N - F and every lane stalls to the round cap (the curve's
+        asymptote, not its interesting region);
+      * rounds-to-decide vs crash-recovery churn (``churn_curve``): a
+        rolling ``stagger:2:<down>`` schedule with growing down length —
+        deeper churn holds more of the quorum slack hostage per round.
+
+    Rows are json-ready dicts; tools/check_metrics_schema
+    .check_faults_blob recomputes the stall threshold and pins the
+    one-bucket claim."""
+    from .faults.curves import churn_curve, drop_curve
+
+    f = max(n // 4, 1)
+    base = SimConfig(n_nodes=n, n_faulty=f, trials=trials,
+                     max_rounds=max_rounds, seed=seed)
+    # omission grid: up to ~60% of the stall threshold F/N, so the curve
+    # bends without saturating at the cap
+    frac = f / n
+    ps = [round(frac * s, 6) for s in (0.1, 0.25, 0.4, 0.6)]
+    drop_rows, drop_cb = drop_curve(base, ps, verbose=verbose)
+    churn_rows, churn_cb = churn_curve(
+        base.replace(n_faulty=max(n // 8, 1)), down_lengths=(1, 3, 6),
+        verbose=verbose)
+    return {"drop_curve": drop_rows,
+            "drop_compile_count": drop_cb.compile_count,
+            "drop_buckets": drop_cb.n_buckets,
+            "churn_curve": churn_rows,
+            "churn_compile_count": churn_cb.compile_count}
+
+
 def generate(out_dir: str = "RESULTS", n_large: int = 1_000_000,
              trials_large: int = 32, seed: int = 0,
              presets=True) -> Dict[str, object]:

@@ -47,10 +47,17 @@ from ..config import SimConfig
 #: ``serve_bucket_key`` by construction (the sweep bucket token keys on
 #: the full config), so mismatched topologies never coalesce into one
 #: launch while committee count/size coalesce as DynParams axes.
+#: The faultlab planes (PR 15) ride here too: ``drop_prob`` coalesces as
+#: a DynParams axis in ``serve_bucket_key`` (the sweep bucket token
+#: erases it, so p-sweeping clients share one warm executable), while
+#: ``recovery`` / ``partition`` specs are static config and separate
+#: buckets — mismatched churn schedules or partition epochs never share
+#: a launch.
 CONFIG_FIELDS = ("n_nodes", "n_faulty", "trials", "max_rounds", "rule",
                  "seed", "coin_mode", "coin_eps", "delivery", "scheduler",
                  "adversary_strength", "fault_model", "path", "topology",
-                 "committee_cap", "committee_count", "committee_size")
+                 "committee_cap", "committee_count", "committee_size",
+                 "drop_prob", "recovery", "partition")
 
 #: The four client verbs.
 JOB_KINDS = ("simulate", "sweep", "trajectory", "audit")
@@ -178,6 +185,13 @@ class JobSpec:
     committee_cap: int = 0
     committee_count: int = 0
     committee_size: int = 0
+    #: faultlab (benor_tpu/faults): per-edge omission probability, the
+    #: crash-recovery schedule spec ('at:<crash>:<down>[:amnesia|
+    #: durable]' / 'stagger:...') and the partition spec
+    #: ('halves:<heal>' / 'groups:<g>:<heal>') or null.
+    drop_prob: float = 0.0
+    recovery: Optional[str] = None
+    partition: Optional[str] = None
     #: sweep kind only: the curve's f grid (expands to per-point jobs).
     f_values: Optional[Tuple[int, ...]] = None
 
@@ -208,16 +222,20 @@ class JobSpec:
             if f not in doc:
                 continue
             v = doc[f]
-            if f == "topology":
+            if f in ("topology", "recovery", "partition"):
                 # Optional[str]: the generic type check below would key
                 # on NoneType.  Spec-string VALIDITY (grammar, degree
-                # bounds, N coverage) is SimConfig's parse at the
-                # to_config() probe — those surface as structured 400s
-                # on the 'config' field.
+                # bounds, N coverage, heal rounds) is SimConfig's parse
+                # at the to_config() probe — those surface as structured
+                # 400s on the 'config' field.
                 if v is not None and not isinstance(v, str):
-                    raise JobError(
-                        "topology", "must be a topology spec string "
-                                    "(e.g. 'torus2d:8x8') or null")
+                    hints = {"topology": "a topology spec string (e.g. "
+                                         "'torus2d:8x8')",
+                             "recovery": "a recovery schedule spec (e.g. "
+                                         "'stagger:2:3:amnesia')",
+                             "partition": "a partition spec (e.g. "
+                                          "'halves:6')"}
+                    raise JobError(f, f"must be {hints[f]} or null")
                 kw[f] = v
                 continue
             want = type(getattr(defaults, f))

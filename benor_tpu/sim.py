@@ -31,6 +31,10 @@ _debug_demotion_warned = False
 #: One warning per process for the structured-delivery pallas demotion.
 _structured_demotion_warned = False
 
+#: One warning per process for the faultlab (omission/partition) pallas
+#: demotion.
+_faults_demotion_warned = False
+
 
 def delivery_plane(cfg: SimConfig) -> str:
     """Which delivery plane serves this config: 'topology'
@@ -47,6 +51,58 @@ def delivery_plane(cfg: SimConfig) -> str:
     if cfg.committee_cap:
         return "committee"
     return "complete"
+
+
+def injection_plane(cfg: SimConfig) -> tuple:
+    """Which DYNAMIC fault families (benor_tpu/faults, PR 15) this
+    config arms, as a tuple of names in fixed order: 'crash_recover'
+    (per-node down-intervals — cfg.fault_model + the cfg.recovery
+    schedule spec), 'omission' (per-edge iid drops, cfg.drop_prob) and
+    'partition' (epoch-structured group masks, cfg.partition).  Empty =
+    the static pre-faultlab fault plane, whose executables are
+    bit-identical in results AND compile counts to a build without the
+    feature (the house rule tests/test_faults.py pins).  The
+    driver-level dispatch fact the regimes share: crash_recover runs in
+    EVERY regime including the fused pallas kernels (which re-derive
+    liveness from the round bounds in-kernel); omission and partitions
+    live on the delivery='all' plane, which the fused kernels never
+    serve (warn_faults_demote_pallas announces that structural
+    demotion, like the topo twin)."""
+    fams = []
+    if cfg.fault_model == "crash_recover" or cfg.recovery is not None:
+        fams.append("crash_recover")
+    if cfg.drop_prob:
+        fams.append("omission")
+    if cfg.partition is not None:
+        fams.append("partition")
+    return tuple(fams)
+
+
+def warn_faults_demote_pallas(cfg: SimConfig) -> None:
+    """The faultlab sibling of warn_structured_demotes_pallas: omission
+    (cfg.drop_prob) and partitions (cfg.partition) require
+    delivery='all', which every pallas gate in ops/tally.py rejects —
+    so a use_pallas_round/use_pallas_hist config with either armed runs
+    the per-round XLA loop.  Structural (the kernels implement lossless
+    quorum delivery only), but silent flag-swallowing is how perf
+    cliffs hide: announce once per process and tick the
+    ``sim.demotion.faults`` counter on every call (one tick = one
+    traced demoted executable build, the PR 14 discipline)."""
+    from .utils.metrics import REGISTRY
+    REGISTRY.counter("sim.demotion.faults").inc()
+    global _faults_demotion_warned
+    if _faults_demotion_warned:
+        return
+    _faults_demotion_warned = True
+    warnings.warn(
+        "SimConfig(use_pallas_round/use_pallas_hist) has no effect with "
+        f"the {'/'.join(injection_plane(cfg))} fault plane armed: the "
+        "fused kernels implement lossless complete-graph delivery only, "
+        "so this run takes the per-round XLA loop.  Results are exactly "
+        "the armed plane's semantics; only the kernel-speed expectation "
+        "is off.  (crash_recover alone does NOT demote — the kernels "
+        "re-derive down-intervals in-register.)",
+        stacklevel=3)
 
 
 def warn_structured_demotes_pallas(cfg: SimConfig) -> None:
@@ -264,6 +320,13 @@ def run_consensus_traced(cfg: SimConfig, state: NetState, faults: FaultSpec,
     # cliff the one-shot path warns about in run_consensus)
     if pallas_requested(cfg) and delivery_plane(cfg) != "complete":
         warn_structured_demotes_pallas(cfg)
+    # same announce-don't-swallow policy for the faultlab delivery
+    # planes: omission/partition force delivery='all', so the pallas
+    # gates reject them structurally (crash_recover does NOT demote —
+    # the kernels serve it)
+    if pallas_requested(cfg) and not pallas_round_active(cfg) and \
+            (cfg.drop_prob or cfg.partition is not None):
+        warn_faults_demote_pallas(cfg)
     state = start_state(cfg, state)
     carry = (jnp.int32(1), state)
     if cfg.record:

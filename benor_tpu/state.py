@@ -78,13 +78,21 @@ class DynParams:
     # the values are only ever read under cfg.committee_cap > 0.
     committee_count: jax.Array  # int32 []
     committee_size: jax.Array   # int32 []
+    # Message-omission probability (benor_tpu/faults, PR 15): the
+    # per-edge drop probability as a traced f32 scalar, so a whole
+    # rounds-vs-drop_prob curve sweeps inside one bucket executable
+    # (the thinning draws — sampling.binomial_keep — are shape-generic
+    # in it).  0.0 whenever the omission plane is off — only ever read
+    # under cfg.drop_prob > 0.
+    drop_prob: jax.Array        # float32 []
 
     @classmethod
     def from_config(cls, cfg: SimConfig) -> "DynParams":
         return cls(n_faulty=jnp.int32(cfg.n_faulty),
                    quorum=jnp.int32(cfg.quorum),
                    committee_count=jnp.int32(cfg.committee_count),
-                   committee_size=jnp.int32(cfg.committee_size))
+                   committee_size=jnp.int32(cfg.committee_size),
+                   drop_prob=jnp.float32(cfg.drop_prob))
 
     @classmethod
     def stack(cls, cfgs) -> "DynParams":
@@ -93,9 +101,11 @@ class DynParams:
         m = np.asarray([c.quorum for c in cfgs], np.int32)
         g = np.asarray([c.committee_count for c in cfgs], np.int32)
         s = np.asarray([c.committee_size for c in cfgs], np.int32)
+        p = np.asarray([c.drop_prob for c in cfgs], np.float32)
         return cls(n_faulty=jnp.asarray(f), quorum=jnp.asarray(m),
                    committee_count=jnp.asarray(g),
-                   committee_size=jnp.asarray(s))
+                   committee_size=jnp.asarray(s),
+                   drop_prob=jnp.asarray(p))
 
 
 @jax.tree_util.register_dataclass
@@ -112,14 +122,24 @@ class FaultSpec:
     scheduler='adversarial' (ops/tally.py).
     Under 'crash_at_round' lane i dies at the start of round crash_round[i]
     (crash_round <= 0 means never).
+    Under 'crash_recover' lane i is DOWN for rounds
+    crash_round[i] <= r < recover_round[i] and then rejoins
+    (recover_round <= 0: never — the lane latches killed, exactly the
+    crash_at_round semantics); benor_tpu/faults/recovery.py realizes
+    SimConfig.recovery spec strings into these bounds.  ``recover_round``
+    is None (an EMPTY pytree leaf — zero extra buffers, zero trace
+    footprint) for every other fault model, so injection off stays
+    bit-identical in results and compile counts.
     """
 
     faulty: jax.Array       # bool  [T, N]
     crash_round: jax.Array  # int32 [T, N]
+    recover_round: Optional[jax.Array] = None  # int32 [T, N] | None
 
     @classmethod
     def from_faulty_list(cls, cfg: SimConfig, faulty_list,
-                         crash_rounds=None) -> "FaultSpec":
+                         crash_rounds=None, recover_rounds=None
+                         ) -> "FaultSpec":
         f = np.asarray(faulty_list, dtype=bool)
         if f.shape != (cfg.n_nodes,):
             raise ValueError("faultyList length must equal N (launchNodes.ts:10-11)")
@@ -127,31 +147,50 @@ class FaultSpec:
             # reference: "faultyList doesnt have F faulties" (launchNodes.ts:12-13)
             raise ValueError("faultyList doesnt have F faulties")
         faulty = jnp.broadcast_to(jnp.asarray(f), (cfg.trials, cfg.n_nodes))
-        if cfg.fault_model == "crash_at_round":
+        recover_round = None
+        if cfg.fault_model in ("crash_at_round", "crash_recover"):
             if crash_rounds is None:
                 raise ValueError(
-                    "fault_model='crash_at_round' requires crash_rounds "
+                    f"fault_model={cfg.fault_model!r} requires crash_rounds "
                     "(int[N], round at which each faulty node dies; <=0 = never)")
             cr = np.asarray(crash_rounds, dtype=np.int32)
             if cr.shape != (cfg.n_nodes,):
                 raise ValueError("crash_rounds length must equal N")
             crash_round = jnp.broadcast_to(jnp.asarray(cr),
                                            (cfg.trials, cfg.n_nodes))
+            if cfg.fault_model == "crash_recover":
+                if recover_rounds is None:
+                    raise ValueError(
+                        "fault_model='crash_recover' requires "
+                        "recover_rounds (int[N], first round each "
+                        "crashed node is back; <=0 = never rejoins)")
+                rr = np.asarray(recover_rounds, dtype=np.int32)
+                if rr.shape != (cfg.n_nodes,):
+                    raise ValueError("recover_rounds length must equal N")
+                recover_round = jnp.broadcast_to(
+                    jnp.asarray(rr), (cfg.trials, cfg.n_nodes))
         elif crash_rounds is not None:
             raise ValueError(
-                "crash_rounds only applies to fault_model='crash_at_round'")
+                "crash_rounds only applies to fault_model='crash_at_round'"
+                " / 'crash_recover'")
         else:
             crash_round = jnp.zeros((cfg.trials, cfg.n_nodes), jnp.int32)
-        return cls(faulty=faulty, crash_round=crash_round)
+        if recover_rounds is not None and recover_round is None:
+            raise ValueError(
+                "recover_rounds only applies to fault_model='crash_recover'")
+        return cls(faulty=faulty, crash_round=crash_round,
+                   recover_round=recover_round)
 
     @classmethod
-    def first_f(cls, cfg: SimConfig, crash_rounds=None) -> "FaultSpec":
+    def first_f(cls, cfg: SimConfig, crash_rounds=None,
+                recover_rounds=None) -> "FaultSpec":
         """Mark the first ``cfg.n_faulty`` lanes faulty — the canonical
         mask every harness uses (WHICH lanes are faulty is statistically
         irrelevant under the uniform scheduler: lanes are exchangeable)."""
         mask = np.zeros(cfg.n_nodes, bool)
         mask[:cfg.n_faulty] = True
-        return cls.from_faulty_list(cfg, mask, crash_rounds)
+        return cls.from_faulty_list(cfg, mask, crash_rounds,
+                                    recover_rounds)
 
     @classmethod
     def none(cls, trials: int, n_nodes: int) -> "FaultSpec":
@@ -204,7 +243,8 @@ PACK_LAYOUT = {
     "killed": (3, 1),   # killed bit (pad lanes carry it too)
     "coined": (4, 1),   # lane committed a coin flip this round
     "faulty": (5, 1),   # fault mask (byzantine flip / equivocator tag)
-    "k": (6, 26),       # round counter, low bit first (width = the cap)
+    "down": (6, 1),     # crash_recover down-interval bit (stored round)
+    "k": (7, 25),       # round counter, low bit first (width = the cap)
 }
 
 #: Packed fields that are NOT NetState leaves (the layout checker proves
@@ -215,14 +255,22 @@ PACK_LAYOUT = {
 #: unpacking (``pallas_round.plane_field(pack, PACK_COINED, 1)``) — the
 #: recorder/witness partials compute their own coined mask in-register,
 #: so dropping this plane would save 1 bit/node at the cost of the
-#: post-hoc evidence channel.
-PACK_EXTRA_FIELDS = ("faulty", "coined")
+#: post-hoc evidence channel.  ``down`` (PR 15) is the crash-recovery
+#: twin: the kernels re-derive each round's down-interval membership
+#: from the (crash_round, recover_round) bounds in-register
+#: (fault_model='crash_recover') and store the bit here so forensic
+#: unpacking can see WHO sat the stored round out — the protocol never
+#: reads it back (liveness always re-derives from the bounds, so a
+#: sliced run cannot inherit a stale bit).  The k cap paid for the
+#: plane: 26 -> 25 (config.py re-anchors the max_rounds bound).
+PACK_EXTRA_FIELDS = ("faulty", "coined", "down")
 
 PACK_X = PACK_LAYOUT["x"][0]
 PACK_DECIDED = PACK_LAYOUT["decided"][0]
 PACK_KILLED = PACK_LAYOUT["killed"][0]
 PACK_COINED = PACK_LAYOUT["coined"][0]
 PACK_FAULTY = PACK_LAYOUT["faulty"][0]
+PACK_DOWN = PACK_LAYOUT["down"][0]
 PACK_K = PACK_LAYOUT["k"][0]
 PACK_K_MAX_BITS = PACK_LAYOUT["k"][1]
 #: Planes below the (variable-width) k field — the hot protocol bits.

@@ -146,10 +146,22 @@ def record_trajectory(cfg: SimConfig, state: NetState, faults: FaultSpec,
 def default_crash_faults(cfg: SimConfig) -> FaultSpec:
     """run_point's default fault policy as a public, reusable function:
     the first F nodes crash-faulty (which F is statistically irrelevant
-    under the uniform scheduler — lanes are exchangeable).  The single
-    policy the per-point oracle, the batched engine and the serve
-    plane's job API (serve/jobs.py) all share, so "same SimConfig" means
-    the same fault mask on every entry path."""
+    under the uniform scheduler — lanes are exchangeable).  Under
+    ``fault_model='crash_recover'`` the down-intervals are realized from
+    the config's ``recovery`` schedule spec
+    (faults.recovery.crash_recover_faults), so the schedule — like the
+    mask — derives from the config alone.  The single policy the
+    per-point oracle, the batched engine and the serve plane's job API
+    (serve/jobs.py) all share, so "same SimConfig" means the same fault
+    mask on every entry path."""
+    if cfg.fault_model == "crash_recover":
+        from .faults.recovery import crash_recover_faults
+        if cfg.recovery is None:
+            raise ValueError(
+                "fault_model='crash_recover' under the default fault "
+                "policy needs SimConfig.recovery (the schedule spec); "
+                "pass an explicit FaultSpec to decouple them")
+        return crash_recover_faults(cfg)
     fl = np.zeros(cfg.n_nodes, bool)
     fl[:cfg.n_faulty] = True
     return FaultSpec.from_faulty_list(cfg, fl)
@@ -298,6 +310,15 @@ def quorum_specialized(cfg: SimConfig) -> bool:
         # round additionally sizes its k-plane stack and partial dtype
         # (pallas_round.partial_dtype's quorum bound) per static config
         return True
+    if cfg.drop_prob or cfg.partition is not None:
+        # faultlab delivery planes (benor_tpu/faults): the omission
+        # thinning (sampling.binomial_keep) and the partition group
+        # histograms are shape-generic — no m-shaped tables, no top-k
+        # masks — so these points always share a dyn bucket (drop_prob
+        # itself IS a DynParams axis; partition specs stay in the
+        # bucket key below).  delivery='all' keeps them clear of every
+        # rule after this one.
+        return False
     if (cfg.delivery == "quorum" and cfg.resolved_path == "dense"
             and cfg.scheduler not in ("adversarial", "targeted")):
         return True                 # top-k delivery mask: static m shape
@@ -319,16 +340,22 @@ def sweep_bucket_key(cfg: SimConfig):
     """Hashable bucket token: two sweep points share one compiled batched
     executable iff their keys are equal.  Quorum-specialized points key on
     the full config (a bucket of one); everything else keys on the config
-    with the DYNAMIC axes erased — n_faulty always, and the committee
-    count/size knobs when committee delivery is armed (they ride
-    DynParams; the static committee_cap shape bound stays in the key, as
-    does the topology spec — mismatched adjacency never shares an
-    executable)."""
+    with the DYNAMIC axes erased — n_faulty always, the committee
+    count/size knobs when committee delivery is armed, and drop_prob
+    when the omission plane is armed (they ride DynParams; the static
+    committee_cap shape bound stays in the key, as do the topology,
+    partition and recovery specs — mismatched adjacency, partition
+    epochs or churn schedules never share an executable)."""
     if quorum_specialized(cfg):
         return ("static", cfg)
     erase = {"n_faulty": 0}
     if cfg.committee_cap:
         erase.update(committee_count=1, committee_size=1)
+    if cfg.drop_prob:
+        # armed omission coalesces on the traced axis; the 0.5 sentinel
+        # keeps armed and OFF (p = 0, whose executable must stay the
+        # bit-identical pre-faultlab one) in separate buckets
+        erase.update(drop_prob=0.5)
     return ("dyn", cfg.replace(**erase))
 
 

@@ -73,7 +73,8 @@ def bucket_fingerprint(cfgs, initial_values, faults) -> str:
     Covers every input the bucket executable consumes — the per-point
     frozen configs (canonical sorted-key JSON; the seed rides inside),
     the shared initial-values array and each point's fault masks
-    (faulty + crash_round) — so "same fingerprint" means "same compiled
+    (faulty + crash_round + the crash_recover recover_round when the
+    churn plane is armed) — so "same fingerprint" means "same compiled
     program on the same operands" and a journaled payload may stand in
     for a rerun bit-for-bit."""
     h = hashlib.sha256()
@@ -85,6 +86,8 @@ def bucket_fingerprint(cfgs, initial_values, faults) -> str:
     for fl in faults:
         _hash_array(h, fl.faulty)
         _hash_array(h, fl.crash_round)
+        if fl.recover_round is not None:
+            _hash_array(h, fl.recover_round)
     return "sha256:" + h.hexdigest()
 
 

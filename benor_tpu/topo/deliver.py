@@ -109,6 +109,21 @@ def neighborhood_counts(cfg: SimConfig, base_key: jax.Array, r: jax.Array,
         alive_g = ctx.all_gather_nodes(alive)
     sv = jnp.take(sent_g, nbr, axis=1)                    # [T, N_loc, d]
     av = jnp.take(alive_g, nbr, axis=1)
+    if cfg.partition is not None:
+        # Epoch-structured partition (benor_tpu/faults/partitions.py)
+        # composing with adjacency: during the epoch (r < heal_round)
+        # a neighbor edge that crosses a group boundary goes silent —
+        # deterministically, before any tallying — so a ring spanning
+        # two groups loses exactly its boundary edges.  The self edge
+        # is always same-group.  equivocate is rejected with partition
+        # (config.py), so the equiv branch below never composes.
+        from ..faults.partitions import group_of, parse_partition
+        part = parse_partition(cfg.partition)
+        g_recv = group_of(node_ids, cfg.n_nodes, part.groups)
+        g_nbr = group_of(nbr, cfg.n_nodes, part.groups)
+        same = g_nbr == g_recv[:, None]                   # [N_loc, d]
+        healed = jnp.asarray(r, jnp.int32) >= part.heal_round
+        av = av & (same[None, :, :] | healed)
     if equiv is not None:
         if equiv_g is None:
             equiv_g = ctx.all_gather_nodes(equiv)

@@ -58,6 +58,10 @@ def save_checkpoint(path: str, cfg: SimConfig, state: NetState,
         "config_json": np.bytes_(
             json.dumps(dataclasses.asdict(cfg)).encode()),
     }
+    if faults.recover_round is not None:
+        # crash_recover down-intervals (PR 15): an OPTIONAL key, so
+        # archives from static-fault runs keep their exact byte layout
+        payload["recover_round"] = np.asarray(faults.recover_round)
     tmp = path + ".tmp"
     with open(tmp, "wb") as fh:
         np.savez(fh, **payload)
@@ -80,8 +84,11 @@ def load_checkpoint(path: str):
         state = NetState(
             x=jnp.asarray(z["x"]), decided=jnp.asarray(z["decided"]),
             k=jnp.asarray(z["k"]), killed=jnp.asarray(z["killed"]))
-        faults = FaultSpec(faulty=jnp.asarray(z["faulty"]),
-                           crash_round=jnp.asarray(z["crash_round"]))
+        faults = FaultSpec(
+            faulty=jnp.asarray(z["faulty"]),
+            crash_round=jnp.asarray(z["crash_round"]),
+            recover_round=(jnp.asarray(z["recover_round"])
+                           if "recover_round" in z.files else None))
         next_round = int(z["next_round"])
         base_key = jax.random.wrap_key_data(jnp.asarray(z["key_data"]))
     return cfg, state, faults, next_round, base_key

@@ -1,0 +1,780 @@
+"""faultlab (PR 15, benor_tpu/faults): the dynamic fault-injection plane.
+
+The acceptance pins:
+
+  * injection OFF is bit-identical in results AND compile counts across
+    all five regimes — a config with every faultlab field at its default
+    IS the pre-faultlab config (same dataclass, same hash), so a rerun
+    must hit the jit cache with zero new backend compiles;
+  * a full rounds-vs-drop_prob curve executes with exactly ONE backend
+    compile (drop_prob rides DynParams) and is bit-equal to the
+    per-point oracle;
+  * seeded down-interval-decide and cross-partition-quorum forgeries are
+    caught by the auditor with exact (trial, node, round) witnesses;
+    clean runs across all fault families audit green.
+
+Runs on the 8-device virtual CPU mesh forced by tests/conftest.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from benor_tpu.audit import (WitnessBundle, audit_point, audit_witness)
+from benor_tpu.config import SimConfig
+from benor_tpu.faults.partitions import (group_of, group_size_of,
+                                         parse_partition)
+from benor_tpu.faults.recovery import (crash_recover_faults,
+                                       parse_recovery, rejoin_mode)
+from benor_tpu.ops import sampling, tally
+from benor_tpu.sim import run_consensus, run_consensus_slice, start_state
+from benor_tpu.state import FaultSpec, init_state
+from benor_tpu.sweep import (balanced_inputs, default_crash_faults,
+                             random_inputs, run_point, run_points_batched)
+from benor_tpu.state import (WIT_DECIDED, WIT_V0, WIT_V1, WIT_WRITTEN,
+                             WIT_X)
+from benor_tpu.utils.compile_counter import count_backend_compiles
+
+
+def _assert_state_equal(a, b):
+    np.testing.assert_array_equal(np.asarray(a.x), np.asarray(b.x))
+    np.testing.assert_array_equal(np.asarray(a.decided),
+                                  np.asarray(b.decided))
+    np.testing.assert_array_equal(np.asarray(a.k), np.asarray(b.k))
+    np.testing.assert_array_equal(np.asarray(a.killed),
+                                  np.asarray(b.killed))
+
+
+def _points_equal(a, b):
+    assert a.rounds_executed == b.rounds_executed
+    assert a.mean_k == b.mean_k
+    assert a.decided_frac == b.decided_frac
+    assert a.ones_frac == b.ones_frac
+    assert a.disagree_frac == b.disagree_frac
+    assert (a.k_hist == b.k_hist).all()
+
+
+# --------------------------------------------------------------------------
+# spec grammars + config validation
+# --------------------------------------------------------------------------
+
+
+def test_recovery_spec_grammar():
+    s = parse_recovery("at:3:4")
+    assert (s.kind, s.crash, s.down, s.rejoin) == ("at", 3, 4, "durable")
+    assert s.rounds(3) == ([3, 3, 3], [7, 7, 7])
+    s = parse_recovery("stagger:2:3:amnesia")
+    assert (s.kind, s.crash, s.down, s.rejoin) == ("stagger", 2, 3,
+                                                   "amnesia")
+    assert s.rounds(3) == ([2, 3, 4], [5, 6, 7])
+    assert parse_recovery("at:5:0").rounds(2) == ([5, 5], [0, 0])
+    assert parse_recovery(None) is None
+    assert rejoin_mode(None) == "durable"
+    assert rejoin_mode("at:2:2:amnesia") == "amnesia"
+    for bad in ("foo:1:2", "at:1", "at:x:2", "at:0:2", "at:1:-1",
+                "stagger:1:2:3", "at:1:2:sometimes"):
+        with pytest.raises(ValueError):
+            parse_recovery(bad)
+
+
+def test_partition_spec_grammar():
+    s = parse_partition("halves:6")
+    assert (s.groups, s.heal_round) == (2, 6)
+    assert s.group_sizes(10) == [5, 5]
+    s = parse_partition("groups:3:4")
+    assert (s.groups, s.heal_round) == (3, 4)
+    assert sum(s.group_sizes(10)) == 10
+    assert parse_partition(None) is None
+    # contiguous assignment: group ids monotone, sizes match group_of
+    n, g = 13, 3
+    ids = np.arange(n)
+    grp = np.asarray(group_of(ids, n, g))
+    sizes = parse_partition(f"groups:{g}:2").group_sizes(n)
+    assert [int((grp == k).sum()) for k in range(g)] == sizes
+    assert group_size_of(0, n, parse_partition(f"groups:{g}:2")) == sizes[0]
+    for bad in ("halves", "halves:0", "groups:1:4", "groups:2",
+                "thirds:3", "groups:x:4"):
+        with pytest.raises(ValueError):
+            parse_partition(bad)
+
+
+def test_config_validation_matrix():
+    ok = SimConfig(n_nodes=16, n_faulty=2, drop_prob=0.1)
+    assert ok.drop_prob == 0.1
+    SimConfig(n_nodes=16, n_faulty=2, partition="halves:4")
+    SimConfig(n_nodes=16, n_faulty=2, fault_model="crash_recover",
+              recovery="at:2:3")
+    with pytest.raises(ValueError, match=r"\[0, 1\)"):
+        SimConfig(n_nodes=16, n_faulty=2, drop_prob=1.0)
+    with pytest.raises(ValueError, match="delivery='all'"):
+        SimConfig(n_nodes=16, n_faulty=2, drop_prob=0.1,
+                  delivery="quorum")
+    with pytest.raises(ValueError, match="equivocate"):
+        SimConfig(n_nodes=16, n_faulty=2, drop_prob=0.1,
+                  fault_model="equivocate")
+    with pytest.raises(ValueError, match="complete graph"):
+        SimConfig(n_nodes=16, n_faulty=2, drop_prob=0.1,
+                  topology="ring:2")
+    with pytest.raises(ValueError, match="crash_recover"):
+        SimConfig(n_nodes=16, n_faulty=2, recovery="at:2:3")
+    with pytest.raises(ValueError, match="backend='tpu'"):
+        SimConfig(n_nodes=16, n_faulty=2, fault_model="crash_recover",
+                  recovery="at:2:3", backend="express")
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        SimConfig(n_nodes=16, n_faulty=2, partition="halves:4",
+                  committee_cap=4, committee_count=2, committee_size=4)
+    with pytest.raises(ValueError, match="equivocate"):
+        SimConfig(n_nodes=16, n_faulty=2, partition="halves:4",
+                  fault_model="equivocate")
+    # partition composes with topology
+    SimConfig(n_nodes=16, n_faulty=1, partition="halves:4",
+              topology="ring:4")
+
+
+# --------------------------------------------------------------------------
+# injection-off bit-identity: results AND compile counts, five regimes
+# --------------------------------------------------------------------------
+
+
+def _off(cfg):
+    """The injection-off twin — MUST be the identical config object."""
+    off = cfg.replace(drop_prob=0.0, recovery=None, partition=None)
+    assert off == cfg and hash(off) == hash(cfg)
+    return off
+
+
+def test_injection_off_identity_traced_and_batched():
+    cfg = SimConfig(n_nodes=32, n_faulty=4, trials=8, max_rounds=16,
+                    seed=3, delivery="quorum", scheduler="uniform",
+                    path="histogram")
+    pt = run_point(cfg)
+    with count_backend_compiles() as cc:
+        pt2 = run_point(_off(cfg))
+    assert cc.count == 0
+    _points_equal(pt, pt2)
+
+    # the batched engine AOT-compiles its bucket executable every
+    # invocation by design (compile accounting is measured, not
+    # inferred) — the identity pin is therefore EQUAL compile counts
+    # plus bit-equal points, not a cache hit
+    cb = run_points_batched(cfg, [cfg, cfg.replace(n_faulty=6)])
+    cb2 = run_points_batched(_off(cfg),
+                             [_off(cfg), _off(cfg).replace(n_faulty=6)])
+    assert cb2.compile_count == cb.compile_count
+    assert cb2.n_buckets == cb.n_buckets
+    for a, b in zip(cb.points, cb2.points):
+        _points_equal(a, b)
+
+
+def test_injection_off_identity_sliced():
+    cfg = SimConfig(n_nodes=24, n_faulty=3, trials=4, max_rounds=16,
+                    seed=4)
+    faults = default_crash_faults(cfg)
+    state = init_state(cfg, random_inputs(4, 4, 24), faults)
+    key = jax.random.key(cfg.seed)
+    st = start_state(cfg, state)
+    r1, s1 = run_consensus_slice(cfg, st, faults, key, jnp.int32(1),
+                                 jnp.int32(cfg.max_rounds + 2))
+    with count_backend_compiles() as cc:
+        r2, s2 = run_consensus_slice(_off(cfg), st, faults, key,
+                                     jnp.int32(1),
+                                     jnp.int32(cfg.max_rounds + 2))
+    assert cc.count == 0
+    assert int(r1) == int(r2)
+    _assert_state_equal(s1, s2)
+
+
+def test_injection_off_identity_fused_pallas():
+    old = sampling.EXACT_TABLE_MAX
+    sampling.EXACT_TABLE_MAX = 4
+    try:
+        cfg = SimConfig(n_nodes=96, n_faulty=24, trials=4, max_rounds=16,
+                        seed=5, delivery="quorum", scheduler="uniform",
+                        path="histogram", use_pallas_hist=True,
+                        use_pallas_round=True)
+        assert tally.pallas_round_active(cfg)
+        faults = default_crash_faults(cfg)
+        state = init_state(cfg, balanced_inputs(4, 96), faults)
+        key = jax.random.key(cfg.seed)
+        r1, s1 = run_consensus(cfg, state, faults, key)
+        with count_backend_compiles() as cc:
+            r2, s2 = run_consensus(_off(cfg), state, faults, key)
+        assert cc.count == 0
+        assert int(r1) == int(r2)
+        _assert_state_equal(s1, s2)
+    finally:
+        sampling.EXACT_TABLE_MAX = old
+
+
+def test_injection_off_identity_sharded():
+    from benor_tpu.parallel import make_mesh, run_consensus_sharded
+
+    cfg = SimConfig(n_nodes=32, n_faulty=4, trials=8, max_rounds=16,
+                    seed=6, delivery="quorum", scheduler="uniform",
+                    path="histogram")
+    faults = default_crash_faults(cfg)
+    state = init_state(cfg, random_inputs(6, 8, 32), faults)
+    key = jax.random.key(cfg.seed)
+    mesh = make_mesh(2, 4)
+    r1, s1 = run_consensus_sharded(cfg, state, faults, key, mesh)
+    with count_backend_compiles() as cc:
+        r2, s2 = run_consensus_sharded(_off(cfg), state, faults, key,
+                                       mesh)
+    assert cc.count == 0
+    assert int(r1) == int(r2)
+    _assert_state_equal(s1, s2)
+
+
+# --------------------------------------------------------------------------
+# omission: the one-bucket drop curve (acceptance) + path duality
+# --------------------------------------------------------------------------
+
+
+def test_drop_curve_single_compile_and_oracle_bit_equal():
+    """The acceptance pin: a whole rounds-vs-drop_prob curve is ONE
+    bucket executable (compile_counter-measured), bit-equal per point to
+    the run_point oracle.  Zero-crash faults (faults/curves.drop_curve's
+    policy): the quorum slack F is what absorbs the thinning."""
+    from benor_tpu.faults.curves import drop_curve
+
+    base = SimConfig(n_nodes=64, n_faulty=16, trials=8, max_rounds=24,
+                     seed=7, path="histogram")
+    ps = (0.02, 0.05, 0.1, 0.15)
+    rows, cb = drop_curve(base, ps)      # warm the eager input helpers
+    assert cb.n_buckets == 1
+    assert cb.compile_count == 1
+    assert cb.bucket_kinds == ["dyn"]
+    # the whole-scope pin: with the eager helpers warm, re-running the
+    # ENTIRE curve costs exactly the one bucket executable build (the
+    # batched engine AOT-compiles per invocation by design)
+    with count_backend_compiles() as cc:
+        rows, cb = drop_curve(base, ps)
+    assert cb.compile_count == 1
+    assert cc.count == 1
+    none = FaultSpec.none(base.trials, base.n_nodes)
+    for p, pt in zip(ps, cb.points):
+        _points_equal(run_point(base.replace(drop_prob=p), faults=none),
+                      pt)
+    assert [r["drop_prob"] for r in rows] == list(ps)
+
+
+def test_drop_slows_convergence_both_paths():
+    """Omission is really injected on BOTH compute paths: with p in the
+    live regime (p < F/N) rounds-to-decide is no faster than lossless
+    delivery, and the dense per-edge mask and the histogram binomial
+    thinning agree on full termination (fixed seeds — deterministic).
+    Zero crashes: with the live population pinned to the quorum exactly,
+    any drop stalls every receiver (the cliff the curve policy avoids)."""
+    base = SimConfig(n_nodes=48, n_faulty=12, trials=16, max_rounds=32,
+                     seed=8, path="histogram")
+    none = FaultSpec.none(base.trials, base.n_nodes)
+    p0 = run_point(base, faults=none)
+    ph = run_point(base.replace(drop_prob=0.08), faults=none)
+    pd = run_point(base.replace(drop_prob=0.08, path="dense"),
+                   faults=none)
+    assert ph.decided_frac == 1.0 and pd.decided_frac == 1.0
+    # near the threshold the per-lane stalls dominate: strictly slower
+    # than lossless delivery (fixed seed — deterministic, not flaky)
+    near = run_point(base.replace(drop_prob=0.2), faults=none)
+    assert near.mean_k > p0.mean_k
+    # past the stall threshold (p >= F/N) the network effectively
+    # stalls to the round cap (a rare lucky lane may still clear the
+    # thinning's tail — hence < 5%, not == 0)
+    stall = run_point(base.replace(drop_prob=0.4), faults=none)
+    assert stall.decided_frac < 0.05
+    assert stall.rounds_executed == base.max_rounds
+    # and crash-from-birth faults + ANY drop is the stall cliff: live
+    # population == quorum exactly, no slack to absorb thinning
+    cliff = run_point(base.replace(drop_prob=0.08, max_rounds=8))
+    assert cliff.decided_frac < 0.2
+
+
+# --------------------------------------------------------------------------
+# crash-recovery churn
+# --------------------------------------------------------------------------
+
+
+def test_crash_recover_never_rejoin_equals_crash_at_round():
+    """recovery down=0 (never rejoins) IS crash_at_round: same killed
+    derivation, same streams, bit-identical results."""
+    cfg_cr = SimConfig(n_nodes=32, n_faulty=6, trials=8, max_rounds=20,
+                       seed=9, fault_model="crash_recover",
+                       recovery="at:3:0")
+    cfg_car = cfg_cr.replace(fault_model="crash_at_round", recovery=None)
+    iv = random_inputs(9, 8, 32)
+    f_cr = default_crash_faults(cfg_cr)
+    f_car = FaultSpec.first_f(cfg_car,
+                              crash_rounds=np.where(np.arange(32) < 6,
+                                                    3, 0))
+    key = jax.random.key(9)
+    r1, s1 = run_consensus(cfg_cr, init_state(cfg_cr, iv, f_cr), f_cr,
+                           key)
+    r2, s2 = run_consensus(cfg_car, init_state(cfg_car, iv, f_car),
+                           f_car, key)
+    assert int(r1) == int(r2)
+    _assert_state_equal(s1, s2)
+
+
+def test_crash_recover_down_interval_freezes_then_rejoins():
+    """A down lane's witnessed (x, decided, k ~ participation) freeze
+    for the whole interval, and it participates again after rejoin —
+    the clean-run semantics the down_silence invariant audits.  The
+    crash is at round 1 so the interval BINDS: full delivery converges
+    in ~1 round, and a later crash would watch an already-settled
+    network."""
+    cfg = SimConfig(n_nodes=32, n_faulty=4, trials=4, max_rounds=24,
+                    seed=10, fault_model="crash_recover",
+                    recovery="at:1:5", witness_trials=(0,),
+                    witness_nodes=8)
+    report, bundle = audit_point(cfg)
+    assert report.ok
+    buf = np.asarray(bundle.buffer)
+    # watched node 0 is faulty (first-F) with interval [1, 6)
+    assert int(bundle.down_crash[0, 0]) == 1
+    assert int(bundle.down_recover[0, 0]) == 6
+    written = np.nonzero(buf[:, 0, 0, WIT_WRITTEN] > 0)[0]
+    inside = [r for r in written if 1 <= r < 6]
+    assert inside, "run must outlast the down interval"
+    for r in inside:
+        assert buf[r, 0, 0, WIT_X] == buf[0, 0, 0, WIT_X]
+        assert buf[r, 0, 0, WIT_DECIDED] == buf[0, 0, 0, WIT_DECIDED]
+        assert buf[r, 0, 0, WIT_DECIDED] == 0
+    # the trial cannot settle while the lane is down: the loop ran to
+    # the rejoin round, where the lane finally decides
+    assert written[-1] >= 6
+    assert buf[written[-1], 0, 0, WIT_DECIDED] == 1
+
+
+@pytest.mark.parametrize("rejoin", ["durable", "amnesia"])
+def test_crash_recover_packed_bit_identical_to_unfused(rejoin):
+    """The packed pallas path re-derives down-intervals from the round
+    bounds in-kernel: use_pallas_round is bit-identical to the unfused
+    pallas-hist path under churn, durable AND amnesia rejoins."""
+    old = sampling.EXACT_TABLE_MAX
+    sampling.EXACT_TABLE_MAX = 4
+    try:
+        # F = 40 keeps the CF-sampled tallies (~ m/2 = 28 per class)
+        # under the decide bar for the first rounds, so the run outlasts
+        # the churn window instead of deciding before anyone crashes
+        base = dict(n_nodes=96, trials=8, n_faulty=40, max_rounds=24,
+                    seed=11, delivery="quorum", scheduler="uniform",
+                    path="histogram", fault_model="crash_recover",
+                    recovery=f"at:2:4:{rejoin}")
+        c_hist = SimConfig(use_pallas_hist=True, **base)
+        c_round = SimConfig(use_pallas_hist=True, use_pallas_round=True,
+                            **base)
+        assert tally.pallas_round_active(c_round)
+        fl = default_crash_faults(c_round)
+        iv = balanced_inputs(8, 96)
+        key = jax.random.key(11)
+        ra, fa = run_consensus(c_hist, init_state(c_hist, iv, fl), fl,
+                               key)
+        rb, fb = run_consensus(c_round, init_state(c_round, iv, fl), fl,
+                               key)
+        # the run must actually cross the churn window, or the pin is
+        # vacuous (the faulty lanes are down for rounds [2, 6))
+        assert int(ra) >= 6
+        assert int(ra) == int(rb)
+        _assert_state_equal(fa, fb)
+    finally:
+        sampling.EXACT_TABLE_MAX = old
+
+
+def test_crash_recover_sliced_sharded_batched_bit_identical():
+    from benor_tpu.parallel import make_mesh, run_consensus_sharded
+
+    cfg = SimConfig(n_nodes=32, n_faulty=6, trials=8, max_rounds=20,
+                    seed=12, fault_model="crash_recover",
+                    recovery="stagger:2:4:amnesia")
+    iv = random_inputs(12, 8, 32)
+    faults = default_crash_faults(cfg)
+    state = init_state(cfg, iv, faults)
+    key = jax.random.key(12)
+    r0, fin0 = run_consensus(cfg, state, faults, key)
+
+    # sliced
+    cur, r = start_state(cfg, state), jnp.int32(1)
+    while True:
+        nr, cur = run_consensus_slice(cfg, cur, faults, key, r, r + 3)
+        if int(nr) == int(r):
+            break
+        r = nr
+    _assert_state_equal(fin0, cur)
+
+    # sharded (trials x nodes mesh)
+    r2, fin2 = run_consensus_sharded(cfg, state, faults, key,
+                                     make_mesh(2, 4))
+    assert int(r2) == int(r0)
+    _assert_state_equal(fin0, fin2)
+
+    # batched engine (dyn bucket; fault spec built by the same policy)
+    cb = run_points_batched(cfg, [cfg])
+    _points_equal(run_point(cfg), cb.points[0])
+
+
+# --------------------------------------------------------------------------
+# partitions
+# --------------------------------------------------------------------------
+
+
+def test_partition_stalls_until_heal():
+    """halves:<h> with F < N/2 is a clean liveness attack: no group can
+    muster the quorum N - F, every lane stalls (k frozen), and the run
+    converges only after the heal — every decided lane's k exceeds the
+    heal round."""
+    heal = 6
+    cfg = SimConfig(n_nodes=32, n_faulty=4, trials=8, max_rounds=24,
+                    seed=13, partition=f"halves:{heal}")
+    pt = run_point(cfg)
+    base = run_point(cfg.replace(partition=None))
+    assert pt.rounds_executed >= heal
+    assert pt.decided_frac == 1.0
+    # k histogram: no decided lane with k <= heal (k = r + 1, r >= heal)
+    assert pt.k_hist[:heal + 1].sum() == 0
+    assert pt.mean_k > base.mean_k
+
+
+def test_partition_cannot_split_brain():
+    """The quorum N - F spans EVERY minority group (a group holds at
+    most ~N/2 < N - F members for any F < N/2), so a partition can
+    starve liveness but never manufacture split-brain: even with
+    per-group UNANIMOUS opposing inputs — the textbook partition
+    nightmare — nothing decides inside the epoch, and after the heal
+    the merged network agrees."""
+    n, heal = 32, 6
+    cfg = SimConfig(n_nodes=n, n_faulty=4, trials=8, max_rounds=24,
+                    seed=14, partition=f"halves:{heal}")
+    iv = np.concatenate([np.zeros(n // 2, np.int8),
+                         np.ones(n // 2, np.int8)])
+    pt = run_point(cfg, initial_values=np.tile(iv, (8, 1)),
+                   faults=FaultSpec.none(8, n))
+    assert pt.k_hist[:heal + 1].sum() == 0     # no in-epoch decide
+    assert pt.disagree_frac == 0.0             # no split-brain, ever
+    assert pt.decided_frac == 1.0              # heals, then agrees
+
+
+def test_partition_composes_with_topology():
+    cfg = SimConfig(n_nodes=32, n_faulty=1, trials=4, max_rounds=24,
+                    seed=15, topology="ring:4", partition="halves:4",
+                    witness_trials=(0,), witness_nodes=6)
+    report, bundle = audit_point(
+        cfg, initial_values=np.ones((4, 32), np.int8),
+        faults=FaultSpec.none(4, 32), unanimous=1)
+    assert bundle.tally_bound == 5          # d + 1
+    assert bundle.partition == "halves:4"
+    assert report.ok
+
+
+# --------------------------------------------------------------------------
+# audits: clean across families, forgeries pinpointed (acceptance)
+# --------------------------------------------------------------------------
+
+
+def test_audit_clean_across_fault_families():
+    common = dict(n_nodes=32, trials=4, max_rounds=24, seed=16,
+                  witness_trials=(0, 1), witness_nodes=8)
+    fams = [
+        (SimConfig(n_faulty=4, fault_model="crash_recover",
+                   recovery="at:1:4", **common), None),
+        (SimConfig(n_faulty=4, fault_model="crash_recover",
+                   recovery="stagger:1:3:amnesia", **common), None),
+        # zero crashes for the omission point (the quorum slack absorbs
+        # the thinning; crash faults would stall every receiver)
+        (SimConfig(n_faulty=8, drop_prob=0.05, **common),
+         FaultSpec.none(4, 32)),
+        (SimConfig(n_faulty=4, partition="halves:4", **common), None),
+    ]
+    for cfg, faults in fams:
+        report, _ = audit_point(cfg, faults=faults,
+                                label=f"clean {cfg.fault_model}")
+        assert report.ok, (cfg, report.summary())
+        if cfg.fault_model == "crash_recover":
+            assert report.checks["down_silence"] >= 1
+
+
+def test_audit_flags_forged_decide_in_down_interval():
+    """The acceptance forgery: a decide written inside a down interval
+    is caught with its exact (trial, node, round)."""
+    cfg = SimConfig(n_nodes=32, n_faulty=4, trials=4, max_rounds=24,
+                    seed=10, fault_model="crash_recover",
+                    recovery="at:1:5", witness_trials=(0,),
+                    witness_nodes=8)
+    report, bundle = audit_point(cfg)
+    assert report.ok
+    forged = np.array(bundle.buffer)
+    rd = 3                                # inside [1, 6)
+    assert forged[rd, 0, 0, WIT_WRITTEN] > 0
+    forged[rd, 0, 0, WIT_DECIDED] = 1
+    forged[rd, 0, 0, WIT_X] = 1
+    forged[rd, 0, 0, WIT_V1] = cfg.n_faulty + 1
+    rep = audit_witness(WitnessBundle(
+        buffer=forged, trial_ids=bundle.trial_ids,
+        node_ids=bundle.node_ids, rule=cfg.rule, n_faulty=cfg.n_faulty,
+        n_nodes=cfg.n_nodes, down_crash=bundle.down_crash,
+        down_recover=bundle.down_recover))
+    hits = [v for v in rep.violations if v.invariant == "down_silence"]
+    assert hits
+    v = hits[0]
+    assert (v.trial, v.round, v.nodes) == (0, rd, [0])
+    assert v.detail["crash_round"] == 1
+    assert v.detail["recover_round"] == 6
+
+
+def test_audit_flags_forged_cross_partition_quorum():
+    """The other acceptance forgery: a tally no partition group could
+    deliver during the epoch is flagged as forged evidence, pinpointed
+    to (trial, node, round)."""
+    heal = 6
+    cfg = SimConfig(n_nodes=32, n_faulty=4, trials=4, max_rounds=24,
+                    seed=13, partition=f"halves:{heal}",
+                    witness_trials=(0,), witness_nodes=8)
+    report, bundle = audit_point(cfg)
+    assert report.ok
+    forged = np.array(bundle.buffer)
+    rd = 3                                # inside the epoch (< heal)
+    assert forged[rd, 0, 0, WIT_WRITTEN] > 0
+    gsize = group_size_of(int(bundle.node_ids[0]), cfg.n_nodes,
+                          parse_partition(cfg.partition))
+    forged[rd, 0, 0, WIT_V0] = gsize + 5  # beyond the group
+    forged[rd, 0, 0, WIT_V1] = 0
+    rep = audit_witness(WitnessBundle(
+        buffer=forged, trial_ids=bundle.trial_ids,
+        node_ids=bundle.node_ids, rule=cfg.rule, n_faulty=cfg.n_faulty,
+        n_nodes=cfg.n_nodes, partition=cfg.partition))
+    hits = [v for v in rep.violations
+            if v.invariant == "quorum_evidence"
+            and v.detail.get("group_size") == gsize]
+    assert hits
+    v = hits[0]
+    assert (v.trial, v.round, v.nodes) == (0, rd, [0])
+    # the SAME tally after the heal is legal (whole network again)
+    healed = np.array(bundle.buffer)
+    post = [r for r in
+            np.nonzero(healed[:, 0, 0, WIT_WRITTEN] > 0)[0]
+            if r >= heal]
+    assert post, "run must outlast the epoch"
+    healed[post[0], 0, 0, WIT_V0] = gsize + 5
+    rep2 = audit_witness(WitnessBundle(
+        buffer=healed, trial_ids=bundle.trial_ids,
+        node_ids=bundle.node_ids, rule=cfg.rule, n_faulty=cfg.n_faulty,
+        n_nodes=cfg.n_nodes, partition=cfg.partition))
+    assert not any(v.detail.get("group_size") == gsize
+                   for v in rep2.violations)
+
+
+def test_bundle_roundtrip_with_faultlab_fields(tmp_path):
+    import json
+    import sys, os
+    from benor_tpu.audit import load_bundle, save_bundle
+
+    cfg = SimConfig(n_nodes=24, n_faulty=3, trials=2, max_rounds=16,
+                    seed=17, fault_model="crash_recover",
+                    recovery="at:2:3", witness_trials=(0,),
+                    witness_nodes=4)
+    report, bundle = audit_point(cfg, label="roundtrip")
+    path = tmp_path / "bundle.json"
+    save_bundle(str(path), bundle, report)
+    back = load_bundle(str(path))
+    assert back.partition is None
+    np.testing.assert_array_equal(back.down_crash, bundle.down_crash)
+    np.testing.assert_array_equal(back.down_recover,
+                                  bundle.down_recover)
+    assert audit_witness(back).ok
+    # schema-valid (tools/witness_bundle_schema.json)
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    try:
+        import check_metrics_schema as cms
+        assert cms.check_witness_bundle(
+            json.loads(path.read_text())) == []
+    finally:
+        sys.path.pop(0)
+
+
+# --------------------------------------------------------------------------
+# structural pallas demotion
+# --------------------------------------------------------------------------
+
+
+def test_faults_demotion_warns_and_counts():
+    import benor_tpu.sim as sim
+    from benor_tpu.utils.metrics import REGISTRY
+
+    sim._faults_demotion_warned = False
+    cfg = SimConfig(n_nodes=16, n_faulty=2, trials=2, drop_prob=0.05,
+                    use_pallas_round=True, use_pallas_hist=True)
+    before = REGISTRY.counter("sim.demotion.faults").value
+    with pytest.warns(UserWarning, match="fault plane armed"):
+        run_point(cfg)
+    assert REGISTRY.counter("sim.demotion.faults").value > before
+    sim._faults_demotion_warned = True
+
+
+# --------------------------------------------------------------------------
+# serve satellites: CONFIG_FIELDS, 400s, bucket keys, end-to-end
+# --------------------------------------------------------------------------
+
+
+def test_serve_jobspec_faultlab_fields():
+    from benor_tpu.serve.jobs import JobSpec
+
+    spec = JobSpec.from_dict({"n_nodes": 32, "n_faulty": 4, "trials": 4,
+                              "drop_prob": 0.05})
+    assert spec.to_config().drop_prob == 0.05
+    spec = JobSpec.from_dict({"n_nodes": 32, "n_faulty": 4, "trials": 4,
+                              "fault_model": "crash_recover",
+                              "recovery": "stagger:2:3:amnesia"})
+    assert spec.to_config().recovery == "stagger:2:3:amnesia"
+    spec = JobSpec.from_dict({"n_nodes": 32, "n_faulty": 4, "trials": 4,
+                              "partition": "halves:5"})
+    assert spec.to_config().partition == "halves:5"
+
+
+def test_serve_jobspec_faultlab_structured_400s():
+    from benor_tpu.serve.jobs import JobError, JobSpec
+
+    cases = [
+        ({"drop_prob": "lots"}, "drop_prob"),
+        ({"recovery": 7}, "recovery"),
+        ({"partition": ["halves", 5]}, "partition"),
+        # SimConfig-level rejections surface on the 'config' field
+        ({"drop_prob": 0.2, "delivery": "quorum"}, "config"),
+        ({"recovery": "at:2:3"}, "config"),          # needs crash_recover
+        ({"partition": "halves:0"}, "config"),       # bad heal round
+        ({"fault_model": "crash_recover",
+          "recovery": "sometimes:1:2"}, "config"),   # bad grammar
+    ]
+    base = {"n_nodes": 32, "n_faulty": 4, "trials": 4}
+    for doc, field in cases:
+        with pytest.raises(JobError) as ei:
+            JobSpec.from_dict({**base, **doc})
+        assert ei.value.body["field"] == field, (doc, ei.value.body)
+
+
+def test_serve_bucket_key_drop_coalesces_specs_separate():
+    from benor_tpu.serve.batcher import serve_bucket_key
+
+    base = SimConfig(n_nodes=32, n_faulty=4, trials=4, seed=0)
+    a = serve_bucket_key(base.replace(drop_prob=0.05))
+    b = serve_bucket_key(base.replace(drop_prob=0.2, seed=9))
+    assert a == b                       # dyn axis + seed erased
+    assert serve_bucket_key(base.replace(drop_prob=0.05)) != \
+        serve_bucket_key(base)          # armed never coalesces with off
+    p1 = serve_bucket_key(base.replace(partition="halves:4"))
+    p2 = serve_bucket_key(base.replace(partition="halves:8"))
+    assert p1 != p2                     # partition specs bucket apart
+    r1 = serve_bucket_key(base.replace(fault_model="crash_recover",
+                                       recovery="at:2:3"))
+    r2 = serve_bucket_key(base.replace(fault_model="crash_recover",
+                                       recovery="at:2:5"))
+    assert r1 != r2                     # churn schedules bucket apart
+
+
+def test_serve_end_to_end_faultlab_jobs_bit_equal_run_point():
+    """Faultlab jobs through the REAL batcher equal the oracle — the
+    serve house rule extended to the new planes."""
+    from benor_tpu.serve.batcher import Batcher
+
+    b = Batcher(start=False)
+    try:
+        docs = [
+            {"n_nodes": 32, "n_faulty": 8, "trials": 4, "max_rounds": 16,
+             "seed": 6, "drop_prob": 0.05},
+            {"n_nodes": 32, "n_faulty": 4, "trials": 4, "max_rounds": 16,
+             "seed": 6, "fault_model": "crash_recover",
+             "recovery": "stagger:2:3:amnesia"},
+        ]
+        for doc in docs:
+            jobs = b.submit_dict(doc)
+            assert b.step() >= 1
+            job = jobs[0]
+            assert job.state == "done", job.error
+            pt = run_point(job.cfg)
+            assert job.result["mean_k"] == pt.mean_k
+            assert job.result["decided_frac"] == pt.decided_frac
+            assert job.result["k_hist"] == pt.k_hist.tolist()
+    finally:
+        b.close()
+
+
+# --------------------------------------------------------------------------
+# the faults manifest checker: tamper matrix
+# --------------------------------------------------------------------------
+
+
+def _good_faults_blob():
+    from benor_tpu.faults.report import faults_manifest
+
+    identity = {"bit_equal": True, "extra_compiles": 0}
+    curves = {
+        "drop_curve": [
+            {"drop_prob": 0.02, "n_nodes": 64, "n_faulty": 16,
+             "trials": 8, "mean_k": 2.5, "decided_frac": 1.0,
+             "rounds_executed": 4},
+            {"drop_prob": 0.1, "n_nodes": 64, "n_faulty": 16,
+             "trials": 8, "mean_k": 3.5, "decided_frac": 1.0,
+             "rounds_executed": 6},
+        ],
+        "drop_compile_count": 1, "drop_buckets": 1,
+        "churn_curve": [
+            {"down_rounds": 3, "recovery": "stagger:2:3", "n_nodes": 64,
+             "n_faulty": 8, "trials": 8, "mean_k": 4.0,
+             "decided_frac": 1.0, "rounds_executed": 8},
+        ],
+        "churn_compile_count": 1,
+    }
+    audits = {"crash_recover": {"ok": True, "checks": 10,
+                                "violations": 0}}
+    return faults_manifest(identity, curves, audits)
+
+
+def test_check_faults_manifest_tamper_matrix():
+    import os, sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    try:
+        import check_metrics_schema as cms
+    finally:
+        sys.path.pop(0)
+
+    assert cms.check_faults_manifest(_good_faults_blob()) == []
+
+    blob = _good_faults_blob()
+    blob["ok"] = False                      # contradicts its parts
+    assert any("contradicts" in e
+               for e in cms.check_faults_manifest(blob))
+
+    blob = _good_faults_blob()
+    blob["drop_curve"][1]["drop_prob"] = 0.3    # >= F/N stall threshold
+    assert any("stall threshold" in e
+               for e in cms.check_faults_manifest(blob))
+
+    blob = _good_faults_blob()
+    blob["drop_curve"].reverse()
+    assert any("not sorted" in e
+               for e in cms.check_faults_manifest(blob))
+
+    blob = _good_faults_blob()
+    blob["drop_compile_count"] = 2
+    assert any("one-bucket" in e
+               for e in cms.check_faults_manifest(blob))
+
+    blob = _good_faults_blob()
+    blob["churn_curve"][0]["down_rounds"] = 5   # != the parsed spec
+    assert any("down length" in e
+               for e in cms.check_faults_manifest(blob))
+
+    blob = _good_faults_blob()
+    blob["churn_curve"][0]["recovery"] = "sometimes:1:2"
+    assert any("unparseable" in e
+               for e in cms.check_faults_manifest(blob))
+
+    blob = _good_faults_blob()
+    blob["audits"]["crash_recover"]["violations"] = 2
+    assert any("claims ok" in e
+               for e in cms.check_faults_manifest(blob))
+
+    degraded = {"ok": True, "error": "boom"}
+    assert any("carries an 'error'" in e
+               for e in cms.check_faults_manifest(degraded))

@@ -482,15 +482,16 @@ def test_pack_rules_clean_on_shipped_table(tmp_path):
 
 
 @pytest.mark.parametrize("field", ["x", "decided", "killed", "coined",
-                                   "faulty", "k"])
+                                   "faulty", "down", "k"])
 def test_removing_any_pack_field_fails(tmp_path, field):
     # acceptance: removing ANY single bit-field from PACK_LAYOUT must
     # fail lint — NetState fields via pack-parity, the extra fields via
-    # parity-or-density (coined/faulty leave a plane gap AND break the
-    # PACK_EXTRA_FIELDS set)
+    # parity-or-density (coined/faulty/down leave a plane gap AND break
+    # the PACK_EXTRA_FIELDS set)
     root = _layout_tree(tmp_path)
     base = {"x": "(0, 2)", "decided": "(2, 1)", "killed": "(3, 1)",
-            "coined": "(4, 1)", "faulty": "(5, 1)", "k": "(6, 26)"}[field]
+            "coined": "(4, 1)", "faulty": "(5, 1)", "down": "(6, 1)",
+            "k": "(7, 25)"}[field]
     _edit(root, "state.py", f'    "{field}": {base},', "", count=1)
     active, _ = _findings(root, rules=_PACK_RULES)
     assert any(f.path == "state.py" for f in active), \
@@ -509,7 +510,7 @@ def test_pack_width_must_fit_word(tmp_path):
     # widening k past the uint32 word budget must fail — the declared
     # cap is what config.py's max_rounds validation enforces at runtime
     root = _layout_tree(tmp_path)
-    _edit(root, "state.py", '    "k": (6, 26),', '    "k": (6, 30),',
+    _edit(root, "state.py", '    "k": (7, 25),', '    "k": (7, 30),',
           count=1)
     active, _ = _findings(root, rules=["pack-layout"])
     assert any("word" in f.message for f in active)
@@ -519,8 +520,9 @@ def test_pack_undeclared_extra_field_fails(tmp_path):
     # a packed field that is neither a NetState leaf nor declared in
     # PACK_EXTRA_FIELDS rides the stack undocumented -> pack-parity
     root = _layout_tree(tmp_path)
-    _edit(root, "state.py", 'PACK_EXTRA_FIELDS = ("faulty", "coined")',
-          'PACK_EXTRA_FIELDS = ("faulty",)', count=1)
+    _edit(root, "state.py",
+          'PACK_EXTRA_FIELDS = ("faulty", "coined", "down")',
+          'PACK_EXTRA_FIELDS = ("faulty", "down")', count=1)
     active, _ = _findings(root, rules=["pack-parity"])
     assert any("coined" in f.message for f in active)
 
@@ -639,6 +641,51 @@ def test_config_parity_topology_fields_clean_and_mutation_fails(tmp_path):
           count=1)
     active, _ = _findings(root2, rules=["config-parity"])
     assert any("committee_cap" in f.message and "sweep.py" in f.message
+               for f in active)
+
+
+def test_config_parity_faultlab_fields_clean_and_mutation_fails(tmp_path):
+    """ISSUE 15 satellite: the faultlab fields (drop_prob, partition,
+    recovery, plus fault_model now that sim.injection_plane consumes it)
+    are policed across the five regimes — the shipped tree passes
+    (sweep.py references them in quorum_specialized / sweep_bucket_key /
+    default_crash_faults, ops/pallas_round.py reads fault_model and the
+    recovery rejoin mode itself; the remaining regime cells carry
+    reasoned PARITY_ALLOWLIST delegations), and removing the reference
+    from ONE regime fails lint."""
+    root = _parity_tree(tmp_path)
+    active, _ = _findings(root, rules=["config-parity"])
+    assert active == []        # clean as shipped (allowlist included)
+
+    # mutation: the sweep engine's bucketing stops seeing the omission
+    # axis — armed and off drop configs would silently share a bucket
+    _edit(root, "sweep.py", "if cfg.drop_prob or cfg.partition is not "
+          "None:", "if cfg.partition is not None:", count=1)
+    _edit(root, "sweep.py", "if cfg.drop_prob:", "if False:", count=1)
+    active, _ = _findings(root, rules=["config-parity"])
+    hits = [f for f in active if "drop_prob" in f.message]
+    assert len(hits) == 1
+    f = hits[0]
+    assert f.rule == "config-parity" and f.path == "sim.py"
+    assert "sweep.py" in f.message
+
+    # recovery mutation, independently: the default fault policy stops
+    # realizing the schedule
+    root2 = _parity_tree(tmp_path.joinpath("second"))
+    _edit(root2, "sweep.py", "if cfg.recovery is None:", "if False:",
+          count=1)
+    active, _ = _findings(root2, rules=["config-parity"])
+    assert any("recovery" in f.message and "sweep.py" in f.message
+               for f in active)
+
+    # partition mutation, independently: the bucketing predicate stops
+    # seeing the partition plane (its spec would still ride the key,
+    # but quorum_specialized is the reviewed consumption point)
+    root3 = _parity_tree(tmp_path.joinpath("third"))
+    _edit(root3, "sweep.py", "if cfg.drop_prob or cfg.partition is not "
+          "None:", "if cfg.drop_prob:", count=1)
+    active, _ = _findings(root3, rules=["config-parity"])
+    assert any("partition" in f.message and "sweep.py" in f.message
                for f in active)
 
 

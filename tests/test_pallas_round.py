@@ -197,15 +197,17 @@ def test_gating():
 
 
 def test_packed_k_field_overflow_rejected():
-    """ADVICE r4 (re-anchored on the PR 8 plane layout): max_rounds must
-    fit the PACK_LAYOUT k field's declared 26-plane cap (k reaches
+    """ADVICE r4 (re-anchored on the PR 8 plane layout, and again on the
+    PR 15 down-plane relayout — the k cap paid one plane for the
+    crash-recovery down bit, 26 -> 25): max_rounds must fit the
+    PACK_LAYOUT k field's declared 25-plane cap (k reaches
     max_rounds + 1)."""
     SimConfig(n_nodes=4, n_faulty=0, use_pallas_round=True,
-              max_rounds=(1 << 26) - 2)          # largest legal value
-    with pytest.raises(ValueError, match="26 bit-planes"):
+              max_rounds=(1 << 25) - 2)          # largest legal value
+    with pytest.raises(ValueError, match="25 bit-planes"):
         SimConfig(n_nodes=4, n_faulty=0, use_pallas_round=True,
-                  max_rounds=(1 << 26) - 1)
-    SimConfig(n_nodes=4, n_faulty=0, max_rounds=1 << 26)  # unfused: fine
+                  max_rounds=(1 << 25) - 1)
+    SimConfig(n_nodes=4, n_faulty=0, max_rounds=1 << 25)  # unfused: fine
 
 
 @pytest.mark.slow

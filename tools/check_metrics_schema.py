@@ -555,6 +555,149 @@ def check_topo_blob(blob: dict) -> List[str]:
     return errors
 
 
+def _load_faults_module(name: str):
+    """File-path-load benor_tpu/faults/<name>.py — stdlib-importable by
+    design (the same no-jax loading trick as _load_topo_graphs), so the
+    faults-blob checks re-derive recovery schedules and partition
+    geometry instead of trusting the document."""
+    import importlib.util
+
+    path = os.path.join(REPO, "benor_tpu", "faults", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(f"_benor_faults_{name}",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+#: Fields every drop-curve row must carry (drop_prob is the swept axis).
+FAULTS_DROP_ROW_FIELDS = ("drop_prob", "n_nodes", "n_faulty", "trials",
+                          "mean_k", "decided_frac", "rounds_executed")
+
+#: Fields every churn-curve row must carry (down_rounds is the severity
+#: axis; the recovery spec is the schedule it must re-derive from).
+FAULTS_CHURN_ROW_FIELDS = ("down_rounds", "recovery", "n_nodes",
+                           "n_faulty", "trials", "mean_k",
+                           "decided_frac", "rounds_executed")
+
+
+def check_faults_manifest(blob: dict) -> List[str]:
+    """Cross-field checks for the ``kind: faults_manifest`` document
+    (bench.py's ``faults`` sidecar blob, benor_tpu/faults/report.py).
+    Beyond key presence, pins the facts the ``faults_ok`` headline
+    rests on:
+
+      * every drop-curve row sweeps the ARMED omission plane
+        (0 < drop_prob < 1) BELOW the stall threshold F/N — a row past
+        it measures the round-cap asymptote, not the curve — and the
+        rows are sorted by drop_prob (the monotonicity axis);
+      * the drop curve really ran as ONE bucket executable
+        (``drop_compile_count == 1`` — the DynParams coalescing claim);
+      * every churn-curve row's ``recovery`` spec re-parses
+        (benor_tpu/faults/recovery.py, file-path-loaded) and its
+        ``down_rounds`` matches the parsed schedule — a hand-edited
+        severity axis cannot survive;
+      * every audit entry claiming ok carries zero violations;
+      * ``ok`` is recomputed from its parts (identity bit-equality +
+        zero extra compiles + non-empty curves + one-bucket claim +
+        clean audits).
+    """
+    errors: List[str] = []
+    if "error" in blob:
+        # the DEGRADED never-fail shape, like check_topo_blob's
+        if blob.get("ok"):
+            errors.append("$.faults: carries an 'error' but claims "
+                          "ok=true")
+        return errors
+    for key in ("ok", "off_identity", "drop_curve",
+                "drop_compile_count", "churn_curve",
+                "churn_compile_count", "audits"):
+        if key not in blob:
+            errors.append(f"$.faults: missing required key {key!r}")
+    if errors:
+        return errors
+    rows = blob["drop_curve"]
+    ps = []
+    for i, row in enumerate(rows):
+        missing = [f for f in FAULTS_DROP_ROW_FIELDS if f not in row]
+        if missing:
+            errors.append(f"$.faults.drop_curve[{i}]: missing {missing}")
+            continue
+        p = float(row["drop_prob"])
+        if not (0.0 < p < 1.0):
+            errors.append(
+                f"$.faults.drop_curve[{i}]: drop_prob {p} outside "
+                "(0, 1) — p = 0 is the injection-off config and "
+                "buckets separately (faults/curves.py rejects it)")
+        thresh = row["n_faulty"] / max(row["n_nodes"], 1)
+        if p >= thresh:
+            errors.append(
+                f"$.faults.drop_curve[{i}]: drop_prob {p} >= the stall "
+                f"threshold F/N = {thresh:.4f} — expected delivery "
+                "drops under the quorum N - F there and the row "
+                "measures the round-cap asymptote, not the curve")
+        ps.append(p)
+    if ps != sorted(ps):
+        errors.append(f"$.faults.drop_curve: rows not sorted by "
+                      f"drop_prob (the monotonicity axis): {ps}")
+    if rows and blob["drop_compile_count"] != 1:
+        errors.append(
+            f"$.faults.drop_compile_count: "
+            f"{blob['drop_compile_count']} != 1 — the drop curve's "
+            "one-bucket-executable claim (drop_prob rides DynParams) "
+            "does not hold")
+    recovery = _load_faults_module("recovery")
+    for i, row in enumerate(blob["churn_curve"]):
+        missing = [f for f in FAULTS_CHURN_ROW_FIELDS if f not in row]
+        if missing:
+            errors.append(f"$.faults.churn_curve[{i}]: missing "
+                          f"{missing}")
+            continue
+        try:
+            spec = recovery.parse_recovery(row["recovery"])
+        except ValueError as e:
+            errors.append(f"$.faults.churn_curve[{i}]: unparseable "
+                          f"recovery spec {row['recovery']!r}: {e}")
+            continue
+        if spec.down != row["down_rounds"]:
+            errors.append(
+                f"$.faults.churn_curve[{i}]: down_rounds "
+                f"{row['down_rounds']!r} != the parsed schedule's "
+                f"down length {spec.down} for spec {row['recovery']!r}")
+    audits = blob["audits"]
+    if not isinstance(audits, dict) or not audits:
+        errors.append("$.faults.audits: must be a non-empty "
+                      "family -> verdict mapping")
+        audits = {}
+    for fam, a in audits.items():
+        for key in ("ok", "checks", "violations"):
+            if key not in a:
+                errors.append(f"$.faults.audits.{fam}: missing {key!r}")
+        if a.get("ok") and a.get("violations", 0) != 0:
+            errors.append(
+                f"$.faults.audits.{fam}: claims ok with "
+                f"{a['violations']} violations")
+    ident = blob["off_identity"]
+    for k in ("bit_equal", "extra_compiles"):
+        if k not in ident:
+            errors.append(f"$.faults.off_identity: missing {k!r}")
+    if errors:
+        return errors
+    want_ok = (bool(ident["bit_equal"]) and ident["extra_compiles"] == 0
+               and len(rows) > 0 and len(blob["churn_curve"]) > 0
+               and blob["drop_compile_count"] == 1
+               and len(audits) > 0
+               and all(bool(a.get("ok")) for a in audits.values()))
+    if bool(blob["ok"]) != want_ok:
+        errors.append(
+            f"$.faults.ok: {blob['ok']} contradicts its parts "
+            f"(identity {ident}, {len(rows)}/{len(blob['churn_curve'])} "
+            f"curve rows, drop compiles {blob['drop_compile_count']}, "
+            f"audits { {k: a.get('ok') for k, a in audits.items()} })")
+    return errors
+
+
 SWEEP_SCHEMA_PATH = os.path.join(HERE, "sweep_manifest_schema.json")
 
 
@@ -898,6 +1041,7 @@ def check_witness_bundle(bundle: dict,
 #: below dispatches through the same registry, so "registered" always
 #: means "actually runnable".
 MANIFEST_CHECKERS = {
+    "faults_manifest": "check_faults_manifest",
     "kernel_manifest": "check_kernel_manifest",
     "perf_manifest": "check_perf_manifest",
     "scaling_manifest": "check_scaling_manifest",
@@ -968,6 +1112,13 @@ def main(argv=None) -> int:
         # (degree/diameter recomputation, curve monotonicity fields,
         # the one-bucket committee claim, the recomputed ok verdict)
         errors += check_topo_blob(detail["topo"])
+    if isinstance(detail.get("faults"), dict):
+        # PR 15: the faultlab blob's cross-field pins (stall threshold,
+        # schedule re-parse, one-bucket drop-curve claim, clean-audit
+        # verdicts, the recomputed ok) — the same checker the
+        # MANIFEST_CHECKERS registry dispatches for standalone
+        # kind:faults_manifest documents
+        errors += check_faults_manifest(detail["faults"])
     for e in errors:
         print(f"FAIL {e}", file=sys.stderr)
     n = headline_bytes(detail)
