@@ -1722,11 +1722,19 @@ def _sweepscope_check() -> dict:
         cb_on = run_curve_batched(base, fs, journal_path=jp)
         cb_res = run_curve_batched(base, fs, journal_path=jp,
                                    resume=True)
+    cb_pipe = run_curve_batched(base, fs, pipeline=True)
     bit_equal = all(science(a) == science(b)
                     for a, b in zip(cb_off.points, cb_on.points))
     compile_parity = cb_off.compile_count == cb_on.compile_count
     resume_bit_equal = all(science(a) == science(b)
                            for a, b in zip(cb_off.points, cb_res.points))
+    # PR 16: compile-ahead/execute-behind dispatch must change neither
+    # the science nor the per-bucket compile counts — only the wall
+    pipeline_bit_equal = all(science(a) == science(b)
+                             for a, b in zip(cb_off.points,
+                                             cb_pipe.points))
+    pipeline_compile_parity = (cb_pipe.bucket_compile_counts
+                               == cb_off.bucket_compile_counts)
 
     manifest = build_sweep_manifest(cb_off, base)
     spec = importlib.util.spec_from_file_location(
@@ -1746,6 +1754,11 @@ def _sweepscope_check() -> dict:
         "resume_compiles": cb_res.compile_count,
         "resume_buckets_reused": sum(cb_res.bucket_reused),
         "headroom_present": headroom_present,
+        "pipeline_bit_equal": pipeline_bit_equal,
+        "pipeline_compile_parity": pipeline_compile_parity,
+        "pipeline_span_s": round(cb_pipe.span_s, 6),
+        "pipeline_headroom_reclaimed_s": round(
+            cb_pipe.headroom_reclaimed_s, 6),
     }
     regressions = []
     comparable = None
@@ -1766,7 +1779,8 @@ def _sweepscope_check() -> dict:
     blob["regressions"] = regressions
     blob["ok"] = (not schema_errors and bit_equal and compile_parity
                   and resume_bit_equal and cb_res.compile_count == 0
-                  and headroom_present and not regressions)
+                  and headroom_present and pipeline_bit_equal
+                  and pipeline_compile_parity and not regressions)
     return blob
 
 

@@ -249,13 +249,14 @@ def _sweep(args) -> int:
               "`trace`/poll_rounds for per-round liveness",
               file=sys.stderr)
     if not args.batched and (args.journal or args.resume
-                             or args.trace_out or args.manifest_out):
+                             or args.trace_out or args.manifest_out
+                             or args.pipeline):
         # sweepscope instruments the BUCKET lifecycle; the per-point
         # path has no buckets — a silent no-op would fake durability/
         # tracing (the same house rule as --heartbeat-rounds)
-        print("warning: --journal/--resume/--trace-out/--manifest-out "
-              "instrument the batched engine's buckets; add --batched",
-              file=sys.stderr)
+        print("warning: --journal/--resume/--trace-out/--manifest-out/"
+              "--pipeline instrument the batched engine's buckets; "
+              "add --batched", file=sys.stderr)
     if args.resume and not args.journal:
         print("sweep: --resume requires --journal (the journal is the "
               "resume substrate)", file=sys.stderr)
@@ -263,7 +264,8 @@ def _sweep(args) -> int:
     if args.trace_out and args.batched:
         from .utils.metrics import SPANS
         SPANS.enable()
-    journal_kw = dict(journal_path=args.journal, resume=args.resume)
+    journal_kw = dict(journal_path=args.journal, resume=args.resume,
+                      pipeline=args.pipeline)
     mode = "balanced/no-crash" if args.balanced else "iid/crash"
     fb = " [cpu fallback]" if FELL_BACK else ""
     # banner reports the compute path actually taken, not the request:
@@ -723,20 +725,28 @@ def _scale(args) -> int:
     from .meshscope import (IncomparableScaling, build_scaling_manifest,
                             compare_scaling, load_scaling_manifest,
                             run_scaling_ladder, save_scaling_manifest)
+    from .meshscope.scaling import parse_mesh_2d
 
     sizes = args.mesh
+    try:
+        shapes_2d = [parse_mesh_2d(s) for s in (args.mesh_2d or [])]
+    except ValueError as e:
+        print(f"scale: {e}", file=sys.stderr)
+        return 1
+    need = max([max(sizes)] + [t * n for t, n in shapes_2d])
     import jax
     have = len(jax.devices())
-    if max(sizes) > have:
-        print(f"mesh ladder needs {max(sizes)} devices, have {have} — "
+    if need > have:
+        print(f"mesh ladder needs {need} devices, have {have} — "
               f"on CPU set XLA_FLAGS=--xla_force_host_platform_"
-              f"device_count={max(sizes)} (before jax initializes)",
+              f"device_count={need} (before jax initializes)",
               file=sys.stderr)
         return 1
     rows, scale = run_scaling_ladder(
         sizes, mode=args.mode, axis=args.axis, n_nodes=args.n,
         trials=args.trials, max_rounds=args.max_rounds, seed=args.seed,
-        reps=args.reps, verbose=args.format == "text")
+        reps=args.reps, verbose=args.format == "text",
+        mesh_2d=shapes_2d)
     manifest = build_scaling_manifest(rows, args.mode, args.axis, scale)
     fb = " [cpu fallback]" if FELL_BACK else ""
     if args.format == "json":
@@ -746,7 +756,9 @@ def _scale(args) -> int:
               f"({manifest['device_kind']}), {args.mode} ladder on the "
               f"{args.axis} axis, rungs {sizes}{fb}")
         for r in rows:
-            print(f"  d={r['devices']}: N={r['n_nodes']} "
+            ts, ns = r["mesh_shape"]
+            print(f"  mesh=({ts},{ns}) d={r['devices']}: "
+                  f"N={r['n_nodes']} "
                   f"T={r['trials']} rounds={r['rounds']} "
                   f"{r['node_rounds_per_sec']:.4g} node-rounds/s "
                   f"efficiency={r['efficiency']} "
@@ -1045,6 +1057,14 @@ def main(argv=None) -> int:
                         "engine: one XLA compile per static-shape bucket "
                         "instead of one per f value (bit-identical "
                         "summaries; see sweep.run_curve_batched)")
+    s.add_argument("--pipeline", action="store_true",
+                   help="with --batched: compile-ahead/execute-behind "
+                        "scheduler — bucket k+1's prepare + AOT "
+                        "compile overlaps bucket k's device execute "
+                        "on a host thread (bit-identical results and "
+                        "per-bucket compile counts; the manifest's "
+                        "pipeline block reports the headroom "
+                        "reclaimed vs the serial overlap model)")
     s.add_argument("--out", help="write points to this JSON file")
     s.add_argument("--heartbeat-out", metavar="PATH",
                    help="with --batched and a heartbeat cadence "
@@ -1239,6 +1259,13 @@ def main(argv=None) -> int:
                     help="comma-separated device counts, one ladder "
                          "rung each; MUST include 1 (efficiency is "
                          "measured vs the single-device rung)")
+    sc.add_argument("--mesh-2d", action="append", default=None,
+                    metavar="T,N",
+                    help="append an explicit 2D (trial_shards, "
+                         "node_shards) rung after the 1D ladder, e.g. "
+                         "--mesh-2d 2,2 --mesh-2d 2,4; weak mode "
+                         "grows BOTH problem axes with their shard "
+                         "counts (constant per-shard slab)")
     sc.add_argument("--mode", choices=("weak", "strong"), default="weak",
                     help="weak: the sharded axis's problem size grows "
                          "with the rung; strong: fixed problem spread "

@@ -26,7 +26,7 @@ CONFIG_FILE = "config.py"
 
 #: The compiled regimes that must keep parity with sim.py's consumption.
 REGIME_FILES = ("sweep.py", "ops/pallas_round.py", "parallel/sharded.py",
-                "parallel/multihost.py")
+                "parallel/multihost.py", "parallel/grid.py")
 
 #: (field, regime-file) -> why that regime legitimately never references
 #: the field.  Every entry is a REVIEWED delegation argument, not an
@@ -148,6 +148,50 @@ PARITY_ALLOWLIST = {
     ("recovery", "parallel/multihost.py"):
         "same as the sharded runner: the schedule travels as "
         "FaultSpec.recover_round built at the harness boundary",
+    # --- gridpipe: the 2D placement plane (parallel/grid.py, PR 16) ------
+    # grid.py is a PLACEMENT layer, not a compute regime: it factors the
+    # ('trials', 'nodes') mesh, device_puts the pytrees per GRID_RULES
+    # and dispatches the unchanged loop to run_consensus (mesh size 1)
+    # or run_consensus_sharded — every protocol/fault/observability
+    # field is consumed by the delegated regime, which has its own
+    # parity row above.  grid.py references exactly the fields that
+    # shape PLACEMENT (n_nodes, trials, record, witness*); the rest
+    # delegate:
+    ("debug", "parallel/grid.py"):
+        "grid dispatches to run_consensus / run_consensus_sharded, "
+        "which apply the debug demotion themselves",
+    ("seed", "parallel/grid.py"):
+        "grid places the caller's derived base_key (replicated per "
+        "GRID_RULES); jax.random.key(cfg.seed) happens at the harness "
+        "boundary like every compiled regime",
+    ("max_rounds", "parallel/grid.py"):
+        "the round loop and its cap live in the delegated runner "
+        "(sim.py / parallel/sharded.py); grid only places inputs",
+    ("heartbeat_rounds", "parallel/grid.py"):
+        "the heartbeat boundary lives in the delegated runner's slice "
+        "loop (sim.run_consensus_slice, sharded._local_slice); "
+        "placement happens once, before the first slice",
+    ("topology", "parallel/grid.py"):
+        "the adjacency gather runs inside the shared round kernel "
+        "reached through run_consensus_sharded; topology never "
+        "changes array shapes, so placement is indifferent to it",
+    ("committee_cap", "parallel/grid.py"):
+        "same as topology: committee dispatch is kernel-level in the "
+        "delegated regime and shape-invariant for placement",
+    ("fault_model", "parallel/grid.py"):
+        "FaultSpec arrays are placed by GRID_RULES leaf name (faulty/"
+        "crash_round/recover_round); their semantics compile in the "
+        "delegated round kernel",
+    ("drop_prob", "parallel/grid.py"):
+        "omission thinning is kernel-level in the delegated regime; "
+        "it reads no additional arrays for grid to place",
+    ("partition", "parallel/grid.py"):
+        "partition masks derive from global node ids inside the "
+        "delegated kernel; nothing partition-specific is placed",
+    ("recovery", "parallel/grid.py"):
+        "the schedule is realized into FaultSpec.recover_round at the "
+        "harness boundary; grid places the realized bounds like any "
+        "FaultSpec leaf",
 }
 
 

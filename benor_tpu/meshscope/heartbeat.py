@@ -198,16 +198,25 @@ def publish_slice_heartbeat(cfg, next_round, recorder=None,
 
 def publish_sweep_heartbeat(cfg, done: int, total: int,
                             publisher: Optional[HeartbeatPublisher] = None,
-                            path: Optional[str] = None) -> dict:
+                            path: Optional[str] = None,
+                            bucket_index: Optional[int] = None) -> dict:
     """Per-bucket heartbeat for the batched sweep engine
     (sweep.run_curve_batched): progress = points finished / points
     total.  Returns the record; pass a publisher to keep one rate state
-    across buckets (the engine does)."""
+    across buckets (the engine does).  ``bucket_index`` stamps which
+    bucket just completed — under pipelined dispatch the beats still
+    arrive in completion order (the engine publishes only from its
+    ordered thread), and the index makes that order auditable from the
+    ``watch`` tail."""
     pub = publisher if publisher is not None else HeartbeatPublisher(
         cfg, path=path, label="sweep")
+    extra = {}
+    if bucket_index is not None:
+        extra["bucket_index"] = int(bucket_index)
     return pub.publish(progress=done / max(total, 1),
                        done=(done >= total),
-                       points_done=int(done), points_total=int(total))
+                       points_done=int(done), points_total=int(total),
+                       **extra)
 
 
 # --------------------------------------------------------------------------

@@ -72,8 +72,11 @@ def _require_comparable(new: dict, base: dict) -> None:
             f"re-baseline")
 
 
-def _rows_by_rung(manifest: dict) -> Dict[Tuple[int, int], dict]:
-    return {(int(r["devices"]), int(r["n_nodes"])): r
+def _rows_by_rung(manifest: dict) -> Dict[Tuple, dict]:
+    # mesh_shape joins the key so a 2D grid rung (e.g. (2,2)) and a 1D
+    # rung at the same device count / n_nodes stay distinct rungs
+    return {(int(r["devices"]), int(r["n_nodes"]),
+             tuple(int(s) for s in (r.get("mesh_shape") or ()))): r
             for r in manifest.get("rows", [])}
 
 
@@ -89,13 +92,15 @@ def compare_scaling(new: dict, base: dict,
     new_rows = _rows_by_rung(new)
     base_rows = _rows_by_rung(base)
     for rung, old in sorted(base_rows.items()):
-        d, n = rung
+        d, n, shape = rung
         row = new_rows.get(rung)
         if row is None:
             out.append(ScalingFinding(
                 d, "row",
-                f"rung devices={d} n_nodes={n}: present in baseline but "
-                f"missing from the manifest — a ladder rung disappeared"))
+                f"rung devices={d} n_nodes={n}"
+                + (f" mesh={shape}" if shape else "")
+                + ": present in baseline but missing from the manifest "
+                  "— a ladder rung disappeared"))
             continue
         if row.get("rounds") != old.get("rounds"):
             out.append(ScalingFinding(

@@ -149,12 +149,31 @@ def attach_efficiency(rows: List[dict]) -> List[dict]:
     return rows
 
 
+def parse_mesh_2d(spec: str):
+    """One ``--mesh-2d t,n`` rung spec -> (trial_shards, node_shards).
+
+    The scale CLI's 2D rung grammar: two comma-separated positive
+    integers, e.g. ``2,2`` or ``2,4``."""
+    parts = [p.strip() for p in str(spec).split(",")]
+    try:
+        t, n = (int(p) for p in parts)
+    except ValueError:
+        raise ValueError(
+            f"--mesh-2d expects 't,n' (two comma-separated shard "
+            f"counts, e.g. 2,2); got {spec!r}") from None
+    if t < 1 or n < 1:
+        raise ValueError(f"--mesh-2d shard counts must be >= 1, got "
+                         f"({t}, {n})")
+    return t, n
+
+
 def run_scaling_ladder(mesh_sizes: Sequence[int], mode: str = "weak",
                        axis: str = "nodes",
                        n_nodes: Optional[int] = None,
                        trials: Optional[int] = None,
                        max_rounds: Optional[int] = None, seed: int = 0,
-                       reps: int = 2, verbose: bool = False):
+                       reps: int = 2, verbose: bool = False,
+                       mesh_2d: Optional[Sequence] = None):
     """Run the ladder -> (rows, scale dict) ready for the manifest.
 
     ``mesh_sizes`` are device counts (must include 1; see
@@ -163,7 +182,16 @@ def run_scaling_ladder(mesh_sizes: Sequence[int], mode: str = "weak",
     data-parallel leg).  ``mode``: 'weak' grows the sharded axis's
     problem dimension with the rung; 'strong' keeps it fixed (each
     rung's device count must divide it).
-    """
+
+    ``mesh_2d`` appends explicit 2D ``(trial_shards, node_shards)``
+    rungs after the 1D ladder (the ``--mesh-2d t,n`` CLI grammar;
+    strings accepted).  A 2D rung runs the same flagship regime on the
+    full ('trials', 'nodes') grid: in weak mode each mesh axis grows
+    its own problem dimension (n_nodes x node_shards, trials x
+    trial_shards — the per-shard slab stays constant in BOTH
+    directions), strong mode keeps the base shape.  Efficiency is still
+    anchored at the 1-device rung: ideal throughput scales with the
+    device count either way."""
     from ..parallel import make_mesh
     if mode not in ("weak", "strong"):
         raise ValueError(f"unknown scaling mode {mode!r}")
@@ -176,6 +204,8 @@ def run_scaling_ladder(mesh_sizes: Sequence[int], mode: str = "weak",
         raise ValueError(
             "scaling ladder needs the 1-device rung (--mesh 1,...): "
             "efficiency is measured vs the single-device row")
+    shapes_2d = [s if isinstance(s, tuple) else parse_mesh_2d(s)
+                 for s in (mesh_2d or [])]
     scale = dict(DEFAULT_SCALE)
     for key, val in (("n_nodes", n_nodes), ("trials", trials),
                      ("max_rounds", max_rounds)):
@@ -183,20 +213,21 @@ def run_scaling_ladder(mesh_sizes: Sequence[int], mode: str = "weak",
             scale[key] = int(val)
     scale["seed"] = int(seed)
     scale["reps"] = int(reps)
+    rungs = [((1, d) if axis == "nodes" else (d, 1)) for d in sizes]
+    rungs += shapes_2d
     rows = []
-    for d in sizes:
+    for ts, ns in rungs:
         n, t = scale["n_nodes"], scale["trials"]
         if mode == "weak":
-            if axis == "nodes":
-                n = n * d
-            else:
-                t = t * d
+            n = n * ns
+            t = t * ts
         cfg = _ladder_cfg(n, t, scale["max_rounds"], scale["seed"])
-        mesh = make_mesh(*((1, d) if axis == "nodes" else (d, 1)))
+        mesh = make_mesh(ts, ns)
         row = run_scaling_rung(cfg, mesh, reps=reps)
         rows.append(row)
         if verbose:
-            print(f"  rung d={d}: N={n} T={t} rounds={row['rounds']} "
+            print(f"  rung mesh=({ts},{ns}) d={ts * ns}: N={n} T={t} "
+                  f"rounds={row['rounds']} "
                   f"{row['node_rounds_per_sec']:.3g} node-rounds/s "
                   f"straggler={row['straggler_ratio']:.2f}", flush=True)
     return attach_efficiency(rows), scale

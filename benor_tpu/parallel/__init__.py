@@ -1,5 +1,8 @@
 """Multi-chip distribution: mesh construction and shard_map'd round kernels."""
 
+from .grid import (GRID_RULES, auto_factor, grid_batch_sharding,
+                   make_grid_mesh, partition_rules, place_batch,
+                   run_consensus_grid, shard_grid_inputs)
 from .mesh import (AXIS_NODES, AXIS_TRIALS, STATE_SPEC, make_mesh,
                    state_sharding)
 from .multihost import (global_mesh, init_multihost, local_block,
@@ -12,6 +15,9 @@ from .sharded import (MESH_CTX, resume_consensus_sharded,
 
 __all__ = [
     "AXIS_NODES", "AXIS_TRIALS", "STATE_SPEC", "make_mesh", "state_sharding",
+    "GRID_RULES", "auto_factor", "grid_batch_sharding", "make_grid_mesh",
+    "partition_rules", "place_batch", "run_consensus_grid",
+    "shard_grid_inputs",
     "MESH_CTX", "resume_consensus_sharded", "run_consensus_sharded",
     "run_consensus_slice_sharded", "shard_inputs",
     "init_multihost", "global_mesh", "local_block", "to_global",

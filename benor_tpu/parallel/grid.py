@@ -1,0 +1,206 @@
+"""2D (trials x nodes) grid placement for giant sweeps (ROADMAP item 1).
+
+``parallel/sharded.py`` owns the node-axis ``shard_map`` round kernel; this
+module is the *placement* layer above it: a partition-rule table mapping
+every ``NetState`` / ``FaultSpec`` / recorder / witness leaf to its
+``PartitionSpec``, an auto-factoring of the available devices into a
+``('trials', 'nodes')`` mesh, and ``run_consensus_grid`` — a single entry
+point whose results are bit-identical at every mesh shape:
+
+  * mesh (1, 1)  -> the traced single-device loop (``run_consensus``);
+  * mesh (1, d)  -> exactly ``run_consensus_sharded`` (node-only shards);
+  * mesh (t, n)  -> trials-axis data parallelism multiplying the node-axis
+                    psum tallies.  The trials axis carries no per-round
+                    collective (trials never communicate), so bit-identity
+                    follows from the (trial, node, round)-keyed RNG plus
+                    the integer-exact per-round reductions.
+
+The batched sweep engine reuses the same table through
+``grid_batch_sharding`` to place its stacked [B, T, N] bucket operands, so
+a 2D mesh accelerates every dyn bucket of ``run_points_batched`` without a
+second code path (GSPMD partitions the vmapped executable; the summaries
+are exact integer reductions, hence mesh-independent journal records).
+
+Rules follow the partition-rule pattern of t5x/EasyLM (SNIPPETS.md [1]/[3]):
+match on leaf name, fall through to replication.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..config import SimConfig
+from ..sim import run_consensus
+from .mesh import (AXIS_NODES, AXIS_TRIALS, STATE_SPEC, check_divisible,
+                   make_mesh)
+from .sharded import run_consensus_sharded
+
+#: Leaf-name -> PartitionSpec rules for the consensus pytrees.  Every
+#: [T, N] plane (the four NetState planes and the FaultSpec masks) is
+#: block-partitioned on both mesh axes; scalars, keys and the
+#: round-major observation buffers (flight recorder / witness — shaped
+#: [R, ...] and reduced across nodes before they leave the shard_map)
+#: stay replicated.
+GRID_RULES: Tuple[Tuple[str, P], ...] = (
+    # NetState planes
+    ("x", STATE_SPEC),
+    ("decided", STATE_SPEC),
+    ("k", STATE_SPEC),
+    ("killed", STATE_SPEC),
+    # FaultSpec planes
+    ("faulty", STATE_SPEC),
+    ("crash_round", STATE_SPEC),
+    ("recover_round", STATE_SPEC),
+    # loop-carried scalars / keys
+    ("base_key", P()),
+    ("rounds", P()),
+)
+
+#: Observation buffers are appended under cfg.record / cfg.witness; they
+#: are psum-reduced inside the round kernel and replicated on exit.
+OBSERVATION_RULES: Tuple[Tuple[str, P], ...] = (
+    ("recorder", P()),
+    ("witness", P()),
+)
+
+
+def partition_rules(cfg: SimConfig) -> dict:
+    """The active leaf-name -> PartitionSpec table for ``cfg``.
+
+    Observation entries (``recorder`` / ``witness``) appear only when the
+    corresponding plane is armed, so the table is also a manifest of what
+    the runner will return beyond ``(rounds, state)``.
+    """
+    rules = dict(GRID_RULES)
+    active = dict(OBSERVATION_RULES)
+    if cfg.record:
+        rules["recorder"] = active["recorder"]
+    if cfg.witness:
+        rules["witness"] = active["witness"]
+    return rules
+
+
+def spec_for(name: str, cfg: SimConfig) -> P:
+    """PartitionSpec for a named leaf (replicated if no rule matches)."""
+    return partition_rules(cfg).get(name, P())
+
+
+def auto_factor(n_devices: int, trials: int, n_nodes: int
+                ) -> Tuple[int, int]:
+    """Factor ``n_devices`` into a (trial_shards, node_shards) grid.
+
+    Prefers (1) using every device, (2) the largest node axis — the
+    node-axis histogram psum is the per-round collective and should ride
+    ICI; the trials axis only meets at the scalar termination psum.
+    Shards must divide their axis extents (block partitioning).
+    """
+    best = (1, 1)
+    best_rank = (1, 1)  # (devices used, node shards)
+    for node_shards in range(1, n_devices + 1):
+        if n_nodes % node_shards:
+            continue
+        trial_shards = min(n_devices // node_shards, trials)
+        while trial_shards > 1 and trials % trial_shards:
+            trial_shards -= 1
+        used = trial_shards * node_shards
+        if used > n_devices:
+            continue
+        rank = (used, node_shards)
+        if rank > best_rank:
+            best_rank, best = rank, (trial_shards, node_shards)
+    return best
+
+
+def make_grid_mesh(cfg: Optional[SimConfig] = None,
+                   trial_shards: Optional[int] = None,
+                   node_shards: Optional[int] = None,
+                   devices=None) -> Mesh:
+    """Build the ('trials', 'nodes') mesh.
+
+    Explicit shard counts win; otherwise the shape is auto-factored from
+    the available devices and ``cfg.trials`` / ``cfg.n_nodes`` (CPU smoke
+    via ``xla_force_host_platform_device_count`` factors the same way).
+    """
+    if devices is None:
+        devices = jax.devices()
+    if trial_shards is None and node_shards is None:
+        if cfg is None:
+            raise ValueError("auto-factoring a grid mesh needs cfg "
+                             "(trials / n_nodes extents)")
+        trial_shards, node_shards = auto_factor(
+            len(devices), cfg.trials, cfg.n_nodes)
+    return make_mesh(trial_shards or 1, node_shards, devices=devices)
+
+
+def shard_grid_inputs(cfg: SimConfig, state, faults, base_key, mesh: Mesh):
+    """Place the run inputs per the partition-rule table."""
+    rules = partition_rules(cfg)
+
+    def _put(name, leaf):
+        if leaf is None:
+            return None
+        return jax.device_put(
+            leaf, NamedSharding(mesh, rules.get(name, P())))
+
+    placed_state = type(state)(
+        **{f: _put(f, getattr(state, f)) for f in ("x", "decided", "k",
+                                                   "killed")})
+    placed_faults = type(faults)(
+        faulty=_put("faulty", faults.faulty),
+        crash_round=_put("crash_round", faults.crash_round),
+        recover_round=_put("recover_round", faults.recover_round),
+    )
+    placed_key = jax.device_put(
+        base_key, NamedSharding(mesh, rules.get("base_key", P())))
+    return placed_state, placed_faults, placed_key
+
+
+def run_consensus_grid(cfg: SimConfig, state, faults, base_key,
+                       mesh: Optional[Mesh] = None):
+    """Run the consensus loop on a 2D (trials x nodes) grid mesh.
+
+    Returns the same ``(rounds, state[, recorder][, witness])`` tuple as
+    ``run_consensus`` at every mesh shape.  ``mesh=None`` auto-factors
+    from the available devices; a 1-device mesh falls through to the
+    traced loop so the grid entry point is safe to use unconditionally.
+    """
+    if mesh is None:
+        mesh = make_grid_mesh(cfg)
+    if mesh.size == 1:
+        # (1, 1): the traced single-device loop IS the reference
+        return run_consensus(cfg, state, faults, base_key)
+    check_divisible(cfg.trials, cfg.n_nodes, mesh)
+    state, faults, base_key = shard_grid_inputs(
+        cfg, state, faults, base_key, mesh)
+    return run_consensus_sharded(cfg, state, faults, base_key, mesh)
+
+
+def grid_batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for the sweep engine's stacked [B, T, N] bucket operands:
+    bucket axis replicated (vmap lanes), trials/nodes block-partitioned."""
+    return NamedSharding(mesh, P(None, AXIS_TRIALS, AXIS_NODES))
+
+
+def place_batch(tree, mesh: Mesh):
+    """Place a stacked bucket pytree on the grid: every [B, T, N] leaf by
+    ``grid_batch_sharding``, everything else (DynParams scalars, key
+    stacks) replicated.  Bit-identity is free — the bucket summaries are
+    integer-exact reductions, so GSPMD partitioning cannot change them.
+    """
+    ts = mesh.shape[AXIS_TRIALS]
+    ns = mesh.shape[AXIS_NODES]
+    batch = grid_batch_sharding(mesh)
+    rep = NamedSharding(mesh, P())
+
+    def _put(leaf):
+        if leaf is None:
+            return None
+        if (getattr(leaf, "ndim", 0) == 3
+                and leaf.shape[1] % ts == 0 and leaf.shape[2] % ns == 0):
+            return jax.device_put(leaf, batch)
+        return jax.device_put(leaf, rep)
+
+    return jax.tree_util.tree_map(_put, tree)

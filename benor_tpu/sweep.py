@@ -402,6 +402,18 @@ class BatchedCurve:
     #: reclaim from the measured serial bucket schedule
     #: (sweepscope/gate.py owns the model)
     overlap_headroom_s: float = 0.0
+    #: True when the buckets ran under the compile-ahead/execute-behind
+    #: scheduler (run_points_batched(pipeline=True))
+    pipelined: bool = False
+    #: wall clock of the bucket loop alone — exactly the work the four
+    #: stage clocks cover, so serial_s - span_s is the overlap the real
+    #: scheduler achieved (gate.headroom_reclaimed_s owns the model)
+    span_s: float = 0.0
+    #: headroom actually reclaimed vs the strictly-serial stage schedule
+    headroom_reclaimed_s: float = 0.0
+    #: [trial_shards, node_shards] of the 2D grid mesh the dyn buckets
+    #: were placed on (None = default single-device placement)
+    mesh_shape: Optional[List[int]] = None
 
 
 def _summarize_inline(cfg: SimConfig, r, final: NetState, faults: FaultSpec):
@@ -422,7 +434,8 @@ def run_curve_batched(base_cfg: SimConfig, f_values: Sequence[int],
                       verbose: bool = False,
                       heartbeat_path: Optional[str] = None,
                       journal_path: Optional[str] = None,
-                      resume: bool = False) -> BatchedCurve:
+                      resume: bool = False, pipeline: bool = False,
+                      mesh=None) -> BatchedCurve:
     """Run a rounds-vs-f curve with one XLA compile per static-shape
     bucket — the f-axis front door of ``run_points_batched`` (which
     batches ANY per-point config list, e.g. the topo committee curves):
@@ -435,7 +448,8 @@ def run_curve_batched(base_cfg: SimConfig, f_values: Sequence[int],
                               initial_values=initial_values,
                               faults_for=faults_for, verbose=verbose,
                               heartbeat_path=heartbeat_path,
-                              journal_path=journal_path, resume=resume)
+                              journal_path=journal_path, resume=resume,
+                              pipeline=pipeline, mesh=mesh)
 
 
 def run_points_batched(base_cfg: SimConfig, cfgs: Sequence[SimConfig],
@@ -443,7 +457,8 @@ def run_points_batched(base_cfg: SimConfig, cfgs: Sequence[SimConfig],
                        verbose: bool = False,
                        heartbeat_path: Optional[str] = None,
                        journal_path: Optional[str] = None,
-                       resume: bool = False) -> BatchedCurve:
+                       resume: bool = False, pipeline: bool = False,
+                       mesh=None) -> BatchedCurve:
     """Run a list of per-point configs with one XLA compile per
     static-shape bucket (sweep_bucket_key groups them).
 
@@ -504,6 +519,30 @@ def run_points_batched(base_cfg: SimConfig, cfgs: Sequence[SimConfig],
     recompiled; any journal tamper reruns rather than reuses.  Journal
     and tracing are host-side only: off OR on, results and compile
     counts are bit-identical (tests/test_sweepscope.py).
+
+    ``pipeline=True`` (PR 16) switches bucket dispatch to the
+    compile-ahead/execute-behind scheduler sweepscope's
+    ``overlap_headroom`` model prices: a single worker thread runs
+    bucket k+1's prepare + AOT compile (host work; XLA compilation
+    releases the GIL) while the main thread executes bucket k on the
+    device.  Everything ORDERED stays on the main thread — execute,
+    fetch, journal records, heartbeat beats, verbose lines — in strict
+    bucket order, so results, per-bucket compile counts, journal
+    contents and heartbeat streams are bit-identical to the serial
+    path; only the wall clock changes.  The reclaimed overlap lands on
+    the curve as ``headroom_reclaimed_s`` (= serial stage sum minus the
+    measured bucket-loop ``span_s``, clamped at 0; gate.py owns the
+    model).
+
+    ``mesh`` places each dyn bucket's stacked [B, T, N] operands on a
+    2D ('trials', 'nodes') grid mesh (``parallel/grid.py``) so GSPMD
+    partitions the bucket executable across devices — trials-axis data
+    parallelism multiplying the node-axis sharding.  The per-point
+    summaries are integer-exact reductions, so results and journal
+    records are mesh-independent (bit-identical at every mesh shape,
+    and a journal written on one mesh resumes on another).  Static
+    (quorum-specialized) buckets keep the classic single-device
+    dispatch — their pallas fast path bakes shapes.
     """
     import warnings
 
@@ -525,6 +564,11 @@ def run_points_batched(base_cfg: SimConfig, cfgs: Sequence[SimConfig],
     if resume and journal_path is None:
         raise ValueError("resume=True requires journal_path (the "
                          "journal IS the resume substrate)")
+    mesh_shape = None
+    if mesh is not None:
+        from .parallel.mesh import check_divisible
+        check_divisible(T, N, mesh)
+        mesh_shape = [int(s) for s in mesh.devices.shape]
     if initial_values is None:
         initial_values = random_inputs(base_cfg.seed, T, N)
 
@@ -567,12 +611,16 @@ def run_points_batched(base_cfg: SimConfig, cfgs: Sequence[SimConfig],
         heartbeat = HeartbeatPublisher(base_cfg, path=heartbeat_path,
                                        label="sweep")
     points_done = 0
-    for bi, key in enumerate(order):
-        b = buckets[key]
+
+    def build_bucket(bi, key, b):
+        """Bucket k's HOST leg: fault specs + journal match + stacked
+        tensors + AOT compile.  Thread-safe by design — under
+        ``pipeline=True`` this runs on the compile-ahead worker while
+        the main thread executes bucket k-1 (XLA compilation releases
+        the GIL), and the per-bucket ``count_backend_compiles`` scope is
+        opened HERE only, never on the executing thread, so compile
+        attribution is identical in both dispatch modes."""
         rep = b["cfgs"][0]
-        bucket_sizes.append(len(b["idx"]))
-        bucket_kinds.append(key[0])
-        bucket_indices.append(list(b["idx"]))
         # -- prepare/stack: fault specs (also the journal fingerprint's
         # input), then — for buckets that will actually run — the
         # stacked state tensors
@@ -585,33 +633,9 @@ def run_points_batched(base_cfg: SimConfig, cfgs: Sequence[SimConfig],
             if resume:
                 rec = journal.match(b["fp"], b["idx"])
         if rec is not None:
-            # journal restore: the bucket's points reassemble from disk
-            # through the IDENTICAL point_from_raw path; no tensor is
-            # built, no executable compiled, nothing dispatched
-            share = (float(rec.get("run_s") or 0.0)
-                     + float(rec.get("fetch_s") or 0.0)) / len(b["idx"])
-            for j, i in enumerate(b["idx"]):
-                raw[i] = deserialize_point(b["cfgs"][j],
-                                           rec["points"][j])
-                secs[i] = share
-            restore_s = time.perf_counter() - t_prep0
-            # the lists carry the JOURNALED stage clocks so straggler
-            # attribution survives a resume; this run spent ~nothing
-            stage_prepare.append(float(rec.get("prepare_s") or 0.0))
-            stage_compile.append(float(rec.get("compile_s") or 0.0))
-            stage_run.append(float(rec.get("run_s") or 0.0))
-            stage_fetch.append(float(rec.get("fetch_s") or 0.0))
-            bucket_compiles.append(0)
-            bucket_reused.append(True)
-            journal.reused += 1
-            emit_bucket_spans(bi, key[0], b["idx"], b["cfgs"],
-                              {"restore": (t_prep0, restore_s)},
-                              reused=True)
-            points_done += len(b["idx"])
-            if heartbeat is not None:
-                publish_sweep_heartbeat(base_cfg, points_done,
-                                        len(cfgs), publisher=heartbeat)
-            continue
+            return {"bi": bi, "key": key, "b": b, "rec": rec,
+                    "t_prep0": t_prep0,
+                    "restore_s": time.perf_counter() - t_prep0}
         states = [init_state(c, initial_values, fl)
                   for c, fl in zip(b["cfgs"], faults)]
         # The executable returns the final states TOO (last position):
@@ -629,6 +653,14 @@ def run_points_batched(base_cfg: SimConfig, cfgs: Sequence[SimConfig],
             stacked = _stack_tree(states)
             stacked_faults = _stack_tree(faults)
             dyn = DynParams.stack(b["cfgs"])
+            if mesh is not None:
+                # 2D grid placement: GSPMD partitions the vmapped
+                # executable over ('trials', 'nodes'); the summaries
+                # are integer-exact reductions, so results (and journal
+                # records) are mesh-independent
+                from .parallel.grid import place_batch
+                stacked = place_batch(stacked, mesh)
+                stacked_faults = place_batch(stacked_faults, mesh)
 
             def runner(states, faults, dyn, bk, _cfg=rep):
                 def one(s, fl, d):
@@ -642,7 +674,9 @@ def run_points_batched(base_cfg: SimConfig, cfgs: Sequence[SimConfig],
         else:
             # init_state aliases killed to faults.faulty under the crash
             # model; the donated state must not share a buffer with the
-            # undonated faults argument ("donated buffer used twice")
+            # undonated faults argument ("donated buffer used twice").
+            # Static buckets stay on the default device even under a
+            # mesh: the quorum-specialized pallas path bakes shapes.
             st = states[0]
             state = NetState(x=st.x, decided=st.decided, k=st.k,
                              killed=jnp.array(st.killed))
@@ -670,7 +704,49 @@ def run_points_batched(base_cfg: SimConfig, cfgs: Sequence[SimConfig],
                 compiled = aot_compile(
                     runner, args, label=f"sweep.bucket.{key[0]}",
                     donate_argnums=(0,)).compiled
-            bucket_compile_s = time.perf_counter() - t0
+        bucket_compile_s = time.perf_counter() - t0
+        return {"bi": bi, "key": key, "b": b, "rec": None,
+                "t_prep0": t_prep0, "prepare_s": prepare_s,
+                "compile_s": bucket_compile_s, "compiled": compiled,
+                "args": args, "compiles": bcc.count}
+
+    def execute_bucket(plan):
+        """Bucket k's ORDERED leg, always on the main thread: device
+        execute, fetch, journal record, heartbeat beat, verbose line —
+        in strict bucket order under either dispatch mode."""
+        nonlocal compile_s, run_s, total_compiles, points_done
+        bi, key, b = plan["bi"], plan["key"], plan["b"]
+        rec = plan["rec"]
+        bucket_sizes.append(len(b["idx"]))
+        bucket_kinds.append(key[0])
+        bucket_indices.append(list(b["idx"]))
+        if rec is not None:
+            # journal restore: the bucket's points reassemble from disk
+            # through the IDENTICAL point_from_raw path; no tensor is
+            # built, no executable compiled, nothing dispatched
+            share = (float(rec.get("run_s") or 0.0)
+                     + float(rec.get("fetch_s") or 0.0)) / len(b["idx"])
+            for j, i in enumerate(b["idx"]):
+                raw[i] = deserialize_point(b["cfgs"][j],
+                                           rec["points"][j])
+                secs[i] = share
+            # the lists carry the JOURNALED stage clocks so straggler
+            # attribution survives a resume; this run spent ~nothing
+            stage_prepare.append(float(rec.get("prepare_s") or 0.0))
+            stage_compile.append(float(rec.get("compile_s") or 0.0))
+            stage_run.append(float(rec.get("run_s") or 0.0))
+            stage_fetch.append(float(rec.get("fetch_s") or 0.0))
+            bucket_compiles.append(0)
+            bucket_reused.append(True)
+            journal.reused += 1
+            emit_bucket_spans(bi, key[0], b["idx"], b["cfgs"],
+                              {"restore": (plan["t_prep0"],
+                                           plan["restore_s"])},
+                              reused=True)
+        else:
+            compiled, args = plan["compiled"], plan["args"]
+            prepare_s = plan["prepare_s"]
+            bucket_compile_s = plan["compile_s"]
             t0 = time.perf_counter()
             *summ, _fin = compiled(*args)
             # completion barrier: ONE output fetched — device execution
@@ -682,50 +758,82 @@ def run_points_batched(base_cfg: SimConfig, cfgs: Sequence[SimConfig],
             t0 = time.perf_counter()
             out = [first] + [np.asarray(o) for o in summ[1:]]
             del _fin           # device-resident final states: not needed
-            del args           # donated input buffers are dead
+            plan["compiled"] = plan["args"] = None   # donated: dead refs
             for j, i in enumerate(b["idx"]):
                 raw[i] = ([o[j] for o in out] if key[0] == "dyn"
                           else [o for o in out])
             bucket_fetch_s = time.perf_counter() - t0
-        # seconds stays the amortized share of the bucket's post-compile
-        # execution wall (execute + fetch), as it always was
-        for i in b["idx"]:
-            secs[i] = (bucket_run_s + bucket_fetch_s) / len(b["idx"])
-        compile_s += bucket_compile_s
-        run_s += bucket_run_s + bucket_fetch_s
-        total_compiles += bcc.count
-        stage_prepare.append(prepare_s)
-        stage_compile.append(bucket_compile_s)
-        stage_run.append(bucket_run_s)
-        stage_fetch.append(bucket_fetch_s)
-        bucket_compiles.append(bcc.count)
-        bucket_reused.append(False)
-        emit_bucket_spans(
-            bi, key[0], b["idx"], b["cfgs"],
-            {"prepare": (t_prep0, prepare_s),
-             "compile": (t_prep0 + prepare_s, bucket_compile_s),
-             "execute": (t_prep0 + prepare_s + bucket_compile_s,
-                         bucket_run_s),
-             "fetch": (t_prep0 + prepare_s + bucket_compile_s
-                       + bucket_run_s, bucket_fetch_s)})
-        if journal is not None:
-            journal.record_bucket(
-                bi, key[0], b["idx"], b["fp"], bcc.count,
-                {"prepare_s": prepare_s, "compile_s": bucket_compile_s,
-                 "run_s": bucket_run_s, "fetch_s": bucket_fetch_s},
-                [serialize_point(c, raw[i])
-                 for c, i in zip(b["cfgs"], b["idx"])])
+            # seconds stays the amortized share of the bucket's
+            # post-compile execution wall (execute + fetch), as always
+            for i in b["idx"]:
+                secs[i] = (bucket_run_s + bucket_fetch_s) / len(b["idx"])
+            compile_s += bucket_compile_s
+            run_s += bucket_run_s + bucket_fetch_s
+            total_compiles += plan["compiles"]
+            stage_prepare.append(prepare_s)
+            stage_compile.append(bucket_compile_s)
+            stage_run.append(bucket_run_s)
+            stage_fetch.append(bucket_fetch_s)
+            bucket_compiles.append(plan["compiles"])
+            bucket_reused.append(False)
+            emit_bucket_spans(
+                bi, key[0], b["idx"], b["cfgs"],
+                {"prepare": (plan["t_prep0"], prepare_s),
+                 "compile": (plan["t_prep0"] + prepare_s,
+                             bucket_compile_s),
+                 "execute": (plan["t_prep0"] + prepare_s
+                             + bucket_compile_s, bucket_run_s),
+                 "fetch": (plan["t_prep0"] + prepare_s + bucket_compile_s
+                           + bucket_run_s, bucket_fetch_s)})
+            if journal is not None:
+                journal.record_bucket(
+                    bi, key[0], b["idx"], b["fp"], plan["compiles"],
+                    {"prepare_s": prepare_s,
+                     "compile_s": bucket_compile_s,
+                     "run_s": bucket_run_s, "fetch_s": bucket_fetch_s},
+                    [serialize_point(c, raw[i])
+                     for c, i in zip(b["cfgs"], b["idx"])],
+                    mesh_shape=mesh_shape, pipelined=pipeline)
         points_done += len(b["idx"])
         if heartbeat is not None:
             publish_sweep_heartbeat(base_cfg, points_done, len(cfgs),
-                                    publisher=heartbeat)
-    del buckets  # the donated input buffers are dead; drop the refs
+                                    publisher=heartbeat,
+                                    bucket_index=bi)
+        if verbose:
+            # ONE print call per bucket, from the ordered thread only —
+            # the compile-ahead worker never writes to stdout, so lines
+            # cannot tear or interleave under async dispatch
+            if rec is not None:
+                detail = "journal-restored"
+            else:
+                detail = (f"compile {stage_compile[-1]:.2f}s, "
+                          f"run {stage_run[-1] + stage_fetch[-1]:.2f}s")
+            print(f"  bucket {bi + 1}/{len(order)} [{key[0]}] "
+                  f"{len(b['idx'])} point(s): {detail}", flush=True)
+
+    # ---- dispatch the buckets: serial, or compile-ahead/execute-behind.
+    # span_s clocks the bucket loop ALONE (exactly the work the four
+    # stage clocks cover — no input build, no assembly), so
+    # serial_s - span_s is the overlap the scheduler actually achieved.
+    work = [(bi, key, buckets[key]) for bi, key in enumerate(order)]
+    t_span0 = time.perf_counter()
+    if pipeline:
+        from .sweep_async import pipeline_buckets
+        for plan in pipeline_buckets(work, build_bucket):
+            execute_bucket(plan)
+    else:
+        for bi, key, b in work:
+            execute_bucket(build_bucket(bi, key, b))
+    span_s = time.perf_counter() - t_span0
+    del work, buckets  # the donated input buffers are dead; drop refs
 
     points = _assemble_points(cfgs, raw, secs)
-    headroom = sweep_gate.overlap_headroom_s(
-        [{"prepare_s": p, "compile_s": c, "run_s": r, "fetch_s": f}
-         for p, c, r, f in zip(stage_prepare, stage_compile, stage_run,
-                               stage_fetch)])
+    stage_dicts = [
+        {"prepare_s": p, "compile_s": c, "run_s": r, "fetch_s": f}
+        for p, c, r, f in zip(stage_prepare, stage_compile, stage_run,
+                              stage_fetch)]
+    headroom = sweep_gate.overlap_headroom_s(stage_dicts)
+    reclaimed = sweep_gate.headroom_reclaimed_s(stage_dicts, span_s)
     cb = BatchedCurve(points=points, n_buckets=len(order),
                       bucket_sizes=bucket_sizes,
                       compile_count=total_compiles,
@@ -739,7 +847,10 @@ def run_points_batched(base_cfg: SimConfig, cfgs: Sequence[SimConfig],
                       bucket_compile_counts=bucket_compiles,
                       bucket_reused=bucket_reused,
                       wall_s=time.perf_counter() - t_wall0,
-                      overlap_headroom_s=headroom)
+                      overlap_headroom_s=headroom,
+                      pipelined=bool(pipeline), span_s=span_s,
+                      headroom_reclaimed_s=reclaimed,
+                      mesh_shape=mesh_shape)
     if journal is not None:
         journal.record_done(len(cfgs), len(order), headroom)
     if verbose:
@@ -749,11 +860,15 @@ def run_points_batched(base_cfg: SimConfig, cfgs: Sequence[SimConfig],
         share = max(totals) / sum(totals) if sum(totals) > 0 else 0.0
         reused_note = (f", {sum(bucket_reused)} journal-restored"
                        if any(bucket_reused) else "")
+        pipe_note = (f", pipelined: reclaimed "
+                     f"{cb.headroom_reclaimed_s:.2f}s"
+                     if pipeline else "")
         print(f"  batched curve: {len(cfgs)} points / {cb.n_buckets} "
               f"bucket(s), {cb.compile_count} compiles "
               f"({cb.compile_s:.1f}s), run {cb.run_s:.2f}s; max bucket "
               f"share {100 * share:.0f}%, overlap headroom "
-              f"{cb.overlap_headroom_s:.2f}s{reused_note}", flush=True)
+              f"{cb.overlap_headroom_s:.2f}s{pipe_note}{reused_note}",
+              flush=True)
     return cb
 
 

@@ -1,0 +1,95 @@
+"""Compile-ahead/execute-behind bucket scheduler for the batched sweep.
+
+Sweepscope's pipeline model (``sweepscope/gate.py``) prices exactly one
+overlap: while the device executes bucket k, the host could already be
+preparing and AOT-compiling bucket k+1 — XLA compilation is pure host
+work and releases the GIL, so a plain worker THREAD captures the whole
+modeled headroom with no serialization risk on the device side.  This
+module is that scheduler, deliberately minimal:
+
+  * ONE worker thread runs the build leg (prepare + fingerprint +
+    journal match + stacked tensors + AOT compile) strictly in bucket
+    order.  Per-bucket ``count_backend_compiles`` scopes open only on
+    the worker, and the executing thread never holds one — the counter
+    listener is process-global and fans events to every active scope,
+    so a main-thread scope during execute would steal the worker's
+    compile attributions.
+  * The handoff queue holds AT MOST ONE built bucket
+    (``Queue(maxsize=1)``), bounding live memory at two buckets' input
+    tensors (one executing + one staged) — the same footprint argument
+    the donation scheme makes per bucket.
+  * The consumer drains plans strictly in build order (single worker +
+    FIFO queue), so everything ordered — device execute, fetch, journal
+    records, heartbeat beats, verbose lines — happens on the caller's
+    thread in bucket order.  Results, per-bucket compile counts and
+    journal contents are bit-identical to serial dispatch
+    (tests/test_gridpipe.py pins both); only the wall clock changes.
+
+A worker exception is re-raised on the consuming thread at the bucket
+it belongs to, so error behavior matches the serial loop.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterable, Iterator, Sequence, Tuple
+
+__all__ = ["pipeline_buckets"]
+
+#: Queue depth of the compile-ahead handoff: 1 staged bucket.
+PIPELINE_DEPTH = 1
+
+
+def pipeline_buckets(work: Sequence[Tuple], build: Callable,
+                     depth: int = PIPELINE_DEPTH) -> Iterator:
+    """Yield ``build(*item)`` for each work item, building one ahead.
+
+    ``build`` runs on a single daemon worker thread, strictly in work
+    order; plans are yielded in the same order on the caller's thread.
+    The caller executes plan k while the worker builds plan k+1 —
+    the compile-ahead/execute-behind overlap.  A ``build`` exception
+    surfaces here, in order, as if the loop were serial.
+    """
+    q: "queue.Queue" = queue.Queue(maxsize=max(1, int(depth)))
+    stop = threading.Event()
+    _done = object()
+
+    def _worker():
+        try:
+            for item in work:
+                if stop.is_set():
+                    return
+                q.put(("plan", build(*item)))
+        # benorlint: allow-broad-except — cross-thread relay boundary:
+        # whatever the build raised (including lowering/backend
+        # failures) is re-raised VERBATIM on the consumer thread, in
+        # bucket order — nothing is swallowed or demoted
+        except BaseException as e:
+            q.put(("raise", e))
+            return
+        q.put(("done", _done))
+
+    t = threading.Thread(target=_worker, name="sweep-compile-ahead",
+                         daemon=True)
+    t.start()
+    try:
+        while True:
+            tag, payload = q.get()
+            if tag == "done":
+                break
+            if tag == "raise":
+                raise payload
+            yield payload
+    finally:
+        # normal exit or consumer abandoned mid-stream (its execute
+        # raised): tell the worker to stop building, free a possibly
+        # blocked put, and let the daemon thread wind down — a build
+        # already in flight finishes (compilation is uninterruptible)
+        # but no further bucket starts
+        stop.set()
+        try:
+            q.get_nowait()
+        except queue.Empty:
+            pass
+        t.join(timeout=60.0)

@@ -71,11 +71,30 @@ HEADROOM_FRAC_SLACK = 0.15
 #: input-build overhead outside any stage).
 TELESCOPE_MIN = 0.7
 
-#: Stage-clock sums may exceed the wall only by timer noise.
+#: Stage-clock sums may exceed the wall only by timer noise (serial
+#: dispatch; a pipelined sweep legitimately exceeds it — see
+#: :func:`telescope_max`).
 TELESCOPE_MAX = 1.05
 
-#: Schema version this comparator understands.
-SCHEMA_VERSION = 1
+#: The reclaimed-headroom checks arm only when the serial model shows at
+#: least this much absolute headroom: below it (CPU smoke captures sit
+#: in the tens of milliseconds) "reclaimed ~ 0" is timer noise, not a
+#: dead pipeline.
+RECLAIM_MODEL_FLOOR_S = 0.5
+
+#: A pipelined run must reclaim at least this fraction of the modeled
+#: headroom once the floor arms — reclaimed ~ 0 where the serial model
+#: shows substantive overlap means the async dispatch serialized.
+RECLAIM_MIN_FRAC = 0.25
+
+#: Ratio band on headroom_reclaimed_frac vs the baseline's before the
+#: drop counts as a pipeline collapse.
+RECLAIM_BAND = 3.0
+
+#: Schema version this comparator understands.  v2 (PR 16): manifests
+#: carry a ``pipeline`` block (pipelined flag, bucket-loop span,
+#: modeled vs reclaimed headroom).
+SCHEMA_VERSION = 2
 
 #: The four bucket lifecycle stages, in execution order.  ``prepare``
 #: and ``compile`` are host work, ``run`` is device work, ``fetch`` is
@@ -132,6 +151,36 @@ def overlap_headroom_s(buckets: List[dict]) -> float:
     """The wall-clock an ideal pipeline would reclaim from the
     measured serial schedule (>= 0)."""
     return max(0.0, serial_s(buckets) - ideal_pipeline_s(buckets))
+
+
+def headroom_reclaimed_s(buckets: List[dict], span_s: float) -> float:
+    """Headroom actually reclaimed by a measured bucket-loop span.
+
+    ``span_s`` is the wall clock of the bucket loop ALONE (no input
+    build, no bucketing, no assembly — the engine measures it around
+    exactly the work the four stage clocks cover), so
+    ``serial_s - span_s`` is the overlap the real scheduler achieved
+    against the strictly-serial stage schedule.  Clamped at 0: a serial
+    run's span equals the stage sum up to timer noise."""
+    return max(0.0, serial_s(buckets) - float(span_s))
+
+
+def telescope_max(manifest: Dict) -> float:
+    """Upper telescoping band for this manifest.
+
+    Serial dispatch: stage sums may exceed the wall only by timer noise
+    (``TELESCOPE_MAX``).  Pipelined dispatch overlaps host compile with
+    device execute, so the stage SUM legitimately exceeds the shrunken
+    wall — but never beyond the fully-overlapped bound
+    ``serial_s / ideal_pipeline_s`` (plus the same noise factor)."""
+    pipe = manifest.get("pipeline") or {}
+    if not pipe.get("pipelined"):
+        return TELESCOPE_MAX
+    buckets = manifest.get("buckets") or []
+    ideal = ideal_pipeline_s(buckets)
+    if ideal <= 0.0:
+        return TELESCOPE_MAX
+    return (serial_s(buckets) / ideal) * TELESCOPE_MAX
 
 
 def _require(manifest: Dict, name: str) -> Dict:
@@ -196,6 +245,48 @@ def compare_sweep(manifest: Dict, baseline: Dict,
             f"{new_cc} backend compiles vs baseline {base_cc} at the "
             f"same scale — the bucketing regressed toward "
             f"compile-per-point"))
+    pipe = manifest.get("pipeline")
+    base_pipe = baseline.get("pipeline") or {}
+    if not isinstance(pipe, dict):
+        findings.append(SweepFinding(
+            "pipeline",
+            f"pipeline block missing/malformed ({pipe!r}): a v2 "
+            f"manifest must report whether dispatch was pipelined and "
+            f"what it reclaimed"))
+    else:
+        model = pipe.get("headroom_model_s")
+        reclaimed_frac = pipe.get("headroom_reclaimed_frac")
+        model_num = isinstance(model, (int, float)) and \
+            not isinstance(model, bool)
+        frac_num = isinstance(reclaimed_frac, (int, float)) and \
+            not isinstance(reclaimed_frac, bool)
+        if pipe.get("pipelined") and model_num and \
+                model >= RECLAIM_MODEL_FLOOR_S:
+            if not frac_num:
+                findings.append(SweepFinding(
+                    "pipeline.headroom_reclaimed_frac",
+                    f"pipelined manifest reports no reclaimed-headroom "
+                    f"fraction ({reclaimed_frac!r}) against a "
+                    f"{model:.2f}s serial model — the pipeline's whole "
+                    f"before/after number vanished"))
+            elif reclaimed_frac < RECLAIM_MIN_FRAC:
+                findings.append(SweepFinding(
+                    "pipeline.headroom_reclaimed_frac",
+                    f"pipelined dispatch reclaimed {reclaimed_frac:.3f} "
+                    f"of a {model:.2f}s modeled headroom "
+                    f"(< {RECLAIM_MIN_FRAC}): the compile-ahead thread "
+                    f"is serializing against execute"))
+            elif base_pipe.get("pipelined"):
+                base_frac = base_pipe.get("headroom_reclaimed_frac")
+                if (isinstance(base_frac, (int, float))
+                        and not isinstance(base_frac, bool)
+                        and base_frac > 0
+                        and reclaimed_frac < base_frac / RECLAIM_BAND):
+                    findings.append(SweepFinding(
+                        "pipeline.headroom_reclaimed_frac",
+                        f"reclaimed-headroom fraction collapsed: "
+                        f"{reclaimed_frac:.3f} < baseline "
+                        f"{base_frac:.3f} / {RECLAIM_BAND}"))
     tel = manifest.get("telescoping") or {}
     cov = tel.get("coverage")
     if not isinstance(cov, (int, float)) or isinstance(cov, bool) or \

@@ -57,7 +57,9 @@ DONE_KIND = "sweep_done"
 
 #: Bumped with any record-shape change; part of the fingerprint, so a
 #: journal written by an older engine reruns rather than misparses.
-JOURNAL_VERSION = 1
+#: v2: records carry the mesh shape + pipelined flag under an integrity
+#: stamp (PR 16) — every v1 journal is stale by construction and reruns.
+JOURNAL_VERSION = 2
 
 
 def _hash_array(h, arr) -> None:
@@ -141,6 +143,30 @@ def payload_digest(points: List[dict]) -> str:
         json.dumps(points, sort_keys=True).encode()).hexdigest()
 
 
+def record_stamp(fingerprint: str, point_indices: List[int],
+                 mesh_shape, pipelined: bool,
+                 payload_sha256: str) -> str:
+    """Integrity stamp binding a record's identity fields together.
+
+    The sweep summaries are integer-exact reductions, so a journal
+    written on one mesh legitimately stands in on ANOTHER mesh shape —
+    the mesh/pipeline fields are provenance, not part of the lookup key.
+    But provenance must not drift silently: the stamp covers
+    fingerprint + point indices + mesh_shape + pipelined + the payload
+    digest, and ``match`` recomputes it before reuse.  Editing a
+    record's mesh field in place (a "stale mesh" forgery) breaks the
+    stamp and the bucket RERUNS."""
+    blob = json.dumps({
+        "fingerprint": fingerprint,
+        "point_indices": [int(i) for i in point_indices],
+        "mesh_shape": (None if mesh_shape is None
+                       else [int(s) for s in mesh_shape]),
+        "pipelined": bool(pipelined),
+        "payload_sha256": payload_sha256,
+    }, sort_keys=True)
+    return "sha256:" + hashlib.sha256(blob.encode()).hexdigest()
+
+
 def read_journal(path: str) -> List[dict]:
     """Parse a journal file -> bucket/done records, in file order.
     A torn (killed-mid-append) or hand-mangled line is SKIPPED, not an
@@ -206,7 +232,11 @@ class SweepJournal:
         pts = rec.get("points")
         if (not isinstance(pts, list)
                 or len(pts) != len(point_indices)
-                or rec.get("payload_sha256") != payload_digest(pts)):
+                or rec.get("payload_sha256") != payload_digest(pts)
+                or rec.get("stamp_sha256") != record_stamp(
+                    fingerprint, list(point_indices),
+                    rec.get("mesh_shape"), rec.get("pipelined", False),
+                    rec.get("payload_sha256"))):
             metrics.REGISTRY.counter("sweepscope.journal.tampered").inc()
             return None
         return rec
@@ -214,16 +244,25 @@ class SweepJournal:
     def record_bucket(self, index: int, kind: str,
                       point_indices: List[int], fingerprint: str,
                       compile_count: int, stages: Dict[str, float],
-                      points: List[dict]) -> dict:
+                      points: List[dict], mesh_shape=None,
+                      pipelined: bool = False) -> dict:
+        digest = payload_digest(points)
+        idx = [int(i) for i in point_indices]
+        shape = (None if mesh_shape is None
+                 else [int(s) for s in mesh_shape])
         rec = {
             "kind": BUCKET_KIND, "label": self.label,
             "journal_version": JOURNAL_VERSION,
             "bucket_index": int(index), "bucket_kind": kind,
-            "point_indices": [int(i) for i in point_indices],
+            "point_indices": idx,
             "fingerprint": fingerprint,
+            "mesh_shape": shape,
+            "pipelined": bool(pipelined),
             "compile_count": int(compile_count),
             **{k: round(float(v), 6) for k, v in stages.items()},
-            "payload_sha256": payload_digest(points),
+            "payload_sha256": digest,
+            "stamp_sha256": record_stamp(fingerprint, idx, shape,
+                                         pipelined, digest),
             "points": points,
         }
         metrics.append_jsonl(self.path, rec)

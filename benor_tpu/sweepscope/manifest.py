@@ -91,6 +91,16 @@ def build_sweep_manifest(cb, base_cfg, platform: Optional[str] = None,
     headroom = round(max(0.0, serial - ideal), 6)
     wall = round(float(cb.wall_s), 6)
     coverage = round(serial / wall, 6) if wall > 0 else 0.0
+    span = round(float(cb.span_s), 6)
+    reclaimed = round(gate.headroom_reclaimed_s(buckets, span), 6)
+    pipeline = {
+        "pipelined": bool(cb.pipelined),
+        "span_s": span,
+        "headroom_model_s": headroom,
+        "headroom_reclaimed_s": reclaimed,
+        "headroom_reclaimed_frac": (round(reclaimed / headroom, 6)
+                                    if headroom > 0 else 0.0),
+    }
     return {
         "kind": SWEEP_MANIFEST_KIND,
         "schema_version": SCHEMA_VERSION,
@@ -114,6 +124,7 @@ def build_sweep_manifest(cb, base_cfg, platform: Optional[str] = None,
         "overlap_headroom_s": headroom,
         "overlap_headroom_frac": (round(headroom / serial, 6)
                                   if serial > 0 else 0.0),
+        "pipeline": pipeline,
         "telescoping": {
             "stage_sum_s": serial,
             "wall_s": wall,
@@ -144,13 +155,18 @@ def capture_base_config(f_values: Optional[Sequence[int]] = None,
 
 def capture_sweep_manifest(journal_path: Optional[str] = None,
                            f_values: Optional[Sequence[int]] = None,
+                           pipeline: bool = False, mesh=None,
                            **scale):
     """Run the standard two-bucket capture curve and build its manifest
-    -> (manifest, BatchedCurve)."""
+    -> (manifest, BatchedCurve).  ``pipeline=True`` captures the
+    compile-ahead/execute-behind scheduler (the committed baseline's
+    mode since PR 16, so its ``headroom_reclaimed`` prices real
+    overlap); ``mesh`` places the dyn buckets on a 2D grid mesh."""
     from ..sweep import run_curve_batched
 
     base, fs = capture_base_config(f_values=f_values, **scale)
-    cb = run_curve_batched(base, fs, journal_path=journal_path)
+    cb = run_curve_batched(base, fs, journal_path=journal_path,
+                           pipeline=pipeline, mesh=mesh)
     return build_sweep_manifest(cb, base), cb
 
 

@@ -19,7 +19,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -34,7 +34,8 @@ _FORMAT_VERSION = 2
 
 def save_checkpoint(path: str, cfg: SimConfig, state: NetState,
                     faults: FaultSpec, next_round: int,
-                    base_key: "jax.Array | None" = None) -> None:
+                    base_key: "jax.Array | None" = None,
+                    mesh_shape: Optional[Tuple[int, int]] = None) -> None:
     """Snapshot a (possibly mid-run) simulation to ``path`` (.npz).
 
     ``next_round`` is the 1-based round index the loop would execute next —
@@ -42,6 +43,10 @@ def save_checkpoint(path: str, cfg: SimConfig, state: NetState,
     ``base_key`` is the PRNG key the run was started with; it is persisted
     (as raw key data) so resume continues the same random streams.  Omit it
     only if the run used the default ``jax.random.key(cfg.seed)``.
+    ``mesh_shape`` optionally records the (trial_shards, node_shards)
+    grid the run was placed on — provenance only, never a constraint:
+    checkpoints stay mesh-agnostic and ``resume_from(mesh="auto")``
+    merely PREFERS the recorded shape when the devices exist.
     """
     if base_key is None:
         base_key = jax.random.key(cfg.seed)
@@ -62,6 +67,11 @@ def save_checkpoint(path: str, cfg: SimConfig, state: NetState,
         # crash_recover down-intervals (PR 15): an OPTIONAL key, so
         # archives from static-fault runs keep their exact byte layout
         payload["recover_round"] = np.asarray(faults.recover_round)
+    if mesh_shape is not None:
+        # 2D grid provenance (PR 16): same OPTIONAL-key discipline —
+        # single-device archives keep their exact byte layout
+        payload["mesh_shape"] = np.asarray(
+            [int(s) for s in mesh_shape], dtype=np.int32)
     tmp = path + ".tmp"
     with open(tmp, "wb") as fh:
         np.savez(fh, **payload)
@@ -94,6 +104,17 @@ def load_checkpoint(path: str):
     return cfg, state, faults, next_round, base_key
 
 
+def saved_mesh_shape(path: str) -> Optional[Tuple[int, int]]:
+    """The (trial_shards, node_shards) recorded in ``path``, or None
+    for archives written without grid provenance (pre-PR-16, or
+    single-device runs)."""
+    with np.load(path, allow_pickle=False) as z:
+        if "mesh_shape" not in z.files:
+            return None
+        t, n = (int(v) for v in z["mesh_shape"])
+    return t, n
+
+
 def resume_from(path: str, mesh=None):
     """Load ``path`` and run the loop to termination.
 
@@ -102,8 +123,21 @@ def resume_from(path: str, mesh=None):
     ``run_consensus``.  Pass a ``jax.sharding.Mesh`` to resume on a device
     mesh: checkpoints are mesh-agnostic (randomness keys on global ids), so
     a single-device checkpoint resumes bit-identically on any mesh shape
-    and vice versa.
+    and vice versa.  Pass ``mesh="auto"`` to re-derive the placement from
+    the archive's recorded grid shape (parallel/grid.py): the recorded
+    (trial_shards, node_shards) when those devices exist here, else a
+    single-device resume — bit-identical either way.
     """
+    if mesh == "auto":
+        import jax
+
+        from ..parallel.grid import make_grid_mesh
+        shape = saved_mesh_shape(path)
+        mesh = None
+        if shape is not None and shape != (1, 1) \
+                and shape[0] * shape[1] <= len(jax.devices()):
+            mesh = make_grid_mesh(trial_shards=shape[0],
+                                  node_shards=shape[1])
     cfg, state, faults, next_round, base_key = load_checkpoint(path)
     if mesh is not None:
         from ..parallel import resume_consensus_sharded

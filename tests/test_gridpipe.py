@@ -1,0 +1,538 @@
+"""gridpipe (PR 16) — 2D (trials x nodes) grid placement + the
+compile-ahead/execute-behind sweep pipeline.
+
+Pins the PR 16 house rules:
+
+  * ``run_consensus_grid`` is bit-identical at EVERY mesh shape —
+    (1, 1) falls through to the traced loop, (1, d) is exactly
+    ``run_consensus_sharded``, and (t, n) with t > 1 multiplies the
+    node-axis psum tallies with trials-axis data parallelism (verified
+    against a NumPy oracle and the flagship ladder regime);
+  * recorder / witness / heartbeat planes survive 2D placement
+    unchanged (the partition-rule table replicates the round-major
+    observation buffers);
+  * ``run_points_batched(pipeline=True)`` is bit-identical to serial
+    dispatch in the science fields AND the per-bucket backend compile
+    counts, reports ``headroom_reclaimed_s`` against the serial
+    overlap model, and keeps heartbeat/verbose output ordered by
+    bucket completion (bucket_index attached, no torn lines);
+  * a pipelined journaled sweep SIGKILLed mid-flight resumes
+    bit-identically on a DIFFERENT mesh shape with exactly
+    n_remaining_buckets compiles (fingerprints exclude the mesh —
+    results are mesh-independent — while the v2 record stamp pins
+    mesh/pipeline provenance so in-place edits rerun);
+  * the sweep gate's reclaimed-headroom checks fire when a pipelined
+    manifest reports reclaimed ~ 0 against a substantive serial model,
+    and stay silent below the CPU-smoke noise floor.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from benor_tpu.config import SimConfig
+from benor_tpu.ops import sampling
+from benor_tpu.parallel import (auto_factor, make_grid_mesh, make_mesh,
+                                partition_rules, run_consensus_grid,
+                                run_consensus_sharded)
+from benor_tpu.parallel.mesh import AXIS_NODES, AXIS_TRIALS
+from benor_tpu.sim import run_consensus
+from benor_tpu.state import FaultSpec, init_state
+from benor_tpu.sweep import run_curve_batched, run_points_batched
+from benor_tpu.sweepscope import read_journal
+from benor_tpu.sweepscope.gate import (RECLAIM_MODEL_FLOOR_S,
+                                       compare_sweep)
+from benor_tpu.sweepscope.journal import BUCKET_KIND
+
+try:
+    from jax import shard_map as shard_map
+except ImportError:                                    # 0.4.x
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N, F, T = 16, 4, 8
+FAULTY = [True] * F + [False] * (N - F)
+VALS = [i % 2 for i in range(N)]
+
+#: Mixed-bucket sweep geometry (mirrors test_sweepscope): two CF-regime
+#: points share a dyn bucket, one exact-table point gets a static
+#: bucket — the smallest sweep exercising BOTH bucket kinds under the
+#: pipeline and the grid.
+CF_N = 9000
+MIXED_FS = [600, 1200, CF_N - sampling.EXACT_TABLE_MAX + 500]
+
+
+def _cfg(**kw):
+    base = dict(n_nodes=N, n_faulty=F, trials=T, delivery="quorum",
+                scheduler="uniform", path="histogram", max_rounds=8,
+                seed=7)
+    base.update(kw)
+    return SimConfig(**base)
+
+
+def _sweep_cfg(**kw):
+    base = dict(n_nodes=CF_N, n_faulty=0, trials=4, delivery="quorum",
+                scheduler="uniform", path="histogram", max_rounds=8,
+                seed=3)
+    base.update(kw)
+    return SimConfig(**base)
+
+
+def _inputs(cfg):
+    faults = FaultSpec.from_faulty_list(cfg, FAULTY)
+    state = init_state(cfg, VALS, faults)
+    return state, faults, jax.random.key(cfg.seed)
+
+
+def _assert_state_equal(s1, s2):
+    for f in ("x", "decided", "k", "killed"):
+        np.testing.assert_array_equal(np.asarray(getattr(s1, f)),
+                                      np.asarray(getattr(s2, f)))
+
+
+def science(p):
+    return (p.rounds_executed, p.decided_frac, p.mean_k, p.ones_frac,
+            p.disagree_frac, tuple(p.k_hist.tolist()))
+
+
+def assert_bit_equal(pa, pb):
+    assert len(pa) == len(pb)
+    for a, b in zip(pa, pb):
+        assert science(a) == science(b), (a.n_faulty, b.n_faulty)
+
+
+# --------------------------------------------------------------------------
+# 2D mesh: bit-identity at every shape, vs the traced AND sharded oracles
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("shape", [(1, 1), (1, 4), (2, 2), (2, 4)])
+def test_grid_bit_identical_to_traced_loop(shape):
+    cfg = _cfg()
+    state, faults, key = _inputs(cfg)
+    r1, s1 = run_consensus(cfg, state, faults, key)
+    mesh = make_grid_mesh(trial_shards=shape[0], node_shards=shape[1])
+    r2, s2 = run_consensus_grid(cfg, state, faults, key, mesh=mesh)
+    assert int(r1) == int(r2)
+    _assert_state_equal(s1, s2)
+
+
+def test_grid_1xd_is_exactly_the_sharded_runner():
+    """(1, d) must reproduce run_consensus_sharded verbatim — the grid
+    entry point adds placement, never a second code path."""
+    cfg = _cfg()
+    state, faults, key = _inputs(cfg)
+    mesh = make_mesh(1, 4)
+    r_sh, s_sh = run_consensus_sharded(cfg, state, faults, key, mesh)
+    r_gr, s_gr = run_consensus_grid(
+        cfg, state, faults, key,
+        mesh=make_grid_mesh(trial_shards=1, node_shards=4))
+    assert int(r_sh) == int(r_gr)
+    _assert_state_equal(s_sh, s_gr)
+
+
+def test_grid_auto_mesh_uses_available_devices():
+    cfg = _cfg()
+    state, faults, key = _inputs(cfg)
+    r1, s1 = run_consensus(cfg, state, faults, key)
+    mesh = make_grid_mesh(cfg)
+    assert mesh.size > 1               # conftest forces 8 CPU devices
+    r2, s2 = run_consensus_grid(cfg, state, faults, key)
+    assert int(r1) == int(r2)
+    _assert_state_equal(s1, s2)
+
+
+def test_auto_factor_properties():
+    # prefers (devices used, node shards): 8 devices, N divisible by 8
+    assert auto_factor(8, 8, 16) == (1, 8)
+    # N=6: node axis tops out at 6... but (4, 2) uses all 8 devices
+    assert auto_factor(8, 4, 6) == (4, 2)
+    # odd extents: best full-device factoring wins, else largest usable
+    assert auto_factor(8, 3, 5) == (1, 5)
+    assert auto_factor(1, 64, 4096) == (1, 1)
+    for d, t, n in [(8, 4, 6), (8, 8, 16), (6, 2, 9), (8, 3, 5)]:
+        ts, ns = auto_factor(d, t, n)
+        assert ts * ns <= d and t % ts == 0 and n % ns == 0
+
+
+def test_partition_rules_observation_entries_follow_cfg():
+    plain = partition_rules(_cfg())
+    assert "recorder" not in plain and "witness" not in plain
+    for leaf in ("x", "decided", "k", "killed", "faulty", "crash_round",
+                 "recover_round"):
+        assert plain[leaf] == P(AXIS_TRIALS, AXIS_NODES)
+    assert plain["base_key"] == P()
+    rec = partition_rules(_cfg(record=True, witness_trials=(0, 1),
+                               witness_nodes=2))
+    assert rec["recorder"] == P() and rec["witness"] == P()
+
+
+def test_grid_recorder_witness_parity():
+    """The observation planes must survive 2D placement bit-identically
+    (the round-major buffers are psum-reduced in-kernel, replicated on
+    exit)."""
+    cfg = _cfg(record=True, witness_trials=(0, 1), witness_nodes=2)
+    state, faults, key = _inputs(cfg)
+    out1 = run_consensus(cfg, state, faults, key)
+    out2 = run_consensus_grid(
+        cfg, state, faults, key,
+        mesh=make_grid_mesh(trial_shards=2, node_shards=2))
+    assert len(out1) == len(out2) == 4
+    assert int(out1[0]) == int(out2[0])
+    _assert_state_equal(out1[1], out2[1])
+    for a, b in zip(jax.tree_util.tree_leaves(out1[2:]),
+                    jax.tree_util.tree_leaves(out2[2:])):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_grid_psum_tallies_match_numpy_oracle():
+    """The 2D contract in one shard_map: trials-axis data parallelism
+    multiplying node-axis psum tallies, checked against np.sum /
+    np.bincount on the unsharded operand."""
+    mesh = make_mesh(2, 2)
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 2, size=(4, 64)).astype(np.int32)
+
+    def tally(xs):
+        ones = jnp.sum(xs, axis=1, keepdims=True)
+        return jax.lax.psum(ones, AXIS_NODES)
+
+    out = shard_map(tally, mesh=mesh,
+                    in_specs=P(AXIS_TRIALS, AXIS_NODES),
+                    out_specs=P(AXIS_TRIALS, None))(x)
+    np.testing.assert_array_equal(np.asarray(out)[:, 0],
+                                  x.sum(axis=1))
+
+    def hist(xs):
+        oh = (xs[..., None] == jnp.arange(2)[None, None, :])
+        return jax.lax.psum(jnp.sum(oh, axis=1), AXIS_NODES)
+
+    h = shard_map(hist, mesh=mesh,
+                  in_specs=P(AXIS_TRIALS, AXIS_NODES),
+                  out_specs=P(AXIS_TRIALS, None))(x)
+    want = np.stack([np.bincount(row, minlength=2) for row in x])
+    np.testing.assert_array_equal(np.asarray(h), want)
+
+
+def test_grid_flagship_regime_2d():
+    """The scaling ladder's flagship regime (forced-tie adversarial,
+    histogram psums) on a t>1 grid == the traced loop — the small-scale
+    twin of the committed MULTICHIP_r06 capture."""
+    from benor_tpu.meshscope.scaling import _ladder_cfg
+    from benor_tpu.sweep import balanced_inputs
+    cfg = _ladder_cfg(64, 4, 4, 0)
+    faults = FaultSpec.none(cfg.trials, cfg.n_nodes)
+    state = init_state(cfg, balanced_inputs(cfg.trials, cfg.n_nodes),
+                       faults)
+    key = jax.random.key(cfg.seed)
+    r1, s1 = run_consensus(cfg, state, faults, key)
+    r2, s2 = run_consensus_grid(
+        cfg, state, faults, key,
+        mesh=make_grid_mesh(trial_shards=2, node_shards=2))
+    assert int(r1) == int(r2) == cfg.max_rounds   # forced tie: runs capped
+    _assert_state_equal(s1, s2)
+
+
+# --------------------------------------------------------------------------
+# pipelined dispatch: bit-identity, compile parity, ordered heartbeat
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def pipe_runs(tmp_path_factory):
+    """One mixed dyn+static curve run three ways — serial (the oracle),
+    pipelined+journaled, and pipelined on a (2, 2) grid — paying the
+    CF-regime compiles once for the whole module."""
+    td = tmp_path_factory.mktemp("gridpipe")
+    jp = str(td / "journal.jsonl")
+    hb = str(td / "heartbeat.jsonl")
+    cfg = _sweep_cfg(heartbeat_rounds=4)
+    oracle = run_curve_batched(cfg, MIXED_FS)
+    piped = run_curve_batched(cfg, MIXED_FS, pipeline=True,
+                              journal_path=jp, heartbeat_path=hb)
+    meshed = run_curve_batched(
+        cfg, MIXED_FS, pipeline=True,
+        mesh=make_grid_mesh(trial_shards=2, node_shards=2))
+    return cfg, jp, hb, oracle, piped, meshed
+
+
+def test_pipeline_bit_identical_and_compile_parity(pipe_runs):
+    _, _, _, oracle, piped, _ = pipe_runs
+    assert set(oracle.bucket_kinds) == {"dyn", "static"}
+    assert_bit_equal(oracle.points, piped.points)
+    assert piped.bucket_kinds == oracle.bucket_kinds
+    assert piped.bucket_point_indices == oracle.bucket_point_indices
+    # the pipeline moves WHERE compiles happen (the compile-ahead
+    # thread), never HOW MANY — per-bucket counts must match serial
+    assert piped.bucket_compile_counts == oracle.bucket_compile_counts
+    assert piped.compile_count == oracle.compile_count
+    assert piped.pipelined and not oracle.pipelined
+    assert piped.span_s > 0.0
+    assert piped.headroom_reclaimed_s >= 0.0
+
+
+def test_pipeline_on_2d_mesh_bit_identical(pipe_runs):
+    cfg, _, _, oracle, _, meshed = pipe_runs
+    assert_bit_equal(oracle.points, meshed.points)
+    assert meshed.mesh_shape == [2, 2]
+    assert meshed.bucket_compile_counts == oracle.bucket_compile_counts
+
+
+def test_pipeline_journal_carries_mesh_and_pipeline_provenance(pipe_runs):
+    _, jp, _, _, piped, _ = pipe_runs
+    recs = [r for r in read_journal(jp) if r.get("kind") == BUCKET_KIND]
+    assert len(recs) == piped.n_buckets
+    for rec in recs:
+        assert rec["pipelined"] is True
+        assert rec["mesh_shape"] is None          # no mesh on this run
+        assert rec["stamp_sha256"]
+
+
+def test_heartbeat_ordered_bucket_completion_no_torn_lines(pipe_runs):
+    """The watch-tail pin: under async dispatch every heartbeat line
+    parses whole (one writer — the ordered main thread), carries the
+    completing bucket's index, and arrives in completion order."""
+    _, _, hb, _, piped, _ = pipe_runs
+    with open(hb) as fh:
+        lines = fh.read().splitlines()
+    assert lines
+    recs = [json.loads(ln) for ln in lines]       # no torn lines
+    sweep_beats = [r for r in recs
+                   if r.get("label") == "sweep" and "bucket_index" in r]
+    assert len(sweep_beats) == piped.n_buckets
+    idx = [r["bucket_index"] for r in sweep_beats]
+    assert idx == sorted(idx) == list(range(piped.n_buckets))
+    done = [r["points_done"] for r in sweep_beats]
+    assert done == sorted(done)                   # monotone progress
+    assert done[-1] == len(MIXED_FS)
+    assert sweep_beats[-1]["done"] is True
+
+
+def test_pipeline_verbose_lines_whole_and_ordered(capsys):
+    """Verbose output under the compile-ahead thread: one whole line
+    per bucket, in bucket order (the worker never writes stdout)."""
+    cfg = SimConfig(n_nodes=64, n_faulty=0, trials=8,
+                    delivery="quorum", scheduler="uniform",
+                    path="histogram", max_rounds=8, seed=5)
+    cfgs = [cfg.replace(n_faulty=f) for f in (8, 12, 16)]
+    run_points_batched(cfg, cfgs, pipeline=True, verbose=True)
+    out = capsys.readouterr().out
+    marks = [ln for ln in out.splitlines() if ln.startswith("  bucket ")]
+    assert [ln.split("/")[0] for ln in marks] == \
+        [f"  bucket {i + 1}" for i in range(3)]
+
+
+# --------------------------------------------------------------------------
+# SIGKILL mid-pipeline: resume bit-equal on a DIFFERENT mesh shape
+# --------------------------------------------------------------------------
+
+
+_CHILD_SRC = """\
+import os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, sys.argv[2])
+from benor_tpu.config import SimConfig
+from benor_tpu.sweep import default_crash_faults, run_points_batched
+
+base = SimConfig(n_nodes=64, n_faulty=0, trials=8, delivery="quorum",
+                 scheduler="uniform", path="histogram", max_rounds=8,
+                 seed=5)
+cfgs = [base.replace(n_faulty=f) for f in (8, 12, 16)]
+
+
+def slow_faults(c):
+    # widen the kill window (masks identical to the default policy, so
+    # the fingerprints match the parent's cross-mesh resume)
+    time.sleep(1.0)
+    return default_crash_faults(c)
+
+
+run_points_batched(base, cfgs, faults_for=slow_faults,
+                   journal_path=sys.argv[1], pipeline=True)
+"""
+
+
+def test_sigkill_mid_pipeline_resumes_on_different_mesh(tmp_path):
+    """The elastic-sweep acceptance: SIGKILL a PIPELINED journaled
+    sweep mid-bucket, resume on a different mesh shape, pin
+    bit-equality vs the uninterrupted oracle AND exactly
+    n_remaining_buckets compiles — journal fingerprints exclude the
+    mesh because the results are mesh-independent."""
+    jp = str(tmp_path / "kill_journal.jsonl")
+    script = tmp_path / "child.py"
+    script.write_text(_CHILD_SRC)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen([sys.executable, str(script), jp, REPO],
+                            env=env, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                break
+            done = [r for r in read_journal(jp)
+                    if r.get("kind") == BUCKET_KIND]
+            if done:
+                break
+            time.sleep(0.05)
+        assert proc.poll() is None, \
+            "child exited before the kill — the sweep ran to completion"
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    recs = [r for r in read_journal(jp) if r.get("kind") == BUCKET_KIND]
+    n_done = len(recs)
+    assert 1 <= n_done < 3, n_done
+    assert all(r["pipelined"] for r in recs)
+
+    base = SimConfig(n_nodes=64, n_faulty=0, trials=8,
+                     delivery="quorum", scheduler="uniform",
+                     path="histogram", max_rounds=8, seed=5)
+    cfgs = [base.replace(n_faulty=f) for f in (8, 12, 16)]
+    oracle = run_points_batched(base, cfgs)
+    resumed = run_points_batched(
+        base, cfgs, journal_path=jp, resume=True, pipeline=True,
+        mesh=make_grid_mesh(trial_shards=1, node_shards=8))
+    assert resumed.compile_count == 3 - n_done
+    assert sum(resumed.bucket_reused) == n_done
+    assert resumed.mesh_shape == [1, 8]
+    assert_bit_equal(oracle.points, resumed.points)
+
+
+def test_journal_mesh_provenance_tamper_reruns(tmp_path):
+    """The v2 stamp matrix: editing a record's mesh_shape or pipelined
+    field IN PLACE breaks stamp_sha256 — the bucket reruns instead of
+    reusing a record whose provenance was rewritten."""
+    base = SimConfig(n_nodes=64, n_faulty=0, trials=8,
+                     delivery="quorum", scheduler="uniform",
+                     path="histogram", max_rounds=8, seed=5)
+    cfgs = [base.replace(n_faulty=f) for f in (8, 12, 16)]
+    jp = str(tmp_path / "journal.jsonl")
+    clean = run_points_batched(base, cfgs, journal_path=jp,
+                               pipeline=True)
+    for field, value in (("mesh_shape", [4, 2]), ("pipelined", False)):
+        tampered = tmp_path / f"tamper_{field}.jsonl"
+        lines = []
+        with open(jp) as fh:
+            for i, ln in enumerate(fh):
+                rec = json.loads(ln)
+                if i == 0 and rec.get("kind") == BUCKET_KIND:
+                    rec[field] = value
+                lines.append(json.dumps(rec))
+        tampered.write_text("\n".join(lines) + "\n")
+        cb = run_points_batched(base, cfgs, journal_path=str(tampered),
+                                resume=True)
+        assert cb.bucket_reused.count(True) == 2, field
+        assert cb.compile_count == 1, field
+        assert_bit_equal(clean.points, cb.points)
+
+
+# --------------------------------------------------------------------------
+# checkpoint: grid provenance + auto-mesh resume
+# --------------------------------------------------------------------------
+
+
+def test_checkpoint_mesh_shape_roundtrip_and_auto_resume(tmp_path):
+    from benor_tpu.utils.checkpoint import (resume_from,
+                                            save_checkpoint,
+                                            saved_mesh_shape)
+    cfg = _cfg(max_rounds=12)
+    state, faults, key = _inputs(cfg)
+    rounds_full, final_full = run_consensus(cfg, state, faults, key)
+    r_cap, mid = run_consensus(cfg.replace(max_rounds=2), state, faults,
+                               key)
+    plain = str(tmp_path / "plain.npz")
+    save_checkpoint(plain, cfg, mid, faults, next_round=int(r_cap) + 1)
+    assert saved_mesh_shape(plain) is None      # byte layout unchanged
+    gridded = str(tmp_path / "grid.npz")
+    save_checkpoint(gridded, cfg, mid, faults,
+                    next_round=int(r_cap) + 1, mesh_shape=(2, 4))
+    assert saved_mesh_shape(gridded) == (2, 4)
+    rounds_res, final_res, _ = resume_from(gridded, mesh="auto")
+    assert int(rounds_res) == int(rounds_full)
+    _assert_state_equal(final_full, final_res)
+
+
+# --------------------------------------------------------------------------
+# gate: reclaimed-headroom findings
+# --------------------------------------------------------------------------
+
+
+def _pipe_manifest(pipelined, model, reclaimed, base=None):
+    """A minimal comparable manifest pair for the pipeline checks."""
+    buckets = [
+        {"index": 0, "kind": "dyn", "size": 2, "point_indices": [0, 1],
+         "prepare_s": 0.1, "compile_s": model, "run_s": model,
+         "fetch_s": 0.05, "compile_count": 1},
+        {"index": 1, "kind": "static", "size": 1, "point_indices": [2],
+         "prepare_s": 0.1, "compile_s": model, "run_s": model,
+         "fetch_s": 0.05, "compile_count": 1},
+    ]
+    from benor_tpu.sweepscope.gate import (ideal_pipeline_s,
+                                           overlap_headroom_s, serial_s)
+    ser = serial_s(buckets)
+    span = ser - reclaimed
+    doc = {
+        "kind": "sweep_manifest", "schema_version": 2,
+        "platform": "cpu", "device_kind": "cpu",
+        "scale": {"n_nodes": 64, "trials": 8, "max_rounds": 8,
+                  "seed": 5, "n_points": 3, "f_values": [8, 12, 16]},
+        "n_buckets": 2, "compile_count": 2, "wall_s": ser,
+        "buckets": buckets,
+        "stage_totals": {"prepare_s": 0.2, "compile_s": 2 * model,
+                         "run_s": 2 * model, "fetch_s": 0.1},
+        "serial_s": ser,
+        "ideal_pipeline_s": ideal_pipeline_s(buckets),
+        "overlap_headroom_s": overlap_headroom_s(buckets),
+        "overlap_headroom_frac": overlap_headroom_s(buckets) / ser,
+        "pipeline": {
+            "pipelined": pipelined, "span_s": span,
+            "headroom_model_s": overlap_headroom_s(buckets),
+            "headroom_reclaimed_s": reclaimed,
+            "headroom_reclaimed_frac":
+                (reclaimed / overlap_headroom_s(buckets)
+                 if overlap_headroom_s(buckets) > 0 else 0.0)},
+        "telescoping": {"stage_sum_s": ser, "wall_s": ser,
+                        "coverage": 1.0},
+    }
+    return doc
+
+
+def test_gate_fires_when_pipeline_reclaims_nothing():
+    """reclaimed ~ 0 where the serial model shows substantive headroom
+    == the compile-ahead thread serialized; the gate must say so."""
+    base = _pipe_manifest(True, model=2.0, reclaimed=1.5)
+    dead = _pipe_manifest(True, model=2.0, reclaimed=0.0)
+    findings = compare_sweep(dead, base)
+    assert any(f.metric == "pipeline.headroom_reclaimed_frac"
+               for f in findings)
+    assert compare_sweep(base, base) == []
+
+
+def test_gate_reclaim_floor_disarms_cpu_smoke_noise():
+    """Below RECLAIM_MODEL_FLOOR_S the serial model is timer noise —
+    reclaimed ~ 0 must NOT gate (the committed CPU baseline relies on
+    this)."""
+    tiny = _pipe_manifest(True, model=RECLAIM_MODEL_FLOOR_S / 10,
+                          reclaimed=0.0)
+    assert compare_sweep(tiny, tiny) == []
+
+
+def test_gate_missing_pipeline_block_is_a_finding():
+    base = _pipe_manifest(True, model=2.0, reclaimed=1.5)
+    broken = dict(base)
+    broken["pipeline"] = None
+    findings = compare_sweep(broken, base)
+    assert any(f.metric == "pipeline" for f in findings)

@@ -547,7 +547,7 @@ def _parity_tree(tmp_path) -> str:
     for rel in ("config.py", "sim.py", "sweep.py"):
         shutil.copy(os.path.join(PKG_DIR, rel), root / rel)
     for rel in ("ops/pallas_round.py", "parallel/sharded.py",
-                "parallel/multihost.py"):
+                "parallel/multihost.py", "parallel/grid.py"):
         shutil.copy(os.path.join(PKG_DIR, rel), os.path.join(root, rel))
     return str(root)
 
@@ -579,7 +579,7 @@ def test_config_parity_new_consumed_field_fires_everywhere(tmp_path):
           count=1)
     active, _ = _findings(root, rules=["config-parity"])
     hits = [f for f in active if "poll_rounds" in f.message]
-    assert len(hits) == 4      # one per regime file, none allowlisted
+    assert len(hits) == 5      # one per regime file, none allowlisted
 
 
 def test_config_parity_heartbeat_field_clean_and_mutation_fails(tmp_path):
@@ -677,6 +677,30 @@ def test_config_parity_faultlab_fields_clean_and_mutation_fails(tmp_path):
     active, _ = _findings(root2, rules=["config-parity"])
     assert any("recovery" in f.message and "sweep.py" in f.message
                for f in active)
+
+
+def test_config_parity_grid_regime_clean_and_mutation_fails(tmp_path):
+    """ISSUE 16 satellite: parallel/grid.py is the sixth policed regime
+    — the shipped tree passes (grid references the placement-shaping
+    fields itself; the delegated fields carry reasoned PARITY_ALLOWLIST
+    entries), and removing ONE placement-relevant reference (the
+    recorder's partition rule) fails lint with a single finding."""
+    root = _parity_tree(tmp_path)
+    active, _ = _findings(root, rules=["config-parity"])
+    assert active == []        # clean as shipped (allowlist included)
+
+    # mutation: placement stops seeing the recorder arm — a recorded 2D
+    # run would device_put the state but leave the recorder rule out of
+    # partition_rules, exactly the recorder-style regime skip the rule
+    # owns
+    _edit(root, "parallel/grid.py", "if cfg.record:", "if False:",
+          count=1)
+    active, _ = _findings(root, rules=["config-parity"])
+    hits = [f for f in active if "record" in f.message
+            and "parallel/grid.py" in f.message]
+    assert len(hits) == 1
+    f = hits[0]
+    assert f.rule == "config-parity" and f.path == "sim.py"
 
     # partition mutation, independently: the bucketing predicate stops
     # seeing the partition plane (its spec would still ride the key,
@@ -1014,11 +1038,13 @@ def test_shipped_tree_lints_clean():
     # broad-except is perfscope.instrument.cost_of's best-effort
     # accounting boundary; the fourth through sixth are the serve
     # plane's multi-tenant isolation boundaries — batcher step/run and
-    # the request handler's 500 path; the second host-rng is the topo
-    # plane's seeded static graph-table construction, a trace-time
-    # constant — topo/graphs.build_neighbor_table)
+    # the request handler's 500 path; the seventh is sweep_async's
+    # cross-thread exception relay, which re-raises verbatim on the
+    # consumer; the second host-rng is the topo plane's seeded static
+    # graph-table construction, a trace-time constant —
+    # topo/graphs.build_neighbor_table)
     assert rep.suppressed == {"host-sync": 1, "host-rng": 2,
-                              "donate-argnums": 3, "broad-except": 6}
+                              "donate-argnums": 3, "broad-except": 7}
     assert rep.files >= 40
 
 
@@ -1033,7 +1059,7 @@ def test_report_schema_and_cli_exit_codes(tmp_path):
     with open(Args.out) as fh:
         doc = json.load(fh)
     assert check_metrics_schema.check_lint_report(doc) == []
-    assert doc["ok"] is True and doc["suppressed_total"] == 12
+    assert doc["ok"] is True and doc["suppressed_total"] == 13
 
     # a dirty tree exits 2 through the same entry point
     dirty = _write_pkg(tmp_path, {"gen.py": HOST_RNG_SRC})

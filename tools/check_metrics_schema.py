@@ -260,7 +260,9 @@ def check_scaling_manifest(manifest: dict,
     entry (the same indirection the perf manifest uses for its dynamic
     regime map), plus the cross-field facts the scaling gate relies on:
     at least one rung, a mandatory 1-device rung (efficiency's anchor),
-    unique (devices, n_nodes) rungs, mesh_shape product == devices, and
+    unique (devices, n_nodes, mesh_shape) rungs (2D grid rungs may
+    share a device count with a 1D rung), mesh_shape product ==
+    devices, and
     efficiency == node_rounds_per_sec / (devices x the 1-device rung's
     node_rounds_per_sec) — a drifted efficiency would silently skew the
     gate's whole verdict."""
@@ -286,10 +288,14 @@ def check_scaling_manifest(manifest: dict,
                           f"{row['devices']}")
     if errors:
         return errors
-    rungs = [(r["devices"], r["n_nodes"]) for r in rows]
+    # rung identity includes the 2D mesh shape: a (2,2) and a (1,4)
+    # rung legitimately share (devices, n_nodes) — the grid ladder
+    # exercises exactly that contrast
+    rungs = [(r["devices"], r["n_nodes"], tuple(r["mesh_shape"]))
+             for r in rows]
     if len(set(rungs)) != len(rungs):
-        errors.append(f"$.rows: duplicate (devices, n_nodes) rungs in "
-                      f"{rungs}")
+        errors.append(f"$.rows: duplicate (devices, n_nodes, mesh_shape) "
+                      f"rungs in {rungs}")
     ones = [r for r in rows if r["devices"] == 1]
     if not ones:
         errors.append("$.rows: no 1-device rung — efficiency has no "
@@ -744,7 +750,12 @@ def check_sweep_manifest(manifest: dict,
     ``ideal_pipeline_s`` / ``overlap_headroom_s`` /
     ``overlap_headroom_frac`` must equal a recomputation from the
     per-bucket stages via sweepscope/gate.py's own pipeline model — a
-    hand-edited headroom cannot survive."""
+    hand-edited headroom cannot survive.  v2: the ``pipeline`` block's
+    model/reclaimed/frac must recompute the same way from the stages +
+    the bucket-loop ``span_s`` (and the span cannot exceed the wall);
+    pipelined manifests get the overlap-adjusted telescoping upper band
+    (``gate.telescope_max``) since their stage sum legitimately exceeds
+    the shrunken wall."""
     errors: List[str] = []
     with open(schema_path) as fh:
         schema = json.load(fh)
@@ -820,15 +831,42 @@ def check_sweep_manifest(manifest: dict,
                       f"manifest wall_s {manifest['wall_s']}")
     if manifest["wall_s"] > 0:
         want_cov = want_serial / manifest["wall_s"]
+        # pipelined dispatch overlaps host compile with device execute,
+        # so the stage SUM legitimately exceeds the shrunken wall — the
+        # upper band is the overlap-adjusted gate.telescope_max
+        cov_max = gate.telescope_max(manifest)
         if not _near(tel["coverage"], want_cov):
             errors.append(f"$.telescoping.coverage: {tel['coverage']} "
                           f"!= stage_sum/wall ({want_cov:.6f})")
-        if not (gate.TELESCOPE_MIN <= want_cov <= gate.TELESCOPE_MAX):
+        if not (gate.TELESCOPE_MIN <= want_cov <= cov_max):
             errors.append(
                 f"$.telescoping: bucket stage clocks cover "
                 f"{want_cov:.3f} of the sweep wall — outside the "
-                f"[{gate.TELESCOPE_MIN}, {gate.TELESCOPE_MAX}] band, "
+                f"[{gate.TELESCOPE_MIN}, {cov_max:.3f}] band, "
                 f"the stage model does not account for the wall clock")
+    pipe = manifest["pipeline"]
+    span = float(pipe["span_s"])
+    if span < 0:
+        errors.append(f"$.pipeline.span_s: negative bucket-loop span "
+                      f"{span}")
+    elif manifest["wall_s"] > 0 and span > manifest["wall_s"] * 1.001:
+        errors.append(f"$.pipeline.span_s: {span} exceeds the sweep "
+                      f"wall_s {manifest['wall_s']} — the bucket loop "
+                      f"cannot outlast the call that contains it")
+    if not _near(pipe["headroom_model_s"], want_hr):
+        errors.append(f"$.pipeline.headroom_model_s: "
+                      f"{pipe['headroom_model_s']} != serial - ideal "
+                      f"recomputed from stages ({want_hr:.6f})")
+    want_reclaimed = gate.headroom_reclaimed_s(buckets, span)
+    if not _near(pipe["headroom_reclaimed_s"], want_reclaimed):
+        errors.append(f"$.pipeline.headroom_reclaimed_s: "
+                      f"{pipe['headroom_reclaimed_s']} != serial - "
+                      f"span recomputed ({want_reclaimed:.6f})")
+    want_frac = (want_reclaimed / want_hr) if want_hr > 0 else 0.0
+    if not _near(pipe["headroom_reclaimed_frac"], want_frac):
+        errors.append(f"$.pipeline.headroom_reclaimed_frac: "
+                      f"{pipe['headroom_reclaimed_frac']} != "
+                      f"reclaimed/model ({want_frac:.6f})")
     return errors
 
 
