@@ -86,7 +86,7 @@ _DETAIL_KEYS = ("curve", "pallas_check", "pallas_hist_check",
                 "pallas_round_check", "pallas_demoted",
                 "batched_sweep_check", "flight_recorder", "perfscope",
                 "meshscope", "serve", "topo", "sweepscope",
-                "kernelscope", "faults", "lint")
+                "kernelscope", "faults", "atlas", "lint")
 
 
 def _split_headline(out: dict) -> tuple[dict, dict]:
@@ -176,6 +176,15 @@ def _split_headline(out: dict) -> tuple[dict, dict]:
         # audited clean under the relaxed neighborhood invariants; the
         # curves live in the sidecar's topo blob
         head["topo_ok"] = bool(tp.get("ok"))
+    atl = out.get("atlas")
+    if isinstance(atl, dict):
+        # ONE compact bool: search-off bit-identity (results + compile
+        # counts), one-bucket-per-generation compile pin on the
+        # drop_prob axis, atlas manifest schema-valid with every cliff
+        # repro replaying + the partition boundary auditing clean, and
+        # in-band vs ATLAS_BASELINE.json when comparable; the full
+        # phase atlas lives in the sidecar's atlas blob
+        head["atlas_ok"] = bool(atl.get("ok"))
     fl = out.get("faults")
     if isinstance(fl, dict):
         # ONE compact bool: injection off bit-identical (results +
@@ -1186,6 +1195,19 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
         f"compile_parity={kernelscope_check.get('compile_parity')} "
         f"baseline_comparable="
         f"{kernelscope_check.get('baseline_comparable')}")
+    try:
+        atlas_check = _atlas_check()
+    except Exception as e:  # noqa: BLE001 — accounting must not kill the run
+        atlas_check = {"ok": False,
+                       "error": f"{type(e).__name__}: {e}"}
+    am = atlas_check.get("manifest", {})
+    log(f"bench: atlas check ok={atlas_check.get('ok')} "
+        f"cliffs={am.get('cliff_count')} "
+        f"probes={am.get('probe_count')} "
+        f"off_identity={atlas_check.get('off_identity')} "
+        f"one_bucket="
+        f"{atlas_check.get('omission_one_bucket_per_generation')} "
+        f"baseline_comparable={atlas_check.get('baseline_comparable')}")
 
     total_trials = trials * len(regimes)
     log(f"bench: sweep elapsed {elapsed:.2f}s for {total_trials} trials; "
@@ -1245,6 +1267,7 @@ def bench_sweep(platform: str, fallback: bool) -> dict:
         "faults": faults_check,
         "sweepscope": sweepscope_check,
         "kernelscope": kernelscope_check,
+        "atlas": atlas_check,
         "pallas_demoted": demoted,
     }
 
@@ -1866,6 +1889,124 @@ def _kernelscope_check() -> dict:
     blob["regressions"] = regressions
     blob["ok"] = (not schema_errors and bit_equal and compile_parity
                   and telescoping and not regressions)
+    return blob
+
+
+def _atlas_check() -> dict:
+    """The phase-boundary observatory's acceptance (PR 20,
+    benor_tpu/atlas) at the fixed CPU-safe capture scale the committed
+    ATLAS_BASELINE.json was taken at (all three shipped searches:
+    omission stall cliff, partition liveness boundary, F >= N/2 quorum
+    cliff):
+
+      * search OFF vs ON must be bit-identical: driving the quorum
+        search's coarse generation-0 grid through run_points_batched
+        DIRECTLY must reproduce the search's recorded probes exactly
+        (science fields) at the same compile count — the atlas driver
+        adds no execution semantics of its own;
+      * every omission-search refinement generation must have run as
+        ONE dyn bucket with ONE compile (the whole drop_prob axis
+        shares a traced-DynParams executable — the probe cost model
+        the manifest's per-cliff compile accounting is built on);
+      * the ``kind: atlas_manifest`` document must be schema-valid
+        with all cross-field recomputes (tools/atlas_manifest_schema
+        .json via the file-path-loaded checker), every cliff's shrunk
+        repro must have replayed bit-identically at capture time, and
+        the stalled partition boundary must have audited CLEAN
+        (liveness-not-safety, machine-checked);
+      * the same gate CI runs (atlas/gate.compare_atlas behind
+        tools/check_atlas_regression.py) must be in-band vs the
+        committed ATLAS_BASELINE.json when comparable (an accelerator
+        capture vs the CPU baseline is honestly reported incomparable,
+        not silently passed).
+    """
+    import importlib.util
+
+    import numpy as np
+
+    from benor_tpu.atlas import manifest as amanifest
+    from benor_tpu.atlas.gate import IncomparableAtlas, compare_atlas
+    from benor_tpu.atlas.scenario import parse_axis
+    from benor_tpu.config import SimConfig
+    from benor_tpu.sweep import run_points_batched
+
+    manifest = amanifest.capture_atlas(forensics=True)
+
+    spec = importlib.util.spec_from_file_location(
+        "_check_metrics_schema",
+        os.path.join(HERE, "tools", "check_metrics_schema.py"))
+    cms = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cms)
+    schema_errors = cms.check_atlas_manifest(manifest)
+
+    # search-off identity: the quorum search's generation-0 grid,
+    # driven through the sweep engine directly (no atlas driver)
+    qspec = amanifest._search_specs()["quorum"]
+    qcfg = SimConfig(**qspec["cfg"])
+    axis = parse_axis(qspec["axis"])
+    grid = axis.grid(qspec["coarse"])
+    ones = np.ones((qcfg.trials, qcfg.n_nodes), np.int8)
+    cb = run_points_batched(qcfg, [axis.apply(qcfg, v) for v in grid],
+                            initial_values=ones)
+    qsearch = next(s for s in manifest["searches"]
+                   if s["name"] == "quorum")
+    gen0 = [p for p in qsearch["probes"] if p["generation"] == 0]
+    off_identity = (len(gen0) == len(cb.points) and all(
+        p["rounds_executed"] == int(pt.rounds_executed)
+        and p["decided_frac"] == float(pt.decided_frac)
+        and p["mean_k"] == float(pt.mean_k)
+        and p["disagree_frac"] == float(pt.disagree_frac)
+        for p, pt in zip(gen0, cb.points)))
+    off_compile_parity = (
+        cb.compile_count == qsearch["generations"][0]["compile_count"])
+
+    # one-bucket-per-generation pin: the whole drop_prob axis is one
+    # traced-DynParams executable, every generation of it
+    osearch = next(s for s in manifest["searches"]
+                   if s["name"] == "omission")
+    one_bucket = all(g["n_buckets"] == 1 and g["compile_count"] == 1
+                     for g in osearch["generations"])
+
+    cliffs = [c for s in manifest["searches"] for c in s["cliffs"]]
+    repro_ok = bool(cliffs) and all(c.get("repro_reproduced") is True
+                                    for c in cliffs)
+    psearch = next(s for s in manifest["searches"]
+                   if s["name"] == "partition")
+    liveness_clean = all(
+        c.get("safety", {}).get("audit_ok") is True
+        for c in psearch["cliffs"])
+
+    blob = {
+        "manifest": manifest,
+        "schema_errors": schema_errors,
+        "off_identity": off_identity,
+        "off_compile_parity": off_compile_parity,
+        "omission_one_bucket_per_generation": one_bucket,
+        "cliff_count": manifest["cliff_count"],
+        "repro_replayed": repro_ok,
+        "partition_audit_clean": liveness_clean,
+    }
+    regressions = []
+    comparable = None
+    baseline_path = os.path.join(HERE, "ATLAS_BASELINE.json")
+    if os.path.exists(baseline_path):
+        try:
+            with open(baseline_path) as fh:
+                baseline = json.load(fh)
+            regressions = [f.to_dict()
+                           for f in compare_atlas(manifest, baseline)]
+            comparable = True
+        except (IncomparableAtlas, ValueError) as e:
+            comparable = False
+            blob["baseline_note"] = f"{e}"
+    else:
+        blob["baseline_note"] = "no committed ATLAS_BASELINE.json"
+    blob["baseline_comparable"] = comparable
+    blob["regressions"] = regressions
+    blob["ok"] = (not schema_errors and off_identity
+                  and off_compile_parity and one_bucket
+                  and manifest["cliff_count"] >= 2 and repro_ok
+                  and liveness_clean and not regressions)
     return blob
 
 

@@ -960,6 +960,47 @@ def _format_sweep_done(rec) -> str:
     return " ".join(bits)
 
 
+def _format_atlas_probe(rec) -> str:
+    """One atlas search probe (atlas/search.py) as a watch line: which
+    axis and generation, the probed value and its verdict."""
+    bits = [f"[atlas:{rec.get('axis')}]",
+            f"gen={rec.get('generation')}",
+            f"{rec.get('axis')}={rec.get('value')}",
+            f"verdict={rec.get('verdict')}"]
+    if isinstance(rec.get("stall_frac"), (int, float)):
+        bits.append(f"stall={rec['stall_frac']:.3f}")
+    if rec.get("rounds_executed") is not None:
+        bits.append(f"rounds={rec['rounds_executed']}")
+    return " ".join(bits)
+
+
+def _format_atlas_cliff(rec) -> str:
+    """One cliff-refinement step: the bracketing interval after this
+    generation's bisection, flagged when at the pinned tolerance."""
+    bits = [f"[atlas:{rec.get('axis')}]"]
+    if rec.get("generation") is not None:
+        bits.append(f"gen={rec['generation']}")
+    bits.append(f"cliff [{rec.get('lo')}, {rec.get('hi')}]")
+    if isinstance(rec.get("width"), (int, float)):
+        bits.append(f"width={rec['width']:g}")
+    bits.append(f"{rec.get('lo_verdict')}->{rec.get('hi_verdict')}")
+    if rec.get("converged"):
+        bits.append("CONVERGED")
+    return " ".join(bits)
+
+
+def _format_atlas_heatmap(rec) -> str:
+    """One 2D-slice heatmap document, rendered with the backend-free
+    shade grid from benor_tpu/atlas/__init__.py."""
+    from .atlas import render_heatmap
+    try:
+        return render_heatmap(rec)
+    except (KeyError, TypeError, ValueError):
+        # a torn/foreign heatmap record: surface it raw, never crash
+        # the tail
+        return json.dumps(rec, sort_keys=True)
+
+
 def _watch(args) -> int:
     """Tail a running run's JSON-lines progress file (heartbeats from
     meshscope, sweep-journal bucket records from sweepscope, or one
@@ -973,6 +1014,7 @@ def _watch(args) -> int:
     (nothing to watch)."""
     import json as _json
 
+    from .atlas import CLIFF_KIND, HEATMAP_KIND, PROBE_KIND
     from .kernelscope.report import KERNEL_TELEM_KIND
     from .meshscope.heartbeat import HEARTBEAT_KIND, tail_records
     from .sweepscope.journal import BUCKET_KIND, DONE_KIND
@@ -980,11 +1022,15 @@ def _watch(args) -> int:
     formatters = {HEARTBEAT_KIND: _format_heartbeat,
                   BUCKET_KIND: _format_sweep_bucket,
                   DONE_KIND: _format_sweep_done,
-                  KERNEL_TELEM_KIND: _format_kernel_telem}
+                  KERNEL_TELEM_KIND: _format_kernel_telem,
+                  PROBE_KIND: _format_atlas_probe,
+                  CLIFF_KIND: _format_atlas_cliff,
+                  HEATMAP_KIND: _format_atlas_heatmap}
     seen = 0
     for rec in tail_records(args.path, poll_s=args.poll,
                             timeout_s=args.timeout,
-                            follow=not args.no_follow):
+                            follow=not args.no_follow,
+                            stop_when_done=not args.keep_going):
         seen += 1
         fmt = formatters.get(rec.get("kind"))
         if fmt is not None:
@@ -1003,6 +1049,140 @@ def _watch(args) -> int:
               file=sys.stderr)
         return 1
     return 0
+
+
+def _atlas(args) -> int:
+    """The phase-boundary observatory (benor_tpu/atlas): adaptive cliff
+    search over the scenario grid -> pinned-schema atlas manifest +
+    cliff-drift gate vs the committed ATLAS_BASELINE.json.  Exit 2 on
+    drift findings; an incomparable baseline (platform/scale mismatch)
+    is a printed note, not a failure — recapture or re-baseline."""
+    from .atlas import gate as agate
+    from .atlas import manifest as amanifest
+    from .atlas import render_heatmap
+    from .atlas import search as asearch
+
+    verbose = args.format == "text"
+    if args.out_dir:
+        os.makedirs(args.out_dir, exist_ok=True)
+
+    if args.heatmap:
+        from .config import SimConfig
+        spec_a, spec_b = args.heatmap.split(",", 1)
+        cfg = SimConfig(n_nodes=args.n, n_faulty=args.f,
+                        trials=args.trials, max_rounds=args.max_rounds,
+                        delivery="all", path="histogram",
+                        seed=args.seed)
+        doc = asearch.heatmap_slice(cfg, spec_a, spec_b,
+                                    na=args.coarse, nb=args.coarse,
+                                    journal_path=args.journal,
+                                    verbose=verbose)
+        asearch.export_heatmap(doc, json_path=args.profile_out,
+                               trace_path=args.trace_out)
+        if args.format == "json":
+            print(json.dumps(doc, indent=1, sort_keys=True))
+        else:
+            print(render_heatmap(doc))
+            print(f"  {len(doc['rows'])} probes in {doc['n_buckets']} "
+                  f"bucket(s), {doc['compile_count']} compile(s)")
+        return 0
+
+    if args.axis:
+        from .config import SimConfig
+        cfg = SimConfig(n_nodes=args.n, n_faulty=args.f,
+                        trials=args.trials, max_rounds=args.max_rounds,
+                        delivery="all", path="histogram",
+                        seed=args.seed)
+        docs = []
+        for i, spec in enumerate(args.axis):
+            res = asearch.find_cliffs(
+                cfg, spec, coarse=args.coarse,
+                journal_path=args.journal,
+                resume=args.resume or i > 0,
+                forensics=not args.no_forensics,
+                out_dir=args.out_dir, verbose=verbose)
+            d = res.to_dict()
+            d["name"] = f"axis{i}"
+            docs.append(d)
+        manifest = amanifest.build_manifest(docs, scale=args.scale)
+    else:
+        searches = tuple(s for s in args.searches.split(",") if s)
+        manifest = amanifest.capture_atlas(
+            searches=searches, scale=args.scale,
+            forensics=not args.no_forensics,
+            journal_path=args.journal, resume=args.resume,
+            out_dir=args.out_dir, verbose=verbose)
+
+    baseline_path = args.baseline or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "ATLAS_BASELINE.json")
+    if args.update_baseline:
+        amanifest.save_manifest(baseline_path, manifest)
+        print(f"baseline updated: {baseline_path} "
+              f"({manifest['cliff_count']} cliffs, "
+              f"{manifest['probe_count']} probes)")
+        return 0
+    if args.profile_out:
+        amanifest.save_manifest(args.profile_out, manifest)
+    if args.format == "json":
+        print(json.dumps(manifest, indent=1, sort_keys=True))
+    else:
+        for s in manifest["searches"]:
+            print(f"[{s['name']}] {s['spec']}: {s['probe_count']} "
+                  f"probes / {len(s['generations'])} generations / "
+                  f"{s['compile_count']} compiles")
+            for c in s["cliffs"]:
+                extra = ""
+                if c.get("safety"):
+                    extra += (" audit_ok" if c["safety"]["audit_ok"]
+                              else f" VIOLATIONS="
+                                   f"{c['safety']['n_violations']}")
+                if c.get("repro_reproduced") is not None:
+                    extra += (" repro_ok" if c["repro_reproduced"]
+                              else " REPRO-STALE")
+                print(f"  cliff {c['axis']}={c['point']:g} bracket "
+                      f"[{c['lo']:g}, {c['hi']:g}] "
+                      f"{c['lo_verdict']}->{c['hi_verdict']}{extra}")
+    if os.path.exists(baseline_path):
+        try:
+            findings = agate.compare_atlas(
+                manifest, amanifest.load_manifest(baseline_path))
+        except (agate.IncomparableAtlas, ValueError) as e:
+            print(f"atlas: baseline not comparable ({e}) — skipping "
+                  f"the drift gate", file=sys.stderr)
+            return 0
+        for f in findings:
+            print(f"REGRESSION: [{f.metric}] {f.message}")
+        if findings:
+            return 2
+        print(f"atlas: in-band vs {os.path.basename(baseline_path)}")
+    return 0
+
+
+def _replay(args) -> int:
+    """Re-execute a ``kind: atlas_repro`` document and pin it
+    bit-identically: exit 0 reproduced, 2 verdict/digest mismatch, 1
+    unreadable input."""
+    from .atlas import repro as arepro
+
+    try:
+        doc = arepro.load_repro(args.path)
+    except (OSError, ValueError) as e:
+        print(f"replay: unreadable repro: {e}", file=sys.stderr)
+        return 1
+    res = arepro.replay_repro(doc)
+    if args.format == "json":
+        print(json.dumps(res, indent=1, sort_keys=True))
+    else:
+        v, e = res["verdict"], res["expected"]
+        print(f"replay {os.path.basename(args.path)} "
+              f"[{doc.get('label') or 'unlabeled'}]: "
+              f"digest {'ok' if res['digest_ok'] else 'MISMATCH'}, "
+              f"verdict {v['verdict']} (recorded {e.get('verdict')}) "
+              f"rounds={v['rounds_executed']} "
+              f"decided={v['decided_frac']:g} -> "
+              f"{'REPRODUCED' if res['ok'] else 'NOT REPRODUCED'}")
+    return 0 if res["ok"] else 2
 
 
 def _preset(args) -> int:
@@ -1379,6 +1559,90 @@ def main(argv=None) -> int:
     w.add_argument("--no-follow", action="store_true",
                    help="print what is in the file now and exit "
                         "instead of tailing")
+    w.add_argument("--keep-going", action="store_true",
+                   help="do not stop at done: true records — an atlas "
+                        "search journal carries one sweep_done per "
+                        "refinement generation, with probe/cliff "
+                        "records interleaving after each")
+
+    at = sub.add_parser(
+        "atlas",
+        help="phase-boundary observatory: adaptive cliff search over "
+             "the scenario grid (benor_tpu/atlas) -> pinned-schema "
+             "kind:atlas_manifest + cliff-drift gate vs "
+             "ATLAS_BASELINE.json; exit 2 on drift")
+    at.add_argument("--searches", default="omission,partition,quorum",
+                    help="comma-separated shipped searches to run "
+                         "(default: all three — the omission stall "
+                         "cliff, the partition liveness boundary, the "
+                         "F >= N/2 quorum cliff)")
+    at.add_argument("--axis", action="append", default=None,
+                    metavar="SPEC",
+                    help="instead of the shipped searches, hunt cliffs "
+                         "on this '<name>:<lo>:<hi>[:<tol>]' axis over "
+                         "the --n/--f/--trials/--max-rounds base "
+                         "config (repeatable; see "
+                         "atlas/scenario.AXIS_KINDS)")
+    at.add_argument("--n", type=int, default=64,
+                    help="base nodes for --axis/--heatmap searches")
+    at.add_argument("--f", type=int, default=16)
+    at.add_argument("--trials", type=int, default=8)
+    at.add_argument("--max-rounds", type=int, default=16)
+    at.add_argument("--seed", type=int, default=0)
+    at.add_argument("--coarse", type=int, default=4,
+                    help="coarse seeding-grid intervals per axis "
+                         "(default 4 -> 5 grid points)")
+    at.add_argument("--scale", type=float, default=1.0,
+                    help="trial-count multiplier for the shipped "
+                         "searches (cliff LOCATIONS are scale-free; "
+                         "the gate refuses cross-scale compares)")
+    at.add_argument("--no-forensics", action="store_true",
+                    help="skip the per-cliff witness-armed audit and "
+                         "minimal-repro emission")
+    at.add_argument("--journal", metavar="PATH",
+                    help="append atlas_probe/atlas_cliff records plus "
+                         "the underlying sweep-journal bucket records "
+                         "here (`python -m benor_tpu watch` renders "
+                         "them; --resume restarts from it)")
+    at.add_argument("--resume", action="store_true",
+                    help="with --journal: restore every completed "
+                         "generation's buckets bit-identically from "
+                         "the journal (0 compiles) and run only the "
+                         "remainder")
+    at.add_argument("--out-dir", metavar="DIR",
+                    help="dump witness bundles + repro JSONs here")
+    at.add_argument("--heatmap", metavar="SPEC_A,SPEC_B",
+                    help="instead of a search: evaluate the 2D "
+                         "axis_a x axis_b slice in ONE batched call "
+                         "and render the stall/rounds heatmap "
+                         "(--profile-out JSON rows, --trace-out "
+                         "Perfetto counter tracks)")
+    at.add_argument("--trace-out", metavar="PATH",
+                    help="with --heatmap: write Perfetto counter "
+                         "tracks (one per axis_b row) here")
+    at.add_argument("--format", choices=("text", "json"),
+                    default="text")
+    at.add_argument("--profile-out", metavar="PATH",
+                    help="write the manifest (or --heatmap document) "
+                         "to this JSON file")
+    at.add_argument("--baseline", metavar="PATH", default=None,
+                    help="baseline manifest to gate against (default: "
+                         "the committed ATLAS_BASELINE.json)")
+    at.add_argument("--update-baseline", action="store_true",
+                    help="write this capture as the new baseline "
+                         "instead of gating against it")
+    _add_obs_args(at, record=False)
+
+    rp = sub.add_parser(
+        "replay",
+        help="re-execute a kind:atlas_repro document bit-identically "
+             "(digest + verdict pinned); exit 0 reproduced, 2 "
+             "mismatch, 1 unreadable")
+    rp.add_argument("path", help="repro JSON (atlas --out-dir emission "
+                                 "or a manifest cliff's repro block "
+                                 "saved to a file)")
+    rp.add_argument("--format", choices=("text", "json"),
+                    default="text")
 
     r = sub.add_parser("results",
                        help="generate RESULTS/ (curves + presets artifact)")
@@ -1397,7 +1661,8 @@ def main(argv=None) -> int:
     if not argv or argv[0] not in ("demo", "sweep", "coins", "preset",
                                    "results", "trace", "audit", "lint",
                                    "profile", "scale", "watch", "serve",
-                                   "load", "-h", "--help"):
+                                   "load", "atlas", "replay",
+                                   "-h", "--help"):
         argv = ["demo"] + argv
     args = ap.parse_args(argv)
     if args.cmd == "scale":
@@ -1430,7 +1695,8 @@ def main(argv=None) -> int:
             "preset": _preset, "results": _results,
             "trace": _trace, "audit": _audit, "lint": _lint,
             "profile": _profile, "scale": _scale,
-            "watch": _watch, "serve": _serve, "load": _load}[args.cmd](args)
+            "watch": _watch, "serve": _serve, "load": _load,
+            "atlas": _atlas, "replay": _replay}[args.cmd](args)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,70 @@
+"""atlas — the phase-boundary observatory (ROADMAP item 5).
+
+Composes the instruments the last five observability PRs built into one
+subsystem that *discovers* physics instead of re-running it: a scenario
+search driver (`atlas.search`) that uses ``sweep.run_points_batched`` as
+its batched evaluator and ``audit.py`` + the flight recorder as its
+oracle to hunt safety/liveness boundaries; a declarative axis grammar
+over the swept knobs (`atlas.scenario`); a minimal-repro emitter whose
+``kind: atlas_repro`` documents replay bit-identically
+(`atlas.repro`, CLI ``python -m benor_tpu replay``); a pinned-schema
+``kind: atlas_manifest`` capture (`atlas.manifest`); and a stdlib-only
+cliff-drift comparator (`atlas.gate`, run by
+``tools/check_atlas_regression.py`` against the committed
+``ATLAS_BASELINE.json``).
+
+This module stays import-light on purpose: the `watch` tail renders the
+search's journal records by kind without touching a JAX backend, so the
+record tags live here, not in the (jax-importing) search driver.
+"""
+
+from __future__ import annotations
+
+#: One evaluated probe (axis value -> verdict) — appended to the search
+#: journal alongside the sweepscope bucket records it interleaves with.
+PROBE_KIND = "atlas_probe"
+
+#: One refinement step of a detected cliff's bracketing interval.
+CLIFF_KIND = "atlas_cliff"
+
+#: One evaluated 2D slice (rounds-to-decide / stall-frac heatmap rows).
+HEATMAP_KIND = "atlas_heatmap"
+
+_SUBMODULES = ("scenario", "search", "repro", "manifest", "gate")
+
+__all__ = ["PROBE_KIND", "CLIFF_KIND", "HEATMAP_KIND",
+           "render_heatmap", *_SUBMODULES]
+
+#: Terminal shade ramp for render_heatmap (metric 0 -> row max).
+_SHADES = " .:-=+*#%@"
+
+
+def render_heatmap(doc: dict, metric: str = "stall_frac") -> str:
+    """Pure-stdlib terminal rendering of one ``kind: atlas_heatmap``
+    document: one row per axis_b value, one shade cell per axis_a value
+    (darkest = the slice maximum).  Lives here — not in the
+    (jax-importing) search driver — because the `watch` tail renders
+    these records backend-free."""
+    va, vb = doc["values_a"], doc["values_b"]
+    cell = {(r["a"], r["b"]): float(r[metric]) for r in doc["rows"]}
+    top = max(max(cell.values(), default=0.0), 1e-12)
+    lines = [f"atlas heatmap: {metric} over "
+             f"{doc['axis_a']} (->) x {doc['axis_b']} (rows)"]
+    for b in vb:
+        shades = ""
+        for a in va:
+            frac = min(max(cell.get((a, b), 0.0) / top, 0.0), 1.0)
+            shades += _SHADES[int(round(frac * (len(_SHADES) - 1)))]
+        lines.append(f"  {doc['axis_b']}={b:<8g} |{shades}|")
+    lines.append(f"  {doc['axis_a']}: {va[0]:g} .. {va[-1]:g}   "
+                 f"(shade ' '..'@' = {metric} 0..{top:g})")
+    return "\n".join(lines)
+
+
+def __getattr__(name: str):
+    # lazy submodule access (search/repro/manifest pull in jax via the
+    # sweep engine; importing `benor_tpu.atlas` must stay backend-free)
+    if name in _SUBMODULES:
+        import importlib
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

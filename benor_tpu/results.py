@@ -244,6 +244,52 @@ def _witness_rerun(cfg: SimConfig, initial_values, faults, tag: str,
     return summary
 
 
+def _violation_forensics(cfg, initial_values, faults, tag: str,
+                         out_dir=None, verbose=True,
+                         fault_policy: str = "none",
+                         shrink: bool = False,
+                         repro: bool = True) -> Dict:
+    """The ONE forensic block every violating study row goes through
+    (deduplicates what disagreement_sweep and safety_violation used to
+    inline separately): the witness-armed bit-identical rerun + audit
+    (_witness_rerun), then a replayable ``kind: atlas_repro`` document
+    (benor_tpu/atlas/repro.py) whose digest and replay verdict ride in
+    the row — every violation artifact is replayable via
+    ``python -m benor_tpu replay``, not just inspectable.
+    ``fault_policy`` is the repro's declarative fault knob ('none' for
+    the adversary-only studies, 'default' for first-F-faulty rows).
+    ``shrink`` defaults OFF here: at full config the repro run and its
+    replay reuse the study's own jit-cached executable, while every
+    shrink candidate is a new static shape = a fresh compile — the
+    shrinking minimal-repro search belongs to the atlas cliff path,
+    where the configs are already small.  ``repro=False`` keeps the
+    per-row witness rerun but skips the repro document (its build and
+    replay are two more full runs): callers emit one repro per
+    violation CLASS, not per row — later rows of the same class
+    replay to the same-shaped document."""
+    summary = _witness_rerun(cfg, initial_values, faults, tag,
+                             out_dir=out_dir, verbose=verbose)
+    if not repro:
+        return summary
+    from .atlas import repro as arepro
+    doc = arepro.build_repro(cfg, inputs="balanced",
+                             faults=fault_policy, label=tag,
+                             shrink=shrink)
+    summary["repro_digest"] = doc["digest"]
+    summary["repro_reproduced"] = bool(arepro.replay_repro(doc)["ok"])
+    if out_dir:
+        path = os.path.join(out_dir, f"repro_{tag}.json")
+        arepro.save_repro(path, doc)
+        summary["repro"] = path
+        if verbose:
+            print(f"    repro {doc['config']['trials']}x"
+                  f"{doc['config']['n_nodes']} "
+                  f"({doc['shrink_steps']} shrink steps, "
+                  f"{'replays' if summary['repro_reproduced'] else 'STALE'}"
+                  f") -> {path}", flush=True)
+    return summary
+
+
 #: Split-adversary strengths for the disagreement study — spaced to frame
 #: the sharp safety phase transition (s_c ~ 0.45 at f = 0.25: below it the
 #: quorum overlap still forces enough starved-class messages through to
@@ -261,6 +307,7 @@ def disagreement_sweep(n: int, trials: int, seed: int = 0,
     # point, so inside generate() its executable comes from the jit cache
     # and the "duplicate" run costs one cached dispatch, not a compile.
     rows = []
+    repro_done = False
     for s in strengths:
         cfg = SimConfig(n_nodes=n, n_faulty=int(f_frac * n), trials=trials,
                         max_rounds=64, delivery="quorum",
@@ -276,11 +323,14 @@ def disagreement_sweep(n: int, trials: int, seed: int = 0,
                   f"decided={pt.decided_frac:.3f} mean_k={pt.mean_k:.2f}",
                   flush=True)
         if pt.disagree_frac > 0:
-            # agreement broke: auto-rerun with witnessing and pin WHICH
-            # nodes decided WHICH value on WHAT quorum evidence
-            row["witness_audit"] = _witness_rerun(
+            # agreement broke: auto-rerun with witnessing to pin WHICH
+            # nodes decided WHICH value on WHAT quorum evidence, and
+            # emit the replayable minimal repro of the break
+            row["witness_audit"] = _violation_forensics(
                 cfg, _balanced(trials, n), faults,
-                f"disagreement_s{s}", out_dir, verbose)
+                f"disagreement_s{s}", out_dir, verbose,
+                repro=not repro_done)
+            repro_done = True
         rows.append(row)
     return rows
 
@@ -314,14 +364,18 @@ def safety_violation(n: int, trials: int, seed: int = 0,
     ``out_dir`` when given.
     """
     rows = []
+    repro_classes = set()
 
-    def _row(cfg, faults, extra, tag):
+    def _row(cfg, faults, extra, tag, fault_policy="none"):
         pt = run_point(cfg, initial_values=_balanced(trials, n),
                        faults=faults)
         row = {**extra, **pt.to_dict()}
         if pt.disagree_frac > 0:
-            row["witness_audit"] = _witness_rerun(
-                cfg, _balanced(trials, n), faults, tag, out_dir, verbose)
+            row["witness_audit"] = _violation_forensics(
+                cfg, _balanced(trials, n), faults, tag, out_dir,
+                verbose, fault_policy=fault_policy,
+                repro=fault_policy not in repro_classes)
+            repro_classes.add(fault_policy)
         rows.append(row)
         return pt
 
@@ -369,7 +423,8 @@ def safety_violation(n: int, trials: int, seed: int = 0,
                     fault_model="equivocate", path="histogram", seed=seed)
     pt = _row(cfg, FaultSpec.first_f(cfg),
               {"f": 1, "f_frac": round(1 / n, 7),
-               "fault_model": "equivocate"}, "targeted_equivocate_f1")
+               "fault_model": "equivocate"}, "targeted_equivocate_f1",
+              fault_policy="default")
     if verbose:
         print(f"  ONE equivocator: disagree={pt.disagree_frac:.3f}",
               flush=True)

@@ -1,0 +1,492 @@
+"""atlas (benor_tpu/atlas) — the phase-boundary observatory.
+
+Pins the PR 20 contract:
+
+  * the ``<name>:<lo>:<hi>[:<tol>]`` axis grammar parses/validates and
+    ``apply`` realizes every knob as a plain SimConfig the existing
+    planes already validate (no new delivery semantics);
+  * the quorum cliff search brackets F = N/2 to the integer lattice
+    with EVERY generation one dyn bucket / one compile, and a journal
+    truncated mid-search (the SIGKILL shape) resumes bit-identically
+    with exactly the remaining generations' compiles;
+  * forensics emits a shrunk ``kind: atlas_repro`` document whose
+    replay is bit-identical by construction, ANY tamper (payload or
+    digest) fails the replay, and ``python -m benor_tpu replay`` maps
+    ok/mismatch/unreadable to exit 0/2/1;
+  * the ``kind: atlas_manifest`` document validates through
+    check_metrics_schema (registered checker + cross-field recomputes)
+    and journal parity holds;
+  * tools/check_atlas_regression.py exits 0 on the committed
+    ATLAS_BASELINE.json, 2 on a moved/vanished cliff or stale repro,
+    3 on a platform/scale mismatch;
+  * the express/native oracles agree with the TPU path on which SIDE of
+    the discovered quorum cliff decides vs stalls.
+"""
+
+import copy
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from benor_tpu.api import launch_network
+from benor_tpu.atlas import CLIFF_KIND, PROBE_KIND, render_heatmap
+from benor_tpu.atlas.gate import (CLIFF_BAND, AtlasFinding,  # noqa: F401
+                                  IncomparableAtlas, compare_atlas,
+                                  repro_digest)
+from benor_tpu.atlas.manifest import (ATLAS_MANIFEST_KIND, build_manifest,
+                                      capture_atlas, journal_parity,
+                                      load_manifest, save_manifest)
+from benor_tpu.atlas.repro import (REPRO_KIND, build_repro, load_repro,
+                                   replay_repro, save_repro)
+from benor_tpu.atlas.scenario import AXIS_KINDS, ScenarioAxis, parse_axis
+from benor_tpu.atlas.search import find_cliffs, heatmap_slice
+from benor_tpu.backends.native_oracle import native_available
+from benor_tpu.config import SimConfig
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO, "ATLAS_BASELINE.json")
+GATE_TOOL = os.path.join(REPO, "tools", "check_atlas_regression.py")
+SCHEMA_TOOL = os.path.join(REPO, "tools", "check_metrics_schema.py")
+
+sys.path.insert(0, os.path.join(REPO, "tools"))
+import check_metrics_schema  # noqa: E402
+
+#: The quorum regime: F sweeps through N/2 = 8 where unanimous-ones
+#: Ben-Or flips from round-1 decision to livelock — the cheapest cliff
+#: in the atlas (N=16, 4 trials, one dyn bucket per generation).
+QN, QT, QR = 16, 4, 8
+
+
+def _qcfg(**kw):
+    base = dict(n_nodes=QN, n_faulty=1, trials=QT, max_rounds=QR,
+                delivery="all", path="histogram", seed=0)
+    base.update(kw)
+    return SimConfig(**base)
+
+
+def _ones():
+    return np.ones((QT, QN), dtype=np.int32)
+
+
+# --------------------------------------------------------------------------
+# scenario: the axis grammar
+# --------------------------------------------------------------------------
+
+
+def test_parse_axis_all_kinds_and_defaults():
+    for name, kind in AXIS_KINDS.items():
+        ax = parse_axis(f"{name}:2:8")
+        assert ax.name == name and (ax.lo, ax.hi) == (2.0, 8.0)
+        assert ax.tol == kind["tol"] and ax.integer == kind["integer"]
+        assert ax.faults in ("none", "default")
+    # explicit tolerance wins (but never below the lattice floor)
+    assert parse_axis("drop_prob:0.1:0.4:0.05").tol == 0.05
+    assert parse_axis("f:1:12:0.25").tol == 1.0     # integer floor
+
+
+@pytest.mark.parametrize("spec,msg", [
+    ("drop_prob:0.1", "grammar"),
+    ("banana:1:2", "unknown scenario axis"),
+    ("f:one:2", "must be numbers"),
+    ("f:5:5", "lo < hi"),
+    ("drop_prob:0.1:0.4:0", "tol must be > 0"),
+    ("heal_round:1.5:4", "must be integers"),
+])
+def test_parse_axis_rejects(spec, msg):
+    with pytest.raises(ValueError, match=msg):
+        parse_axis(spec)
+
+
+def test_axis_apply_realizes_every_knob():
+    cfg = _qcfg()
+    assert parse_axis("drop_prob:0:0.5").apply(cfg, 0.3).drop_prob == 0.3
+    assert parse_axis("f:1:12").apply(cfg, 7).n_faulty == 7
+    assert parse_axis("heal_round:2:18").apply(cfg, 5).partition == \
+        "halves:5"
+    rec = parse_axis("recovery_down:1:6").apply(cfg, 3)
+    assert rec.fault_model == "crash_recover" and rec.recovery == "at:2:3"
+    topo = parse_axis("topology_degree:2:8").apply(cfg, 5)   # snaps to even
+    assert topo.topology in ("ring:4", "ring:6")
+    armed = cfg.replace(committee_cap=8, committee_count=2,
+                        committee_size=2)
+    assert parse_axis("committee_size:2:8").apply(armed, 4) \
+        .committee_size == 4
+    with pytest.raises(ValueError, match="committee plane"):
+        parse_axis("committee_size:2:8").apply(cfg, 4)
+    # apply fails loudly on an incoherent combination (SimConfig's error)
+    with pytest.raises(ValueError):
+        parse_axis("f:1:32").apply(cfg, 32)     # F > N
+
+
+def test_axis_lattice_snap_grid_midpoint():
+    ax = parse_axis("topology_degree:2:10")
+    assert ax.snap(5.1) == 6.0 and ax.snap(99) == 10.0
+    assert all(v % 2 == 0 for v in ax.grid(4))
+    f = parse_axis("f:1:12")
+    assert f.grid(11) == [float(v) for v in range(1, 13)]
+    assert f.midpoint(7, 8) is None              # converged bracket
+    assert f.midpoint(4, 9) in (6.0, 7.0)
+    d = parse_axis("drop_prob:0.0:0.4")
+    assert not d.converged(0.0, 0.4) and d.converged(0.2, 0.21)
+
+
+# --------------------------------------------------------------------------
+# search: the quorum cliff, compile pins, journal resume
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def quorum_capture(tmp_path_factory):
+    """ONE forensics-armed quorum capture shared by the search /
+    manifest / repro / gate tests (amortizes the backend compiles)."""
+    d = tmp_path_factory.mktemp("atlas")
+    journal = str(d / "journal.jsonl")
+    out_dir = str(d / "forensics")
+    os.makedirs(out_dir)
+    manifest = capture_atlas(searches=("quorum",), forensics=True,
+                             journal_path=journal, out_dir=out_dir)
+    return {"manifest": manifest, "journal": journal,
+            "out_dir": out_dir, "dir": d}
+
+
+def _quorum_search(cap):
+    (s,) = cap["manifest"]["searches"]
+    return s
+
+
+def test_quorum_search_brackets_half_n(quorum_capture):
+    s = _quorum_search(quorum_capture)
+    (cliff,) = s["cliffs"]
+    assert (cliff["lo"], cliff["hi"]) == (7.0, 8.0)   # F = N/2 exactly
+    assert cliff["lo_verdict"] == "decided"
+    assert cliff["hi_verdict"] == "stalled"
+    assert cliff["width"] <= 1.0
+
+
+def test_every_generation_is_one_bucket_one_compile(quorum_capture):
+    s = _quorum_search(quorum_capture)
+    assert len(s["generations"]) >= 2
+    for g in s["generations"]:
+        assert g["n_buckets"] == 1, g
+        assert g["compile_count"] == 1, g
+    assert s["compile_count"] == len(s["generations"])
+    assert s["probe_count"] == sum(g["n_points"] for g in s["generations"])
+
+
+def test_truncated_journal_resumes_bit_identical(tmp_path):
+    """The SIGKILL shape: cut the journal after generation 0's records
+    and resume — the coarse generation replays from the journal with
+    ZERO compiles, the refinement generations recompile, and the
+    search result is bit-equal to the uninterrupted one."""
+    journal = str(tmp_path / "j.jsonl")
+    axis = parse_axis("f:1:12")
+    full = find_cliffs(_qcfg(), axis, coarse=4, initial_values=_ones(),
+                       journal_path=journal)
+    n_gens = len(full.generations)
+    assert n_gens >= 2
+
+    # keep only generation 0's sweep records (everything up to and
+    # including the FIRST sweep_done) — the kill landed in generation 1
+    kept, done_seen = [], False
+    with open(journal) as fh:
+        for line in fh:
+            rec = json.loads(line)
+            if rec.get("kind") in (PROBE_KIND, CLIFF_KIND):
+                continue                  # atlas records are derived
+            kept.append(line)
+            if rec.get("kind") == "sweep_done":
+                done_seen = True
+                break
+    assert done_seen
+    with open(journal, "w") as fh:
+        fh.writelines(kept)
+
+    resumed = find_cliffs(_qcfg(), axis, coarse=4,
+                          initial_values=_ones(), journal_path=journal,
+                          resume=True)
+    assert resumed.generations[0]["compile_count"] == 0      # reused
+    assert resumed.generations[0]["buckets_reused"] == 1
+    for g in resumed.generations[1:]:
+        assert g["compile_count"] == 1                       # recompiled
+    # science is bit-equal: same probes, same brackets (only the
+    # compile accounting differs — the resume reused generation 0)
+    a, b = full.to_dict(), resumed.to_dict()
+    for k in ("generations", "compile_count"):
+        a.pop(k), b.pop(k)
+    for ca, cb in zip(a["cliffs"], b["cliffs"]):
+        ca.pop("compile_count"), cb.pop("compile_count")
+    assert a == b
+
+
+def test_heatmap_slice_renders_and_is_one_bucket(tmp_path):
+    doc = heatmap_slice(_qcfg(), "drop_prob:0.05:0.35", "f:2:6",
+                        na=3, nb=2, initial_values=_ones())
+    assert doc["kind"] == "atlas_heatmap"
+    assert doc["n_buckets"] == 1 and doc["compile_count"] == 1
+    text = render_heatmap(doc)
+    assert "drop_prob" in text and "stall_frac" in text
+    assert len(text.splitlines()) == len(doc["values_b"]) + 2
+
+
+# --------------------------------------------------------------------------
+# repro: shrink, replay, tamper
+# --------------------------------------------------------------------------
+
+
+def test_repro_shrinks_and_replays(quorum_capture):
+    s = _quorum_search(quorum_capture)
+    (cliff,) = s["cliffs"]
+    doc = cliff["repro"]
+    assert doc["kind"] == REPRO_KIND
+    assert cliff["repro_reproduced"] is True
+    # the emitter shrank at least one of (trials, nodes, rounds)
+    cfg = doc["config"]
+    assert (cfg["trials"] < doc["shrunk_from"]["trials"]
+            or cfg["n_nodes"] < doc["shrunk_from"]["n_nodes"]
+            or cfg["max_rounds"] < doc["shrunk_from"]["max_rounds"])
+    assert doc["verdict"]["verdict"] == "stalled"    # cliff's hi side
+    assert replay_repro(doc)["ok"] is True
+
+
+def test_repro_tamper_fails_replay(quorum_capture):
+    s = _quorum_search(quorum_capture)
+    doc = copy.deepcopy(s["cliffs"][0]["repro"])
+    doc["verdict"]["rounds_executed"] += 1           # edit the payload
+    rep = replay_repro(doc)
+    assert rep["ok"] is False and rep["digest_ok"] is False
+    doc2 = copy.deepcopy(s["cliffs"][0]["repro"])
+    doc2["digest"] = "sha256:" + "0" * 64            # edit the digest
+    assert replay_repro(doc2)["digest_ok"] is False
+
+
+def test_replay_cli_exit_codes(quorum_capture, tmp_path):
+    """0 reproduced / 2 mismatch / 1 unreadable — the CI contract."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    s = _quorum_search(quorum_capture)
+    ok_path = tmp_path / "ok.json"
+    save_repro(str(ok_path), s["cliffs"][0]["repro"])
+    proc = subprocess.run(
+        [sys.executable, "-m", "benor_tpu", "replay", str(ok_path)],
+        capture_output=True, text=True, env=env, timeout=240)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "REPRODUCED" in proc.stdout
+
+    bad = copy.deepcopy(s["cliffs"][0]["repro"])
+    bad["verdict"]["decided_frac"] = 0.123
+    bad_path = tmp_path / "bad.json"
+    bad_path.write_text(json.dumps(bad))
+    proc = subprocess.run(
+        [sys.executable, "-m", "benor_tpu", "replay", str(bad_path)],
+        capture_output=True, text=True, env=env, timeout=240)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+
+    junk = tmp_path / "junk.json"
+    junk.write_text('{"kind": "not_a_repro"}')
+    proc = subprocess.run(
+        [sys.executable, "-m", "benor_tpu", "replay", str(junk)],
+        capture_output=True, text=True, env=env, timeout=240)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+
+
+# --------------------------------------------------------------------------
+# manifest: schema + cross-field checker + journal parity
+# --------------------------------------------------------------------------
+
+
+def test_manifest_passes_registered_checker(quorum_capture):
+    m = quorum_capture["manifest"]
+    assert m["kind"] == ATLAS_MANIFEST_KIND
+    assert ATLAS_MANIFEST_KIND in check_metrics_schema.MANIFEST_CHECKERS
+    assert check_metrics_schema.check_atlas_manifest(m) == []
+
+
+def test_manifest_checker_flags_cross_field_drift(quorum_capture):
+    m = copy.deepcopy(quorum_capture["manifest"])
+    # bracket no longer contains the point estimate
+    m["searches"][0]["cliffs"][0]["point"] = 99.0
+    assert any("point" in e for e in
+               check_metrics_schema.check_atlas_manifest(m))
+    m2 = copy.deepcopy(quorum_capture["manifest"])
+    m2["probe_count"] += 1                          # totals drift
+    assert any("probe_count" in e for e in
+               check_metrics_schema.check_atlas_manifest(m2))
+    m3 = copy.deepcopy(quorum_capture["manifest"])
+    m3["searches"][0]["cliffs"][0]["repro"]["label"] = "edited"
+    assert any("digest" in e for e in
+               check_metrics_schema.check_atlas_manifest(m3))
+
+
+def test_journal_parity(quorum_capture):
+    par = journal_parity(quorum_capture["manifest"],
+                         quorum_capture["journal"])
+    assert par["parity"], par
+    assert par["journal_probes"] == par["manifest_probes"]
+
+
+def test_save_load_roundtrip(quorum_capture, tmp_path):
+    p = str(tmp_path / "m.json")
+    save_manifest(p, quorum_capture["manifest"])
+    assert load_manifest(p) == json.loads(
+        json.dumps(quorum_capture["manifest"]))
+
+
+# --------------------------------------------------------------------------
+# gate: committed baseline + exit codes
+# --------------------------------------------------------------------------
+
+
+def _baseline():
+    with open(BASELINE) as fh:
+        return json.load(fh)
+
+
+def test_committed_baseline_schema_and_self_gate():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, SCHEMA_TOOL, BASELINE],
+                          capture_output=True, text=True, env=env,
+                          timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "atlas manifest OK" in proc.stdout
+    proc = subprocess.run([sys.executable, GATE_TOOL, BASELINE],
+                          capture_output=True, text=True, env=env,
+                          timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "in-band" in proc.stdout
+
+
+def test_committed_baseline_pins_two_cliffs_with_brackets():
+    m = _baseline()
+    assert m["cliff_count"] >= 2
+    names = {s["name"] for s in m["searches"]}
+    assert {"omission", "partition"} <= names
+    for s in m["searches"]:
+        for c in s["cliffs"]:
+            assert c["lo"] < c["hi"]
+            assert c["lo"] <= c["point"] <= c["hi"]
+
+
+def test_gate_in_band_on_identical_manifests():
+    m = _baseline()
+    assert compare_atlas(m, m) == []
+
+
+def test_gate_flags_moved_vanished_and_stale():
+    m = _baseline()
+    moved = copy.deepcopy(m)
+    c = moved["searches"][0]["cliffs"][0]
+    span = c["hi"] - c["lo"]
+    for k in ("lo", "hi", "point"):
+        c[k] += 10 * span
+    assert any("moved" in f.message for f in compare_atlas(moved, m))
+
+    vanished = copy.deepcopy(m)
+    vanished["searches"][0]["cliffs"] = []
+    assert any("vanished" in f.message
+               for f in compare_atlas(vanished, m))
+
+    stale = copy.deepcopy(m)
+    for s in stale["searches"]:
+        for c in s["cliffs"]:
+            if c.get("repro") is not None:
+                c["repro_reproduced"] = False
+    assert any("no longer reproduces" in f.message
+               for f in compare_atlas(stale, m))
+
+
+def test_gate_incomparable_on_platform_and_scale():
+    m = _baseline()
+    other = copy.deepcopy(m)
+    other["platform"] = "definitely-not-" + str(m["platform"])
+    with pytest.raises(IncomparableAtlas, match="platform"):
+        compare_atlas(other, m)
+    other = copy.deepcopy(m)
+    other["scale"] = {"factor": 64.0}
+    with pytest.raises(IncomparableAtlas, match="scale"):
+        compare_atlas(other, m)
+
+
+def test_gate_cli_exit_codes(tmp_path):
+    """End-to-end: 0 in-band, 2 on a moved cliff, 3 on platform
+    mismatch / missing baseline under --strict."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    m = _baseline()
+
+    moved = copy.deepcopy(m)
+    c = moved["searches"][0]["cliffs"][0]
+    span = c["hi"] - c["lo"]
+    for k in ("lo", "hi", "point"):
+        c[k] += 10 * span
+    mp = tmp_path / "moved.json"
+    mp.write_text(json.dumps(moved))
+    proc = subprocess.run([sys.executable, GATE_TOOL, str(mp), BASELINE],
+                          capture_output=True, text=True, env=env,
+                          timeout=60)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "REGRESSION" in proc.stdout
+
+    foreign = copy.deepcopy(m)
+    foreign["platform"] = "tpu-from-another-lab"
+    fp = tmp_path / "foreign.json"
+    fp.write_text(json.dumps(foreign))
+    proc = subprocess.run([sys.executable, GATE_TOOL, str(fp), BASELINE],
+                          capture_output=True, text=True, env=env,
+                          timeout=60)
+    assert proc.returncode == 3, proc.stdout + proc.stderr
+
+    missing = subprocess.run(
+        [sys.executable, GATE_TOOL, str(mp),
+         str(tmp_path / "nope.json"), "--strict"],
+        capture_output=True, text=True, env=env, timeout=60)
+    assert missing.returncode == 3
+
+
+def test_build_manifest_totals(quorum_capture):
+    m = quorum_capture["manifest"]
+    assert m["probe_count"] == sum(s["probe_count"]
+                                   for s in m["searches"])
+    assert m["compile_count"] == sum(s["compile_count"]
+                                     for s in m["searches"])
+    assert m["cliff_count"] == sum(len(s["cliffs"])
+                                   for s in m["searches"])
+    rebuilt = build_manifest(m["searches"], scale=m["scale"]["factor"])
+    assert rebuilt["probe_count"] == m["probe_count"]
+
+
+# --------------------------------------------------------------------------
+# oracle differential: same side of the quorum cliff
+# --------------------------------------------------------------------------
+
+
+def _oracle_side(f, backend):
+    """Run one unanimous-ones trial at fault level ``f`` through an
+    event-loop oracle; 'decided' iff every healthy node decided."""
+    values = [1] * QN
+    faulty = [i < f for i in range(QN)]       # first-F, crash-from-birth
+    net = launch_network(QN, f, values, faulty, backend=backend,
+                         seed=0, max_rounds=QR)
+    net.start()
+    # the global-halt probe kills everyone once all healthy decided, so
+    # judge by ``decided`` on the healthy slice (faulty carry null)
+    states = net.get_states()
+    return ("decided" if all(st["decided"] for st in states[f:])
+            else "stalled")
+
+
+@pytest.mark.parametrize("backend", [
+    "express",
+    pytest.param("native", marks=pytest.mark.skipif(
+        not native_available(),
+        reason="g++ unavailable; native oracle not built")),
+])
+def test_oracle_agrees_on_quorum_cliff_sides(quorum_capture, backend):
+    """Differential acceptance: at the discovered cliff's bracketing
+    grid points the reference oracle lands on the SAME stall/decide
+    side as the TPU path that found the cliff."""
+    (cliff,) = _quorum_search(quorum_capture)["cliffs"]
+    lo_f, hi_f = int(cliff["lo"]), int(cliff["hi"])
+    assert _oracle_side(lo_f, backend) == cliff["lo_verdict"]
+    assert _oracle_side(hi_f, backend) == cliff["hi_verdict"]

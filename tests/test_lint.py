@@ -1014,6 +1014,40 @@ def test_removing_kernel_checker_registration_fails(tmp_path):
     assert "'kernel_manifest'" in f.message
 
 
+def _atlas_manifest_tree(tmp_path) -> str:
+    """The real atlas manifest builder + the real checker registry in
+    the sibling tools/ dir (the PR-20 sibling of _manifest_tree)."""
+    root = tmp_path / "pkg"
+    (root / "atlas").mkdir(parents=True)
+    shutil.copy(os.path.join(PKG_DIR, "atlas", "manifest.py"),
+                root / "atlas" / "manifest.py")
+    (tmp_path / "tools").mkdir()
+    shutil.copy(os.path.join(REPO, "tools", "check_metrics_schema.py"),
+                tmp_path / "tools" / "check_metrics_schema.py")
+    return str(root)
+
+
+def test_atlas_manifest_kind_clean_on_shipped_registry(tmp_path):
+    active, _ = _findings(_atlas_manifest_tree(tmp_path),
+                          rules=["manifest-kind-parity"])
+    assert active == []
+
+
+def test_removing_atlas_checker_registration_fails(tmp_path):
+    """The PR-20 acceptance mutation: un-registering
+    check_atlas_manifest makes the (unchanged) atlas emission an
+    unvalidated kind."""
+    root = _atlas_manifest_tree(tmp_path)
+    _edit(str(tmp_path), "tools/check_metrics_schema.py",
+          '    "atlas_manifest": "check_atlas_manifest",\n', "",
+          count=1)
+    active, _ = _findings(root, rules=["manifest-kind-parity"])
+    assert len(active) == 1
+    f = active[0]
+    assert f.path == "atlas/manifest.py"
+    assert "'atlas_manifest'" in f.message
+
+
 def test_stale_manifest_checker_row_is_a_finding(tmp_path):
     # a registry row whose checker function left the tool validates
     # nothing — the JIT_REGISTRY staleness discipline

@@ -640,3 +640,48 @@ def test_watch_stops_on_sweep_done(tmp_path, capsys):
     assert "sweep complete: 1 points / 1 buckets" in out
     assert "DONE" in out
     assert "[after]" not in out        # tail stopped AT the done record
+
+
+def test_watch_renders_atlas_records_and_keeps_going(tmp_path, capsys):
+    """PR 20: an atlas search journal interleaves sweepscope bucket
+    records with atlas_probe / atlas_cliff records and carries one
+    sweep_done PER GENERATION — ``--keep-going`` tails past them, the
+    kind-dispatched formatters render the atlas records, and the torn
+    tail is still skipped."""
+    from benor_tpu.__main__ import main
+    p = tmp_path / "atlas.jsonl"
+    lines = [
+        json.dumps({"kind": "sweep_bucket", "label": "atlas",
+                    "bucket_index": 0, "bucket_kind": "dyn",
+                    "point_indices": [0, 1], "fingerprint": "sha256:x",
+                    "compile_count": 1, "prepare_s": 0.0,
+                    "compile_s": 1.0, "run_s": 0.1, "fetch_s": 0.0,
+                    "points": []}),
+        json.dumps({"kind": "atlas_probe", "axis": "f", "generation": 0,
+                    "value": 7.0, "verdict": "decided",
+                    "stall_frac": 0.0, "decided_frac": 1.0,
+                    "rounds_executed": 2}),
+        json.dumps({"kind": "sweep_done", "label": "atlas",
+                    "done": True, "points_total": 2, "n_buckets": 1,
+                    "buckets_reused": 0, "overlap_headroom_s": 0.0}),
+        json.dumps({"kind": "atlas_cliff", "axis": "f", "generation": 1,
+                    "metric": "stall_frac", "lo": 7.0, "hi": 8.0,
+                    "width": 1.0, "point": 7.5,
+                    "lo_verdict": "decided", "hi_verdict": "stalled",
+                    "converged": True}),
+    ]
+    p.write_text("\n".join(lines) + "\n" + '{"kind": "atlas_pro')
+    assert main(["watch", str(p), "--no-follow", "--keep-going"]) == 0
+    out_lines = capsys.readouterr().out.strip().splitlines()
+    assert len(out_lines) == 4          # the torn tail line is skipped
+    assert "[atlas:f] gen=0 f=7.0 verdict=decided" in out_lines[1]
+    assert "stall=0.000" in out_lines[1]
+    assert "cliff [7.0, 8.0]" in out_lines[3]
+    assert "decided->stalled" in out_lines[3]
+    assert "CONVERGED" in out_lines[3]
+
+    # without --keep-going the per-generation done record still stops
+    # the tail — the atlas_cliff after it is never printed
+    assert main(["watch", str(p), "--timeout", "30"]) == 0
+    out = capsys.readouterr().out
+    assert "cliff [7.0, 8.0]" not in out

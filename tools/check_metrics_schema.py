@@ -1069,6 +1069,170 @@ def check_witness_bundle(bundle: dict,
     return errors
 
 
+ATLAS_SCHEMA_PATH = os.path.join(HERE, "atlas_manifest_schema.json")
+
+
+def _load_atlas_gate():
+    """File-path-load benor_tpu/atlas/gate.py — stdlib-only by contract
+    (the check_atlas_regression.py loader keeps it honest) — for the
+    canonical repro-digest recompute: an edited repro document embedded
+    in a manifest cannot survive this checker (the recompute-don't-trust
+    discipline of _load_sweep_gate and check_topo_blob)."""
+    import importlib.util
+
+    path = os.path.join(REPO, "benor_tpu", "atlas", "gate.py")
+    spec = importlib.util.spec_from_file_location("_atlas_gate", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check_atlas_search(s: dict, schema: dict, agate, label: str,
+                        errors: List[str]) -> None:
+    """One search document's row validation + cross-field recomputes."""
+    before = len(errors)
+    _validate(s, schema["search"], label, errors)
+    if len(errors) > before:
+        return                  # shape is wrong; recomputes would be noise
+    for i, p in enumerate(s["probes"]):
+        pb = len(errors)
+        _validate(p, schema["probe"], f"{label}.probes[{i}]", errors)
+        if len(errors) > pb:
+            continue
+        want = "stalled" if p["stall_frac"] >= 0.5 else "decided"
+        if p["verdict"] != want:
+            errors.append(f"{label}.probes[{i}].verdict: "
+                          f"{p['verdict']!r} contradicts stall_frac "
+                          f"{p['stall_frac']} (want {want!r})")
+        if not _near(p["stall_frac"], 1.0 - p["decided_frac"]):
+            errors.append(f"{label}.probes[{i}].stall_frac: "
+                          f"{p['stall_frac']} != 1 - decided_frac "
+                          f"({1.0 - p['decided_frac']:.6f})")
+    gen_compiles = {}
+    for i, g in enumerate(s["generations"]):
+        gb = len(errors)
+        _validate(g, schema["generation"],
+                  f"{label}.generations[{i}]", errors)
+        if len(errors) > gb:
+            continue
+        if g["generation"] != i:
+            errors.append(f"{label}.generations[{i}].generation: "
+                          f"{g['generation']} — generation ids must be "
+                          f"the contiguous evaluation order")
+        gen_compiles[g["generation"]] = g["compile_count"]
+    if len(errors) > before:
+        return                  # row errors; the recomputes would cascade
+    if s["probe_count"] != len(s["probes"]):
+        errors.append(f"{label}.probe_count: {s['probe_count']} != "
+                      f"{len(s['probes'])} probe rows")
+    want_pts = sum(g.get("n_points", 0) for g in s["generations"])
+    if s["probe_count"] != want_pts:
+        errors.append(f"{label}.probe_count: {s['probe_count']} != sum "
+                      f"of generation n_points ({want_pts}) — "
+                      f"probe/journal parity is broken")
+    want_cc = sum(g.get("compile_count", 0) for g in s["generations"])
+    if s["compile_count"] != want_cc:
+        errors.append(f"{label}.compile_count: {s['compile_count']} != "
+                      f"sum of generation compile counts ({want_cc})")
+    for j, c in enumerate(s["cliffs"]):
+        cl = f"{label}.cliffs[{j}]"
+        cb = len(errors)
+        _validate(c, schema["cliff"], cl, errors)
+        if len(errors) > cb:
+            continue
+        if not c["lo"] < c["hi"]:
+            errors.append(f"{cl}: bracket [{c['lo']}, {c['hi']}] is not "
+                          f"ordered")
+            continue
+        if not (c["lo"] <= c["point"] <= c["hi"]):
+            errors.append(f"{cl}.point: {c['point']} outside its own "
+                          f"bracket [{c['lo']}, {c['hi']}]")
+        if not _near(c["point"], (c["lo"] + c["hi"]) / 2.0):
+            errors.append(f"{cl}.point: {c['point']} != bracket "
+                          f"midpoint ({(c['lo'] + c['hi']) / 2.0:.6g})")
+        if not _near(c["width"], c["hi"] - c["lo"]):
+            errors.append(f"{cl}.width: {c['width']} != hi - lo "
+                          f"({c['hi'] - c['lo']:.6g})")
+        if not _near(c["jump"], abs(c["hi_metric"] - c["lo_metric"])):
+            errors.append(
+                f"{cl}.jump: {c['jump']} != |hi_metric - lo_metric| "
+                f"({abs(c['hi_metric'] - c['lo_metric']):.6g})")
+        if c["width"] > s["tol"] * (1 + 1e-6):
+            errors.append(f"{cl}.width: {c['width']} exceeds the "
+                          f"search's pinned tolerance {s['tol']} — the "
+                          f"bisection did not converge")
+        bad_gen = [g for g in c["generations"] if g not in gen_compiles]
+        if bad_gen:
+            errors.append(f"{cl}.generations: ids {bad_gen} are not "
+                          f"generations of this search")
+        else:
+            want = sum(gen_compiles[g] for g in c["generations"])
+            if c["compile_count"] != want:
+                errors.append(f"{cl}.compile_count: "
+                              f"{c['compile_count']} != sum of its "
+                              f"refinement generations' compiles "
+                              f"({want})")
+        repro = c.get("repro")
+        if repro is not None:
+            rb = len(errors)
+            _validate(repro, schema["repro"], f"{cl}.repro", errors)
+            if len(errors) == rb:
+                want_digest = agate.repro_digest(repro)
+                if repro["digest"] != want_digest:
+                    errors.append(
+                        f"{cl}.repro.digest: {repro['digest']} != "
+                        f"recomputed canonical digest ({want_digest}) "
+                        f"— the repro was edited after emission")
+                v = repro["verdict"]
+                want_v = ("stalled" if 1.0 - v["decided_frac"] >= 0.5
+                          else "decided")
+                if v["verdict"] != want_v:
+                    errors.append(
+                        f"{cl}.repro.verdict.verdict: "
+                        f"{v['verdict']!r} contradicts decided_frac "
+                        f"{v['decided_frac']} (want {want_v!r})")
+
+
+def check_atlas_manifest(manifest: dict,
+                         schema_path: str = ATLAS_SCHEMA_PATH
+                         ) -> List[str]:
+    """Validate an atlas manifest (`python -m benor_tpu atlas`,
+    ATLAS_BASELINE.json, bench.py's atlas sidecar blob) against
+    tools/atlas_manifest_schema.json; returns the error list (empty =
+    ok).  Cross-field, recomputed rather than trusted: probe/generation
+    parity, per-search and per-cliff compile accounting, bracket
+    geometry (ordering, midpoint, width, jump, convergence to the
+    pinned tolerance), verdict-vs-stall_frac consistency, and the
+    canonical digest of every embedded repro via atlas/gate.py."""
+    errors: List[str] = []
+    with open(schema_path) as fh:
+        schema = json.load(fh)
+    _validate(manifest, schema, "$", errors)
+    if errors:
+        return errors
+    agate = _load_atlas_gate()
+    for i, s in enumerate(manifest["searches"]):
+        _check_atlas_search(s, schema, agate, f"$.searches[{i}]",
+                            errors)
+    if errors:
+        return errors
+    searches = manifest["searches"]
+    want_p = sum(s["probe_count"] for s in searches)
+    if manifest["probe_count"] != want_p:
+        errors.append(f"$.probe_count: {manifest['probe_count']} != "
+                      f"sum of search probe counts ({want_p})")
+    want_c = sum(s["compile_count"] for s in searches)
+    if manifest["compile_count"] != want_c:
+        errors.append(f"$.compile_count: {manifest['compile_count']} "
+                      f"!= sum of search compile counts ({want_c})")
+    want_cl = sum(len(s["cliffs"]) for s in searches)
+    if manifest["cliff_count"] != want_cl:
+        errors.append(f"$.cliff_count: {manifest['cliff_count']} != "
+                      f"{want_cl} cliff rows")
+    return errors
+
+
 #: ``kind`` -> checker-function name for every pinned-schema manifest
 #: document this tool validates.  A PURE LITERAL by contract: benorlint's
 #: ``manifest-kind-parity`` rule (benor_tpu/analysis/rules_manifest.py)
@@ -1079,6 +1243,7 @@ def check_witness_bundle(bundle: dict,
 #: below dispatches through the same registry, so "registered" always
 #: means "actually runnable".
 MANIFEST_CHECKERS = {
+    "atlas_manifest": "check_atlas_manifest",
     "faults_manifest": "check_faults_manifest",
     "kernel_manifest": "check_kernel_manifest",
     "perf_manifest": "check_perf_manifest",
